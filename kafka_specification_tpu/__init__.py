@@ -1,0 +1,27 @@
+"""kafka_specification_tpu — a TPU-native explicit-state model checker.
+
+This package reproduces the capabilities of the reference corpus
+`hachikuji/kafka-specification` (TLA+ models of Kafka's single-partition
+replication protocol: KIP-101 -> KIP-279 -> KIP-320 truncation/fencing lineage
+plus the AsyncIsr/AlterIsr model) and supplies the checking engine those specs
+outsource to the external TLC tool — rebuilt TPU-first on JAX/XLA:
+
+- protocol state encoded as fixed-width int tensors (`ops.packing.StateSpec`),
+- `Next` actions and safety invariants compiled to `jax.vmap`'d successor and
+  predicate kernels (`models/`),
+- TLC's StateQueue + FPSet replaced by a device-resident BFS frontier with
+  64-bit fingerprint dedup (`engine/`), sharded over a device mesh with
+  `shard_map` + `all_to_all` fingerprint routing (`parallel/`),
+- a pure-Python oracle interpreter of the same TLA+ semantics (`oracle/`)
+  serving as the golden cross-check in place of stock TLC.
+
+Layout:
+    ops/       packing, fingerprinting, sorting/dedup primitives
+    models/    tensor encodings + action/invariant kernels per TLA+ module
+    engine/    BFS checker, trace reconstruction, checkpointing, stats
+    parallel/  mesh-sharded frontier (ICI collectives)
+    oracle/    slow set-semantics reference interpreter (golden source)
+    utils/     TLC-compatible .cfg parsing, CLI
+"""
+
+__version__ = "0.1.0"

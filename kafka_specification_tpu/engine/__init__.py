@@ -1,0 +1,3 @@
+from .bfs import CheckResult, Violation, check
+
+__all__ = ["CheckResult", "Violation", "check"]

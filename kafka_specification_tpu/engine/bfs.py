@@ -1,0 +1,322 @@
+"""Single-device level-synchronous BFS model checker.
+
+This is the TPU-native replacement for TLC's worker loop (StateQueue + FPSet
++ per-state invariant evaluation) — the external Java engine the reference
+corpus depends on (it vendors no checker; `*.toolbox` is gitignored,
+/root/reference/.gitignore:1).
+
+Per BFS level, one jitted step does:
+  frontier[B, K] --unpack--> vmap over (state x choice) of every action kernel
+  --> candidate successors [B, C, K] + enabled mask
+  --> fingerprint pairs, lexsort, adjacent-dedup           (in-batch dedup)
+  --> binary-search probe of the sorted visited set        (global dedup)
+  --> compact new states to the front, merge fps into visited
+  --> invariant predicate kernels on the new states
+
+Shapes are static under jit: the frontier is padded to power-of-two buckets
+and the visited set to a power-of-two capacity; the host loop re-pads and
+lets a new (bucket, capacity) pair trigger a (cached) recompile — O(log n)
+distinct shapes over a whole run, each compiled once.
+
+Deadlock checking is off by default: the bounded models deadlock by design
+once id sequences are exhausted and logs converge (every `Spec` in the corpus
+is run with TLC's deadlock check disabled for the same reason).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import Model
+from ..ops import dedup
+from ..ops.fingerprint import fingerprint_lanes
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+@dataclass
+class Violation:
+    invariant: str
+    depth: int
+    state: object  # decoded canonical state (or raw dict if no decoder)
+    trace: list  # [(action_name | "<init>", decoded state), ...] root -> violation
+
+
+@dataclass
+class CheckResult:
+    model: str
+    levels: list[int]  # distinct new states per BFS level (level 0 = inits)
+    total: int
+    diameter: int
+    violation: Optional[Violation]
+    seconds: float
+    states_per_sec: float
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+class _Step:
+    """Builds and caches the jitted level step for one model."""
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.spec = model.spec
+        self.K = self.spec.num_lanes
+        self.C = model.total_fanout
+        # global action id per flattened choice column
+        act_ids = np.concatenate(
+            [np.full(a.n_choices, i, np.int32) for i, a in enumerate(model.actions)]
+        )
+        self.act_ids = jnp.asarray(act_ids)
+        self._cache = {}
+
+    def _expand_one(self, state: dict):
+        """All successors of one state: (enabled[C], packed[C, K])."""
+        model, spec = self.model, self.spec
+        ok_parts, packed_parts = [], []
+        for a in model.actions:
+            choices = jnp.arange(a.n_choices, dtype=jnp.int32)
+            ok, nxt = jax.vmap(lambda c, s=state, a=a: a.kernel(s, c))(choices)
+            if model.constraint is not None:
+                ok = ok & jax.vmap(model.constraint)(nxt)
+            ok_parts.append(ok)
+            packed_parts.append(jax.vmap(spec.pack)(nxt))
+        return jnp.concatenate(ok_parts), jnp.concatenate(packed_parts, axis=0)
+
+    def get(self, bucket: int, vcap: int, with_invariants: bool = True):
+        key = (bucket, vcap, with_invariants)
+        if key not in self._cache:
+            self._cache[key] = self._build(bucket, vcap, with_invariants)
+        return self._cache[key]
+
+    def _build(self, bucket: int, vcap: int, with_invariants: bool):
+        spec, model = self.spec, self.model
+        C, K = self.C, self.K
+        M = bucket * C
+        act_ids = self.act_ids
+
+        @jax.jit
+        def step(frontier, fvalid, vhi, vlo, vn):
+            states = jax.vmap(spec.unpack)(frontier)
+            en, packed = jax.vmap(self._expand_one)(states)  # [B,C], [B,C,K]
+            en = en & fvalid[:, None]
+            cand = packed.reshape(M, K)
+            valid = en.reshape(M)
+            parent = jnp.repeat(jnp.arange(bucket, dtype=jnp.int32), C)
+            act = jnp.tile(act_ids, bucket)
+
+            hi, lo = fingerprint_lanes(cand, spec.exact64)
+            hi = jnp.where(valid, hi, dedup.SENT)
+            lo = jnp.where(valid, lo, dedup.SENT)
+            hi, lo, invalid, (cand, parent, act) = dedup.sort_pairs_with_payload(
+                hi, lo, ~valid, (cand, parent, act)
+            )
+            first = dedup.first_occurrence_mask(hi, lo, invalid)
+            seen = dedup.member_sorted(vhi, vlo, vn, hi, lo)
+            is_new = first & ~seen
+
+            # compact new states to the front (OOB scatter indices are dropped)
+            pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, M)
+            out = jnp.zeros((M, K), jnp.uint32).at[pos].set(cand)
+            out_parent = jnp.full((M,), -1, jnp.int32).at[pos].set(parent)
+            out_act = jnp.full((M,), -1, jnp.int32).at[pos].set(act)
+            new_n = jnp.sum(is_new, dtype=jnp.int32)
+
+            vhi2, vlo2, vn2 = dedup.merge_into_sorted(vhi, vlo, vn, hi, lo, is_new, vcap)
+
+            # invariants on the newly discovered states only
+            viol_any, viol_idx = [], []
+            if with_invariants and model.invariants:
+                new_states = jax.vmap(spec.unpack)(out)
+                new_mask = jnp.arange(M) < new_n
+                for inv in model.invariants:
+                    ok = jax.vmap(inv.pred)(new_states)
+                    bad = new_mask & ~ok
+                    viol_any.append(jnp.any(bad))
+                    viol_idx.append(jnp.argmax(bad))
+            else:
+                viol_any = [jnp.bool_(False)]
+                viol_idx = [jnp.int32(0)]
+            return (
+                out,
+                out_parent,
+                out_act,
+                new_n,
+                vhi2,
+                vlo2,
+                vn2,
+                jnp.stack(viol_any),
+                jnp.stack(viol_idx),
+            )
+
+        return step
+
+
+def _pad_rows(arr: np.ndarray, n: int, fill=0):
+    if arr.shape[0] == n:
+        return arr
+    pad_shape = (n - arr.shape[0],) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
+
+
+def check(
+    model: Model,
+    max_depth: Optional[int] = None,
+    max_states: Optional[int] = None,
+    store_trace: bool = True,
+    min_bucket: int = 256,
+    check_invariants: bool = True,
+    progress=None,
+    collect_levels: Optional[list] = None,
+) -> CheckResult:
+    """Breadth-first exhaustive check of `model`. Stops at first violation."""
+    spec = model.spec
+    step_builder = _Step(model)
+    K, C = spec.num_lanes, step_builder.C
+
+    inits = [
+        {k: np.asarray(v, np.int32) for k, v in s.items()} for s in model.init_states()
+    ]
+    init_packed = np.stack([np.asarray(spec.pack(s)) for s in inits])
+    # dedup inits (all corpus models have a single deterministic init)
+    init_packed = np.unique(init_packed, axis=0)
+    n0 = init_packed.shape[0]
+
+    t0 = time.perf_counter()
+    hi0, lo0 = fingerprint_lanes(jnp.asarray(init_packed), spec.exact64)
+    order = np.lexsort((np.asarray(lo0), np.asarray(hi0)))
+    vcap = _next_pow2(max(n0, min_bucket * C, 2))
+    vhi = np.full(vcap, 0xFFFFFFFF, np.uint32)
+    vlo = np.full(vcap, 0xFFFFFFFF, np.uint32)
+    vhi[:n0] = np.asarray(hi0)[order]
+    vlo[:n0] = np.asarray(lo0)[order]
+    vhi, vlo = jnp.asarray(vhi), jnp.asarray(vlo)
+    vn = jnp.int32(n0)
+
+    levels = [n0]
+    total = n0
+    trace_store = []  # per level: (packed[np], parent[np], act[np])
+    if store_trace:
+        trace_store.append((init_packed, np.full(n0, -1), np.full(n0, -1)))
+    if collect_levels is not None:
+        collect_levels.append(init_packed)
+
+    def decode_state(packed_row: np.ndarray):
+        s = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(packed_row)).items()}
+        return model.decode(s) if model.decode else s
+
+    def build_violation(inv_name, depth, idx):
+        # Walk parent pointers back through stored levels.
+        chain = []
+        i = idx
+        for d in range(depth, 0, -1):
+            packed, parent, act = trace_store[d]
+            chain.append((model.actions[int(act[i])].name, decode_state(packed[i])))
+            i = int(parent[i])
+        packed0, _, _ = trace_store[0]
+        chain.append(("<init>", decode_state(packed0[i])))
+        chain.reverse()
+        return Violation(
+            invariant=inv_name, depth=depth, state=chain[-1][1], trace=chain
+        )
+
+    # invariants on init states
+    if check_invariants and model.invariants:
+        st0 = jax.vmap(spec.unpack)(jnp.asarray(init_packed))
+        for inv in model.invariants:
+            ok = np.asarray(jax.vmap(inv.pred)(st0))
+            if not ok.all():
+                idx = int(np.argmax(~ok))
+                dt = time.perf_counter() - t0
+                viol = Violation(
+                    invariant=inv.name,
+                    depth=0,
+                    state=decode_state(init_packed[idx]),
+                    trace=[("<init>", decode_state(init_packed[idx]))],
+                )
+                return CheckResult(
+                    model.name, levels, total, 0, viol, dt, total / max(dt, 1e-9)
+                )
+
+    frontier_np = init_packed
+    depth = 0
+    violation = None
+
+    while frontier_np.shape[0] > 0:
+        if max_depth is not None and depth >= max_depth:
+            break
+        if max_states is not None and total >= max_states:
+            break
+        f = frontier_np.shape[0]
+        bucket = _next_pow2(max(f, min_bucket))
+        M = bucket * C
+        # ensure visited capacity can absorb worst-case M new states
+        need = int(vn) + M
+        if need > vcap:
+            new_cap = _next_pow2(need)
+            pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
+            vhi = jnp.concatenate([vhi, pad])
+            vlo = jnp.concatenate([vlo, pad])
+            vcap = new_cap
+
+        frontier = jnp.asarray(_pad_rows(frontier_np, bucket))
+        fvalid = jnp.arange(bucket) < f
+        step = step_builder.get(bucket, vcap, check_invariants)
+        out, out_parent, out_act, new_n, vhi, vlo, vn, viol_any, viol_idx = step(
+            frontier, fvalid, vhi, vlo, vn
+        )
+        new_n = int(new_n)
+        depth += 1
+        if new_n:
+            levels.append(new_n)
+            total += new_n
+        next_frontier = np.asarray(out[:new_n])
+        if collect_levels is not None and new_n:
+            collect_levels.append(next_frontier)
+        if store_trace:
+            trace_store.append(
+                (next_frontier, np.asarray(out_parent[:new_n]), np.asarray(out_act[:new_n]))
+            )
+        if progress:
+            progress(depth, new_n, total)
+
+        if check_invariants:
+            viol_any_np = np.asarray(viol_any)
+            if viol_any_np.any():
+                inv_i = int(np.argmax(viol_any_np))
+                idx = int(np.asarray(viol_idx)[inv_i])
+                if store_trace:
+                    violation = build_violation(model.invariants[inv_i].name, depth, idx)
+                else:
+                    violation = Violation(
+                        invariant=model.invariants[inv_i].name,
+                        depth=depth,
+                        state=decode_state(next_frontier[idx]),
+                        trace=[],
+                    )
+                break
+        frontier_np = next_frontier
+
+    dt = time.perf_counter() - t0
+    return CheckResult(
+        model=model.name,
+        levels=levels,
+        total=total,
+        diameter=len(levels) - 1,
+        violation=violation,
+        seconds=dt,
+        states_per_sec=total / max(dt, 1e-9),
+        stats={"visited_capacity": int(vcap), "fanout": C, "lanes": K},
+    )

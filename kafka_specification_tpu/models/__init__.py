@@ -1,0 +1,3 @@
+from .base import Action, Invariant, Model
+
+__all__ = ["Action", "Invariant", "Model"]

@@ -1,0 +1,65 @@
+"""Model API: a TLA+ spec compiled to tensor form.
+
+A Model is the TPU-native analogue of (TLA+ module + TLC .cfg):
+
+- `spec` defines the canonical tensor encoding of one state,
+- each Action is one disjunct of `Next`, compiled to a successor kernel over a
+  *fixed* choice space (the bounded existentials of the TLA+ action, e.g.
+  `\\E replica \\in Replicas` -> choice = replica index).  The kernel returns
+  (enabled?, next_state) for a given (state, choice); the engine vmaps it over
+  states x choices and masks disabled combinations — this is how TLC's
+  nondeterministic disjunct expansion becomes a dense TPU computation,
+- each Invariant is a predicate kernel (True = state OK),
+- `constraint`, if set, is TLC's CONSTRAINT: successors violating it are
+  pruned (not explored, not counted) — required to bound AsyncIsr, whose
+  LeaderWrite has no MaxOffset guard (/root/reference/AsyncIsr.tla:117-119).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from ..ops.packing import StateSpec
+
+# kernel: (state: dict[str, Array], choice: int32 scalar) -> (enabled: bool, next_state: dict)
+SuccessorKernel = Callable
+# pred: (state: dict[str, Array]) -> bool  (True = invariant holds)
+PredicateKernel = Callable
+
+
+@dataclass(frozen=True)
+class Action:
+    name: str
+    n_choices: int
+    kernel: SuccessorKernel
+
+
+@dataclass(frozen=True)
+class Invariant:
+    name: str
+    pred: PredicateKernel
+
+
+@dataclass
+class Model:
+    name: str
+    spec: StateSpec
+    init_states: Callable[[], Sequence[dict]]
+    actions: Sequence[Action]
+    invariants: Sequence[Invariant]
+    constraint: Optional[PredicateKernel] = None
+    # canonical Python value for a decoded state; must equal the oracle
+    # interpreter's state representation so state *sets* can be compared.
+    decode: Optional[Callable[[dict], object]] = None
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def total_fanout(self) -> int:
+        return sum(a.n_choices for a in self.actions)
+
+    def invariant(self, name: str) -> Invariant:
+        for inv in self.invariants:
+            if inv.name == name:
+                return inv
+        raise KeyError(name)

@@ -1,0 +1,60 @@
+"""IdSequence — monotonically increasing bounded counter.
+
+Reference: /root/reference/IdSequence.tla
+  IdSet == 0..MaxId                  (IdSequence.tla:28)
+  NextId(id) == id <= MaxId /\\ id = nextId /\\ nextId' = nextId + 1
+                                     (IdSequence.tla:30-33)
+  Init == nextId = 0                 (IdSequence.tla:37)
+  Next == \\E id \\in IdSet : NextId(id)  (IdSequence.tla:39)
+  TypeOk == nextId \\in IdSet \\union {MaxId + 1}  (IdSequence.tla:43)
+
+The existential in Next is forced (only id = nextId satisfies the guard), so
+the action kernel has a single choice.  Smallest checkable model in the
+corpus: MaxId + 2 distinct states in a single chain.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.packing import Field, StateSpec
+from ..oracle.interp import OracleAction, OracleModel
+from .base import Action, Invariant, Model
+
+
+def make_model(max_id: int) -> Model:
+    spec = StateSpec([Field("nextId", (), 0, max_id + 1)])
+
+    def init():
+        return [{"nextId": 0}]
+
+    def next_id(state, choice):
+        # NextId guard: id = nextId /\ id <= MaxId (IdSequence.tla:31-32).
+        # `id` is forced to nextId, so the only real guard is the bound.
+        enabled = state["nextId"] <= max_id
+        return enabled, {"nextId": jnp.minimum(state["nextId"] + 1, max_id + 1)}
+
+    def type_ok(state):
+        return (state["nextId"] >= 0) & (state["nextId"] <= max_id + 1)
+
+    return Model(
+        name=f"IdSequence(MaxId={max_id})",
+        spec=spec,
+        init_states=init,
+        actions=[Action("NextId", 1, next_id)],
+        invariants=[Invariant("TypeOk", type_ok)],
+        decode=lambda s: int(s["nextId"]),
+    )
+
+
+def make_oracle(max_id: int) -> OracleModel:
+    def successors(s):
+        if s <= max_id:  # IdSequence.tla:31-33
+            yield s + 1
+
+    return OracleModel(
+        name=f"IdSequence(MaxId={max_id})",
+        init_states=lambda: [0],  # IdSequence.tla:37
+        actions=[OracleAction("NextId", successors)],
+        invariants=[("TypeOk", lambda s: 0 <= s <= max_id + 1)],  # IdSequence.tla:43
+    )

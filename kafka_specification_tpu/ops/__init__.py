@@ -1,0 +1,4 @@
+from .packing import Field, StateSpec
+from .fingerprint import fingerprint_lanes
+
+__all__ = ["Field", "StateSpec", "fingerprint_lanes"]

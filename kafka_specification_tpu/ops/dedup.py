@@ -1,0 +1,84 @@
+"""Sorting, batch-dedup and sorted-set membership over (hi, lo) uint32 pairs.
+
+This is the device-resident replacement for TLC's FPSet + StateQueue: the
+visited set is a sorted array of fingerprint pairs living in HBM; each BFS
+level sorts the candidate fingerprints (XLA sort on TPU), drops in-batch
+duplicates by adjacent comparison, and probes the visited set with a
+fixed-iteration vectorized binary search (jit-friendly: no data-dependent
+control flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel (all-ones) sorts to the end; used to pad invalid slots.
+SENT = jnp.uint32(0xFFFFFFFF)
+
+
+def sort_pairs_with_payload(hi, lo, invalid, payloads):
+    """Sort candidates so valid entries come first ordered by (hi, lo).
+
+    invalid: bool[N] — True entries are pushed to the end.
+    payloads: tuple of arrays [N, ...] permuted alongside.
+    Returns (hi_s, lo_s, invalid_s, payloads_s).
+    """
+    order = jnp.lexsort((lo, hi, invalid.astype(jnp.uint32)))
+    take = lambda a: jnp.take(a, order, axis=0)
+    return take(hi), take(lo), take(invalid), tuple(take(p) for p in payloads)
+
+
+def first_occurrence_mask(hi_s, lo_s, invalid_s):
+    """After sorting: True for the first copy of each distinct valid pair."""
+    prev_same = jnp.concatenate(
+        [jnp.array([False]), (hi_s[1:] == hi_s[:-1]) & (lo_s[1:] == lo_s[:-1])]
+    )
+    return (~invalid_s) & (~prev_same)
+
+
+def member_sorted(set_hi, set_lo, set_n, q_hi, q_lo):
+    """Vectorized membership probe of queries against a sorted pair set.
+
+    set_hi/set_lo: uint32[cap] sorted ascending on (hi, lo) for the first
+    set_n entries (the rest is sentinel padding).  Fixed 32-iteration binary
+    search — static trip count, fully vectorized over queries.
+    """
+    cap = set_hi.shape[0]
+    n_q = q_hi.shape[0]
+    lo_i = jnp.zeros((n_q,), jnp.int32)
+    hi_i = jnp.full((n_q,), set_n, jnp.int32)
+    iters = max(1, cap.bit_length())
+
+    def body(_, carry):
+        lo_i, hi_i = carry
+        mid = (lo_i + hi_i) // 2
+        mh = set_hi[mid]
+        ml = set_lo[mid]
+        less = (mh < q_hi) | ((mh == q_hi) & (ml < q_lo))
+        return jnp.where(less, mid + 1, lo_i), jnp.where(less, hi_i, mid)
+
+    lo_i, _ = jax.lax.fori_loop(0, iters, body, (lo_i, hi_i))
+    idx = jnp.minimum(lo_i, cap - 1)
+    return (lo_i < set_n) & (set_hi[idx] == q_hi) & (set_lo[idx] == q_lo)
+
+
+def merge_into_sorted(set_hi, set_lo, set_n, new_hi, new_lo, new_valid, out_cap):
+    """Merge new pairs into the sorted visited set (concat + sort + slice).
+
+    Invalid new slots are replaced by sentinel pairs so they sort past the
+    valid region.  out_cap is a static capacity the caller guarantees to be
+    >= set_n + count(new_valid) (host-side doubling policy); the result is
+    sliced to it so the jitted caller keeps a fixed visited-set shape.
+    Returns (hi[out_cap], lo[out_cap], n).
+    """
+    all_hi = jnp.concatenate([set_hi, jnp.where(new_valid, new_hi, SENT)])
+    all_lo = jnp.concatenate([set_lo, jnp.where(new_valid, new_lo, SENT)])
+    order = jnp.lexsort((all_lo, all_hi))
+    all_hi, all_lo = all_hi[order], all_lo[order]
+    total = all_hi.shape[0]
+    if total < out_cap:
+        pad = jnp.full((out_cap - total,), SENT, jnp.uint32)
+        all_hi = jnp.concatenate([all_hi, pad])
+        all_lo = jnp.concatenate([all_lo, pad])
+    return all_hi[:out_cap], all_lo[:out_cap], set_n + jnp.sum(new_valid, dtype=jnp.int32)

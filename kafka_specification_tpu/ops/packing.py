@@ -1,0 +1,132 @@
+"""Fixed-width bit packing of model states into uint32 lanes.
+
+A model checker dedups states by identity, so the tensor encoding of a state
+must be *canonical*: one TLA+ state <-> exactly one bit pattern.  The models
+guarantee canonical field values (e.g. `TruncateTo` Nil-fills truncated log
+slots, /root/reference/FiniteReplicatedLog.tla:105-109, so unwritten slots are
+always Nil); this module guarantees a canonical bit layout.
+
+Each field is an integer tensor with a known inclusive value range
+[lo, hi].  Values are stored biased (v - lo) in ceil(log2(hi-lo+1)) bits.
+Elements never straddle a lane boundary (the packer pads instead), which keeps
+pack/unpack a pure gather/shift — friendly to XLA fusion on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Field:
+    """One state variable: an integer tensor of `shape` with values in [lo, hi]."""
+
+    name: str
+    shape: tuple[int, ...]
+    lo: int
+    hi: int
+
+    def __post_init__(self):
+        assert self.hi >= self.lo, (self.name, self.lo, self.hi)
+
+    @property
+    def width(self) -> int:
+        span = self.hi - self.lo + 1
+        return max(1, math.ceil(math.log2(span)))
+
+    @property
+    def num_elements(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) if self.shape else 1
+
+
+class StateSpec:
+    """Bit-layout codec for a tuple of Fields -> uint32[num_lanes].
+
+    pack/unpack are vectorizable (jax.vmap) and jit-friendly: the layout is
+    computed once in Python; at trace time packing is a segment-sum of shifted
+    values and unpacking a gather + shift + mask.
+    """
+
+    def __init__(self, fields: Sequence[Field], force_hashed: bool = False):
+        self.fields = tuple(fields)
+        self._force_hashed = force_hashed
+        names = [f.name for f in self.fields]
+        assert len(set(names)) == len(names), "duplicate field names"
+
+        lane_ids, shifts, widths, los = [], [], [], []
+        lane, bit = 0, 0
+        for f in self.fields:
+            w = f.width
+            assert w <= 32, f"field {f.name} needs {w} bits > 32"
+            for _ in range(f.num_elements):
+                if bit + w > 32:  # never straddle a lane
+                    lane, bit = lane + 1, 0
+                lane_ids.append(lane)
+                shifts.append(bit)
+                widths.append(w)
+                los.append(f.lo)
+                bit += w
+        self.num_lanes = lane + 1 if bit > 0 else lane
+        self.total_bits = sum(widths)
+        self._lane_ids = np.asarray(lane_ids, np.int32)
+        self._shifts = np.asarray(shifts, np.uint32)
+        self._masks = np.asarray([(1 << w) - 1 for w in widths], np.uint32)
+        self._los = np.asarray(los, np.int32)
+        self._num_elements = len(lane_ids)
+        # per-field slices into the flat element vector
+        self._field_slices = {}
+        ofs = 0
+        for f in self.fields:
+            self._field_slices[f.name] = (ofs, ofs + f.num_elements, f.shape)
+            ofs += f.num_elements
+        # True iff the whole state fits in 64 bits -> fingerprints can be exact
+        # (force_hashed exists so tests can exercise the hashed dedup mode on
+        # small states)
+        self.exact64 = self.num_lanes <= 2 and not force_hashed
+
+    # -- flat <-> struct -------------------------------------------------------
+
+    def _flatten(self, state: dict) -> jnp.ndarray:
+        parts = []
+        for f in self.fields:
+            v = jnp.asarray(state[f.name], jnp.int32).reshape(-1)
+            parts.append(v)
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def _unflatten(self, flat: jnp.ndarray) -> dict:
+        out = {}
+        for f in self.fields:
+            a, b, shape = self._field_slices[f.name]
+            v = flat[a:b].reshape(shape) if shape else flat[a]
+            out[f.name] = v
+        return out
+
+    # -- public API ------------------------------------------------------------
+
+    def pack(self, state: dict) -> jnp.ndarray:
+        """dict of int32 tensors -> uint32[num_lanes]. vmap over leading axes."""
+        flat = self._flatten(state)
+        biased = (flat - self._los).astype(jnp.uint32) & self._masks
+        shifted = biased << self._shifts
+        # widths don't overlap within a lane, so sum == bitwise-or
+        lanes = jnp.zeros((self.num_lanes,), jnp.uint32)
+        return lanes.at[self._lane_ids].add(shifted)
+
+    def unpack(self, lanes: jnp.ndarray) -> dict:
+        """uint32[num_lanes] -> dict of int32 tensors. vmap over leading axes."""
+        vals = (lanes[self._lane_ids] >> self._shifts) & self._masks
+        flat = vals.astype(jnp.int32) + self._los
+        return self._unflatten(flat)
+
+    def validate(self, state: dict) -> jnp.ndarray:
+        """True iff every element is within its declared [lo, hi] range."""
+        ok = jnp.bool_(True)
+        for f in self.fields:
+            v = jnp.asarray(state[f.name])
+            ok = ok & jnp.all(v >= f.lo) & jnp.all(v <= f.hi)
+        return ok

@@ -1,0 +1,3 @@
+from .interp import OracleAction, OracleModel, OracleResult, oracle_bfs
+
+__all__ = ["OracleAction", "OracleModel", "OracleResult", "oracle_bfs"]

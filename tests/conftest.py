@@ -1,0 +1,24 @@
+"""Force tests onto a virtual 8-device CPU platform.
+
+The sharded-frontier path (parallel/) must be exercisable in CI without TPU
+hardware; single-device tests also run faster on CPU than through the TPU
+tunnel for the tiny constants used here.
+
+Note: this environment's sitecustomize registers the `axon` TPU plugin at
+interpreter start and forces jax.config jax_platforms="axon,cpu", which
+overrides the JAX_PLATFORMS env var — so we must override the *config* back
+(before any backend is initialized), not just the env.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

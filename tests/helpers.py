@@ -1,0 +1,58 @@
+"""Shared test helpers: engine-vs-oracle cross validation."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.oracle.interp import oracle_bfs
+
+
+def enumerate_states(model, max_depth=None, min_bucket=32):
+    """Run the engine BFS and decode every level's states to canonical python
+    values. Returns (CheckResult, list of per-level state sets)."""
+    spec = model.spec
+    collected: list = []
+    res = check(
+        model,
+        max_depth=max_depth,
+        store_trace=True,
+        min_bucket=min_bucket,
+        collect_levels=collected,
+    )
+    levels = []
+    for packed in collected:
+        states = set()
+        for row in packed:
+            s = {k: np.asarray(v) for k, v in spec.unpack(row).items()}
+            states.add(model.decode(s))
+        levels.append(states)
+    return res, levels
+
+
+def assert_matches_oracle(model, oracle, max_depth=None, min_bucket=32):
+    """BFS both the JAX kernels and the Python oracle; require identical
+    per-level distinct-state *sets* (strongest possible equivalence), and the
+    same verdict (violation of the same invariant at the same depth, or an
+    exhaustive pass with identical counts)."""
+    ores = oracle_bfs(oracle, max_depth=max_depth)
+    res, engine_levels = enumerate_states(model, max_depth=max_depth, min_bucket=min_bucket)
+
+    if ores.violation is None:
+        assert res.violation is None, res.violation
+        assert res.levels == ores.levels, (res.levels, ores.levels)
+        assert res.total == ores.total
+        assert len(engine_levels) == len(ores.level_sets)
+        for d, (eng, orc) in enumerate(zip(engine_levels, ores.level_sets)):
+            assert eng == orc, (
+                f"level {d}: engine-only={list(eng - orc)[:3]} "
+                f"oracle-only={list(orc - eng)[:3]}"
+            )
+    else:
+        # Both stop at the violation level; the explored prefix must agree.
+        assert res.violation is not None, f"oracle found {ores.violation}, engine none"
+        assert res.violation.invariant == ores.violation[0]
+        assert res.violation.depth == ores.violation[1]
+        for d in range(ores.violation[1] + 1):
+            assert engine_levels[d] == ores.level_sets[d], f"level {d} diff"
+    return res, ores

@@ -1,0 +1,77 @@
+"""Engine behaviors: violation traces, hashed-fingerprint dedup mode,
+invariant checking at init, depth cutoffs."""
+
+import numpy as np
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import finite_replicated_log, id_sequence
+from kafka_specification_tpu.models.base import Invariant, Model
+
+from helpers import assert_matches_oracle
+
+
+def _with_invariants(base, invariants):
+    return Model(
+        name=base.name,
+        spec=base.spec,
+        init_states=base.init_states,
+        actions=base.actions,
+        invariants=invariants,
+        constraint=base.constraint,
+        decode=base.decode,
+    )
+
+
+def test_violation_trace_is_valid_action_path():
+    """Falsify an invariant at the end of the IdSequence chain; the
+    reconstructed trace must be a valid path init -> violation."""
+    max_id = 5
+    base = id_sequence.make_model(max_id)
+    model = _with_invariants(
+        base, [Invariant("BelowBound", lambda s: s["nextId"] <= 3)]
+    )
+    res = check(model, min_bucket=32)
+    assert res.violation is not None
+    v = res.violation
+    assert v.invariant == "BelowBound"
+    assert v.depth == 4 and v.state == 4
+    # the trace replays as a real action path: 0 ->NextId-> 1 ... -> 4
+    assert [s for _, s in v.trace] == [0, 1, 2, 3, 4]
+    assert v.trace[0][0] == "<init>"
+    assert all(a == "NextId" for a, _ in v.trace[1:])
+
+
+def test_violation_at_init():
+    base = id_sequence.make_model(3)
+    model = _with_invariants(base, [Invariant("NotZero", lambda s: s["nextId"] != 0)])
+    res = check(model)
+    assert res.violation is not None
+    assert res.violation.depth == 0
+    assert res.violation.trace == [("<init>", 0)]
+
+
+def test_hashed_fingerprint_mode_full_bfs():
+    """Same model checked in exact64 and forced-hashed dedup mode must agree
+    with the oracle state-for-state (exercises murmur3 path through the
+    whole sort/member/merge pipeline)."""
+    model = finite_replicated_log.make_model(2, 2, 2, force_hashed=True)
+    assert not model.spec.exact64
+    oracle = finite_replicated_log.make_oracle(2, 2, 2)
+    res, _ = assert_matches_oracle(model, oracle)
+    assert res.total == 7**2
+
+
+def test_invariants_checked_on_new_states_each_level():
+    """A violation deep in FRL: no log may reach length 2 — found at depth 2."""
+    base = finite_replicated_log.make_model(2, 2, 1)
+    model = _with_invariants(
+        base,
+        [Invariant("ShortLogs", lambda s: (s["end"] < 2).all())],
+    )
+    res = check(model, min_bucket=32)
+    assert res.violation is not None
+    assert res.violation.invariant == "ShortLogs"
+    assert res.violation.depth == 2
+    # trace is a valid path of length depth+1
+    assert len(res.violation.trace) == 3
+    assert res.violation.trace[0][0] == "<init>"

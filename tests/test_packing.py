@@ -1,0 +1,114 @@
+"""Codec round-trip and canonicality tests (SURVEY.md §7 step 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kafka_specification_tpu.ops.packing import Field, StateSpec
+from kafka_specification_tpu.ops.fingerprint import fingerprint_lanes
+from kafka_specification_tpu.ops import dedup
+
+
+def _random_state(spec, rng):
+    return {
+        f.name: rng.integers(f.lo, f.hi + 1, size=f.shape).astype(np.int32)
+        for f in spec.fields
+    }
+
+
+SPECS = [
+    StateSpec([Field("a", (), 0, 5)]),
+    StateSpec([Field("a", (3,), -1, 7), Field("b", (), 0, 1)]),
+    StateSpec(
+        [
+            Field("end", (5,), 0, 4),
+            Field("rec", (5, 4), -1, 4),
+            Field("isr", (5,), 0, 31),
+            Field("scalar", (), -1, 6),
+        ]
+    ),
+]
+
+
+@pytest.mark.parametrize("spec", SPECS)
+def test_roundtrip(spec):
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        s = _random_state(spec, rng)
+        packed = spec.pack(s)
+        assert packed.dtype == jnp.uint32
+        assert packed.shape == (spec.num_lanes,)
+        out = spec.unpack(packed)
+        for k, v in s.items():
+            np.testing.assert_array_equal(np.asarray(out[k]), v, err_msg=k)
+
+
+@pytest.mark.parametrize("spec", SPECS)
+def test_pack_is_injective(spec):
+    rng = np.random.default_rng(1)
+    seen = {}
+    for _ in range(200):
+        s = _random_state(spec, rng)
+        key = tuple(np.asarray(spec.pack(s)).tolist())
+        canon = tuple(np.asarray(s[f.name]).tobytes() for f in spec.fields)
+        if key in seen:
+            assert seen[key] == canon
+        seen[key] = canon
+
+
+def test_vmapped_roundtrip():
+    spec = SPECS[2]
+    rng = np.random.default_rng(2)
+    states = [_random_state(spec, rng) for _ in range(32)]
+    batched = {
+        f.name: np.stack([s[f.name] for s in states]) for f in spec.fields
+    }
+    packed = jax.vmap(spec.pack)(batched)
+    out = jax.vmap(spec.unpack)(packed)
+    for f in spec.fields:
+        np.testing.assert_array_equal(np.asarray(out[f.name]), batched[f.name])
+
+
+def test_exact64_flag():
+    small = StateSpec([Field("a", (), 0, 100), Field("b", (), 0, 100)])
+    assert small.exact64
+    big = SPECS[2]
+    assert big.num_lanes > 2 and not big.exact64
+
+
+def test_fingerprint_distinguishes():
+    spec = SPECS[2]
+    rng = np.random.default_rng(3)
+    packs = np.stack(
+        [np.asarray(spec.pack(_random_state(spec, rng))) for _ in range(500)]
+    )
+    uniq = np.unique(packs, axis=0)
+    hi, lo = fingerprint_lanes(jnp.asarray(uniq), exact=False)
+    pairs = set(zip(np.asarray(hi).tolist(), np.asarray(lo).tolist()))
+    assert len(pairs) == uniq.shape[0]  # no collisions on 500 random states
+
+
+def test_member_sorted():
+    rng = np.random.default_rng(4)
+    n, cap = 100, 128
+    vals = rng.integers(0, 2**31, size=(n, 2)).astype(np.uint32)
+    vals = np.unique(vals, axis=0)
+    n = vals.shape[0]
+    order = np.lexsort((vals[:, 1], vals[:, 0]))
+    shi = np.full(cap, 0xFFFFFFFF, np.uint32)
+    slo = np.full(cap, 0xFFFFFFFF, np.uint32)
+    shi[:n], slo[:n] = vals[order, 0], vals[order, 1]
+    # queries: half members, half misses
+    q_in = vals[rng.integers(0, n, 50)]
+    q_out = rng.integers(0, 2**31, size=(50, 2)).astype(np.uint32)
+    member_keys = {(int(a), int(b)) for a, b in vals}
+    q = np.concatenate([q_in, q_out])
+    got = np.asarray(
+        dedup.member_sorted(
+            jnp.asarray(shi), jnp.asarray(slo), jnp.int32(n),
+            jnp.asarray(q[:, 0]), jnp.asarray(q[:, 1]),
+        )
+    )
+    want = np.array([(int(a), int(b)) in member_keys for a, b in q])
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,47 @@
+"""End-to-end engine-vs-oracle checks on the two L2 component models
+(SURVEY.md §7 step 4: the minimum end-to-end slice)."""
+
+import pytest
+
+from kafka_specification_tpu.models import finite_replicated_log, id_sequence
+
+from helpers import assert_matches_oracle
+
+
+@pytest.mark.parametrize("max_id", [0, 3, 10])
+def test_id_sequence(max_id):
+    model = id_sequence.make_model(max_id)
+    oracle = id_sequence.make_oracle(max_id)
+    res, ores = assert_matches_oracle(model, oracle)
+    # IdSequence is a single chain: 0..MaxId+1 -> MaxId+2 states, diameter MaxId+1
+    assert res.total == max_id + 2
+    assert res.diameter == max_id + 1
+    assert res.ok
+
+
+@pytest.mark.parametrize(
+    "n,l,r",
+    [
+        (2, 2, 1),
+        (2, 2, 2),
+        (3, 2, 2),
+        (2, 3, 2),
+    ],
+)
+def test_finite_replicated_log(n, l, r):
+    model = finite_replicated_log.make_model(n, l, r)
+    oracle = finite_replicated_log.make_oracle(n, l, r)
+    res, ores = assert_matches_oracle(model, oracle)
+    assert res.ok
+    # closed form: per-replica log count = sum_{k=0..L} R^k, independent replicas
+    per_log = sum(r**k for k in range(l + 1))
+    assert res.total == per_log**n
+
+
+def test_frl_3replicas_logsize4():
+    """The BASELINE.json config 'FiniteReplicatedLog (3 replicas, L=4)' at a
+    reduced record universe — full cross-check against the oracle."""
+    model = finite_replicated_log.make_model(3, 4, 1)
+    oracle = finite_replicated_log.make_oracle(3, 4, 1)
+    res, _ = assert_matches_oracle(model, oracle)
+    assert res.total == 5**3
