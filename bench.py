@@ -1,0 +1,59 @@
+"""Benchmark: distinct states/sec, exhaustive check of Kip320 (the flagship).
+
+Runs the TPU engine on the default platform (the real chip under axon) over
+Kip320 at 3 brokers (737,794 distinct states, all four invariants on — the
+THEOREM workload of Kip320.tla:168-171; count pinned by the oracle), and
+prints ONE JSON line.
+
+vs_baseline: the reference corpus publishes no numbers (BASELINE.md) and its
+external engine (TLC, Java) is not installable in this zero-egress image, so
+the recorded baseline is this machine's Python oracle interpreter on the same
+model — an explicit-state BFS in CPython, the same algorithmic role TLC's
+worker loop plays.  Its throughput is measured fresh in each bench run
+(oracle on a 2-broker config, extrapolation-free: states/sec is
+config-insensitive within ~2x).  See BASELINE.md for the measurement plan.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    from kafka_specification_tpu.engine import check
+    from kafka_specification_tpu.models import kip320
+    from kafka_specification_tpu.models.kafka_replication import Config
+    from kafka_specification_tpu.oracle.interp import oracle_bfs
+
+    # baseline: Python-oracle BFS throughput (TLC stand-in), small config
+    ocfg = Config(2, 2, 2, 2)
+    t0 = time.perf_counter()
+    ores = oracle_bfs(kip320.make_oracle(ocfg), keep_level_sets=False)
+    oracle_sps = ores.total / (time.perf_counter() - t0)
+
+    cfg = Config(3, 2, 2, 2)
+    model = kip320.make_model(cfg)
+    res = check(model, store_trace=False, min_bucket=4096)
+    assert res.ok, res.violation
+    assert res.total == 737_794, res.total  # oracle-pinned golden count
+
+    print(
+        json.dumps(
+            {
+                "metric": "Kip320 3-broker exhaustive check (737,794 states, "
+                "4 invariants), distinct states/sec",
+                "value": round(res.states_per_sec, 1),
+                "unit": "states/sec",
+                "vs_baseline": round(res.states_per_sec / oracle_sps, 2),
+            }
+        )
+    )
+    print(
+        f"# engine: {res.seconds:.1f}s wall, diameter {res.diameter}, "
+        f"oracle baseline {oracle_sps:.0f} states/sec",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

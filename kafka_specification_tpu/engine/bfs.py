@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Optional
 
 import jax
@@ -83,23 +82,36 @@ class _Step:
         self._cache = {}
 
     def _expand_one(self, state: dict):
-        """All successors of one state: (enabled[C], packed[C, K])."""
+        """All successors of one state: (enabled_pre_constraint[C],
+        enabled[C], packed[C, K]).  The pre-constraint mask feeds deadlock
+        detection (a state is deadlocked when no action is enabled,
+        regardless of CONSTRAINT pruning)."""
         model, spec = self.model, self.spec
-        ok_parts, packed_parts = [], []
+        pre_parts, ok_parts, packed_parts = [], [], []
         for a in model.actions:
             choices = jnp.arange(a.n_choices, dtype=jnp.int32)
             ok, nxt = jax.vmap(lambda c, s=state, a=a: a.kernel(s, c))(choices)
+            pre_parts.append(ok)
             if model.constraint is not None:
                 ok = ok & jax.vmap(model.constraint)(nxt)
             ok_parts.append(ok)
             packed_parts.append(jax.vmap(spec.pack)(nxt))
-        return jnp.concatenate(ok_parts), jnp.concatenate(packed_parts, axis=0)
+        return (
+            jnp.concatenate(pre_parts),
+            jnp.concatenate(ok_parts),
+            jnp.concatenate(packed_parts, axis=0),
+        )
 
     def get(self, bucket: int, vcap: int, with_invariants: bool = True):
         key = (bucket, vcap, with_invariants)
         if key not in self._cache:
-            self._cache[key] = self._build(bucket, vcap, with_invariants)
+            self._cache[key] = jax.jit(self.build_raw(bucket, vcap, with_invariants))
         return self._cache[key]
+
+    def build_raw(self, bucket: int, vcap: int, with_invariants: bool = True):
+        """The un-jitted level step (frontier, fvalid, vhi, vlo, vn) -> ...;
+        exposed for the driver's compile checks and custom jit wrapping."""
+        return self._build(bucket, vcap, with_invariants)
 
     def _build(self, bucket: int, vcap: int, with_invariants: bool):
         spec, model = self.spec, self.model
@@ -107,10 +119,12 @@ class _Step:
         M = bucket * C
         act_ids = self.act_ids
 
-        @jax.jit
         def step(frontier, fvalid, vhi, vlo, vn):
             states = jax.vmap(spec.unpack)(frontier)
-            en, packed = jax.vmap(self._expand_one)(states)  # [B,C], [B,C,K]
+            en_pre, en, packed = jax.vmap(self._expand_one)(states)  # [B,C]x2, [B,C,K]
+            deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
+            dl_any = jnp.any(deadlocked)
+            dl_idx = jnp.argmax(deadlocked)
             en = en & fvalid[:, None]
             cand = packed.reshape(M, K)
             valid = en.reshape(M)
@@ -118,8 +132,9 @@ class _Step:
             act = jnp.tile(act_ids, bucket)
 
             hi, lo = fingerprint_lanes(cand, spec.exact64)
-            hi = jnp.where(valid, hi, dedup.SENT)
-            lo = jnp.where(valid, lo, dedup.SENT)
+            sent = jnp.uint32(dedup.SENT)
+            hi = jnp.where(valid, hi, sent)
+            lo = jnp.where(valid, lo, sent)
             hi, lo, invalid, (cand, parent, act) = dedup.sort_pairs_with_payload(
                 hi, lo, ~valid, (cand, parent, act)
             )
@@ -159,6 +174,8 @@ class _Step:
                 vn2,
                 jnp.stack(viol_any),
                 jnp.stack(viol_idx),
+                dl_any,
+                dl_idx,
             )
 
         return step
@@ -180,11 +197,34 @@ def check(
     check_invariants: bool = True,
     progress=None,
     collect_levels: Optional[list] = None,
+    checkpoint_dir: Optional[str] = None,
+    check_deadlock: bool = False,
 ) -> CheckResult:
-    """Breadth-first exhaustive check of `model`. Stops at first violation."""
+    """Breadth-first exhaustive check of `model`. Stops at first violation.
+
+    check_deadlock: when True (TLC's CHECK_DEADLOCK TRUE), a reachable state
+    with no enabled action is reported as a violation of the pseudo-invariant
+    "Deadlock" (CONSTRAINT pruning does not mask enabledness).  Default off:
+    the bounded corpus models deadlock by design (SURVEY.md §2.4).
+
+    checkpoint_dir: when set, the (visited set, frontier, level counters) are
+    persisted after every BFS level and a run restarts from the last saved
+    level if a checkpoint exists — the natural fit for a level-synchronous
+    engine (SURVEY.md §5 "Checkpoint / resume"; TLC keeps this externally).
+    Checkpointed runs don't retain parent-pointer traces across restarts, so
+    store_trace is forced off.
+    """
     spec = model.spec
     step_builder = _Step(model)
     K, C = spec.num_lanes, step_builder.C
+
+    ckpt_path = None
+    if checkpoint_dir is not None:
+        import os
+
+        store_trace = False
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(checkpoint_dir, "bfs_checkpoint.npz")
 
     inits = [
         {k: np.asarray(v, np.int32) for k, v in s.items()} for s in model.init_states()
@@ -254,6 +294,47 @@ def check(
     depth = 0
     violation = None
 
+    # identity stamp: a checkpoint may only resume the same model+constants
+    ckpt_ident = f"{model.name}|lanes={spec.num_lanes}|" + ",".join(
+        f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields
+    )
+    if ckpt_path is not None:
+        import os
+
+        if os.path.exists(ckpt_path):
+            snap = np.load(ckpt_path)
+            found = str(snap["ident"]) if "ident" in snap else "<none>"
+            if found != ckpt_ident:
+                raise ValueError(
+                    f"checkpoint at {ckpt_path} was written by a different "
+                    f"model/config:\n  checkpoint: {found}\n  this run:   {ckpt_ident}"
+                )
+            frontier_np = snap["frontier"]
+            vcap = int(snap["vcap"])
+            vhi = jnp.asarray(snap["vhi"])
+            vlo = jnp.asarray(snap["vlo"])
+            vn = jnp.int32(int(snap["vn"]))
+            levels = snap["levels"].tolist()
+            total = int(snap["total"])
+            depth = int(snap["depth"])
+
+    def _save_checkpoint():
+        np.savez_compressed(
+            ckpt_path + ".tmp.npz",
+            ident=ckpt_ident,
+            frontier=frontier_np,
+            vhi=np.asarray(vhi),
+            vlo=np.asarray(vlo),
+            vn=int(vn),
+            vcap=vcap,
+            levels=np.asarray(levels),
+            total=total,
+            depth=depth,
+        )
+        import os
+
+        os.replace(ckpt_path + ".tmp.npz", ckpt_path)
+
     while frontier_np.shape[0] > 0:
         if max_depth is not None and depth >= max_depth:
             break
@@ -274,9 +355,31 @@ def check(
         frontier = jnp.asarray(_pad_rows(frontier_np, bucket))
         fvalid = jnp.arange(bucket) < f
         step = step_builder.get(bucket, vcap, check_invariants)
-        out, out_parent, out_act, new_n, vhi, vlo, vn, viol_any, viol_idx = step(
-            frontier, fvalid, vhi, vlo, vn
-        )
+        (
+            out,
+            out_parent,
+            out_act,
+            new_n,
+            vhi,
+            vlo,
+            vn,
+            viol_any,
+            viol_idx,
+            dl_any,
+            dl_idx,
+        ) = step(frontier, fvalid, vhi, vlo, vn)
+        if check_deadlock and bool(dl_any):
+            i = int(dl_idx)
+            if store_trace:
+                violation = build_violation("Deadlock", depth, i)
+            else:
+                violation = Violation(
+                    invariant="Deadlock",
+                    depth=depth,
+                    state=decode_state(frontier_np[i]),
+                    trace=[],
+                )
+            break
         new_n = int(new_n)
         depth += 1
         if new_n:
@@ -308,6 +411,8 @@ def check(
                     )
                 break
         frontier_np = next_frontier
+        if ckpt_path is not None:
+            _save_checkpoint()
 
     dt = time.perf_counter() - t0
     return CheckResult(

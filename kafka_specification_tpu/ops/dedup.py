@@ -14,7 +14,10 @@ import jax
 import jax.numpy as jnp
 
 # Sentinel (all-ones) sorts to the end; used to pad invalid slots.
-SENT = jnp.uint32(0xFFFFFFFF)
+# (kept as a Python int: a module-level jnp constant would initialize the
+# default JAX backend at import time, which must not happen on TPU hosts
+# where import != run)
+SENT = 0xFFFFFFFF
 
 
 def sort_pairs_with_payload(hi, lo, invalid, payloads):
@@ -72,8 +75,9 @@ def merge_into_sorted(set_hi, set_lo, set_n, new_hi, new_lo, new_valid, out_cap)
     sliced to it so the jitted caller keeps a fixed visited-set shape.
     Returns (hi[out_cap], lo[out_cap], n).
     """
-    all_hi = jnp.concatenate([set_hi, jnp.where(new_valid, new_hi, SENT)])
-    all_lo = jnp.concatenate([set_lo, jnp.where(new_valid, new_lo, SENT)])
+    sent = jnp.uint32(SENT)
+    all_hi = jnp.concatenate([set_hi, jnp.where(new_valid, new_hi, sent)])
+    all_lo = jnp.concatenate([set_lo, jnp.where(new_valid, new_lo, sent)])
     order = jnp.lexsort((all_lo, all_hi))
     all_hi, all_lo = all_hi[order], all_lo[order]
     total = all_hi.shape[0]

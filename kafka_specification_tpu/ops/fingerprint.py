@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# plain ints (not jnp scalars): a module-level jnp constant would initialize
+# the default JAX backend at import time
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
 
 
 def _rotl32(x, r):
@@ -37,10 +39,11 @@ def _fmix32(h):
 def _murmur3_lanes(lanes: jnp.ndarray, seed: int) -> jnp.ndarray:
     """murmur3_x86_32 over the trailing lane axis. lanes: uint32[..., K]."""
     k = lanes.shape[-1]
+    c1, c2 = jnp.uint32(_C1), jnp.uint32(_C2)
     h = jnp.full(lanes.shape[:-1], seed, jnp.uint32)
     for i in range(k):
-        kx = lanes[..., i] * _C1
-        kx = _rotl32(kx, 15) * _C2
+        kx = lanes[..., i] * c1
+        kx = _rotl32(kx, 15) * c2
         h = h ^ kx
         h = _rotl32(h, 13) * jnp.uint32(5) + jnp.uint32(0xE6546B64)
     return _fmix32(h ^ jnp.uint32(4 * k))

@@ -54,7 +54,12 @@ def oracle_bfs(
     max_states: Optional[int] = None,
     stop_on_violation: bool = True,
     keep_level_sets: bool = True,
+    check_deadlock: bool = False,
 ) -> OracleResult:
+    """check_deadlock: report a state with no successors as a violation of
+    the pseudo-invariant "Deadlock" (TLC's CHECK_DEADLOCK TRUE).  Note: an
+    oracle model whose generators bake constraint bounds into the guards
+    (AsyncIsr) treats constraint-pruned successors as absent here."""
     inits = list(dict.fromkeys(model.init_states()))
     visited = set(inits)
     parent = {s: (None, "<init>") for s in inits}
@@ -79,14 +84,21 @@ def oracle_bfs(
             break
         nxt = []
         for s in frontier:
+            any_succ = False
             for a in model.actions:
                 for t in a.successors(s):
+                    any_succ = True
                     if model.constraint is not None and not model.constraint(t):
                         continue
                     if t not in visited:
                         visited.add(t)
                         parent[t] = (s, a.name)
                         nxt.append(t)
+            if check_deadlock and not any_succ and violation is None:
+                violation = ("Deadlock", depth, s)
+        if violation is not None and check_deadlock and violation[0] == "Deadlock":
+            frontier = []
+            break
         depth += 1
         if nxt:
             levels.append(len(nxt))

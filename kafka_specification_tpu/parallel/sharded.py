@@ -1,0 +1,326 @@
+"""Mesh-sharded BFS: the distributed engine (SURVEY.md §2.6).
+
+TLC parallelizes with Java worker threads over a shared FPSet; the TPU-native
+equivalent shards the frontier AND the fingerprint set across a 1-D device
+mesh and exchanges ownership over ICI collectives:
+
+- the frontier lives sharded across devices (axis 'd'); each device expands
+  its shard with the same vmapped action kernels as the single-device engine,
+- every candidate successor is owned by the device selected by its
+  fingerprint (owner = fp_lo mod D — fingerprint-range sharding),
+- candidates are exchanged with `lax.all_gather` (the north-star design in
+  BASELINE.json); each device filters to the candidates it owns, dedups them
+  against its local sorted fingerprint shard, and keeps its new states as its
+  shard of the next frontier — hash ownership keeps shards balanced with no
+  host-side reshuffle,
+- `lax.psum` provides frontier-size consensus and termination detection.
+
+Everything runs under `jax.jit` + `shard_map` over a `jax.sharding.Mesh`, so
+the same code drives 8 virtual CPU devices in CI, one real TPU chip, or a
+v5e-8 pod slice — XLA inserts the ICI collectives.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.bfs import CheckResult, Violation, _next_pow2, _Step
+from ..models.base import Model
+from ..ops import dedup
+from ..ops.fingerprint import fingerprint_lanes
+
+
+def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
+    """Jitted sharded level step.
+
+    Global shapes (D = mesh size):
+      frontier [D*bucket, K], fvalid [D*bucket]
+      vhi/vlo  [D, vcap]  (per-device sorted fingerprint shard), vn [D]
+    Returns per-shard compacted new states [D*M, K], per-shard new counts [D],
+    updated visited, and violation flags.
+    """
+    spec = model.spec
+    expander = _Step(model)
+    K, C = spec.num_lanes, expander.C
+    M = bucket * C
+    D = mesh.devices.size
+    act_ids = expander.act_ids
+
+    def shard_body(frontier, fvalid, vhi, vlo, vn):
+        # per-shard views: frontier [bucket, K], vhi [1, vcap], vn [1]
+        vhi, vlo, vn = vhi[0], vlo[0], vn[0]
+        me = jax.lax.axis_index("d")
+
+        states = jax.vmap(spec.unpack)(frontier)
+        en_pre, en, packed = jax.vmap(expander._expand_one)(states)
+        deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
+        en = en & fvalid[:, None]
+        cand = packed.reshape(M, K)
+        valid = en.reshape(M)
+        parent = me * bucket + jnp.repeat(jnp.arange(bucket, dtype=jnp.int32), C)
+        act = jnp.tile(act_ids, bucket)
+
+        hi, lo = fingerprint_lanes(cand, spec.exact64)
+        sent = jnp.uint32(dedup.SENT)
+        hi = jnp.where(valid, hi, sent)
+        lo = jnp.where(valid, lo, sent)
+
+        # exchange: gather all candidates, keep the ones this shard owns
+        g_hi = jax.lax.all_gather(hi, "d", tiled=True)  # [D*M]
+        g_lo = jax.lax.all_gather(lo, "d", tiled=True)
+        g_cand = jax.lax.all_gather(cand, "d", tiled=True)  # [D*M, K]
+        g_parent = jax.lax.all_gather(parent, "d", tiled=True)
+        g_act = jax.lax.all_gather(act, "d", tiled=True)
+        g_valid = jax.lax.all_gather(valid, "d", tiled=True)
+
+        mine = g_valid & ((g_lo % jnp.uint32(D)).astype(jnp.int32) == me)
+        g_hi = jnp.where(mine, g_hi, sent)
+        g_lo = jnp.where(mine, g_lo, sent)
+
+        s_hi, s_lo, s_inv, (s_cand, s_parent, s_act) = dedup.sort_pairs_with_payload(
+            g_hi, g_lo, ~mine, (g_cand, g_parent, g_act)
+        )
+        first = dedup.first_occurrence_mask(s_hi, s_lo, s_inv)
+        seen = dedup.member_sorted(vhi, vlo, vn, s_hi, s_lo)
+        is_new = first & ~seen
+
+        DM = D * M
+        pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, DM)
+        out = jnp.zeros((DM, K), jnp.uint32).at[pos].set(s_cand)
+        out_parent = jnp.full((DM,), -1, jnp.int32).at[pos].set(s_parent)
+        out_act = jnp.full((DM,), -1, jnp.int32).at[pos].set(s_act)
+        new_n = jnp.sum(is_new, dtype=jnp.int32)
+
+        vhi2, vlo2, vn2 = dedup.merge_into_sorted(vhi, vlo, vn, s_hi, s_lo, is_new, vcap)
+
+        viol_any, viol_idx = [], []
+        if model.invariants:
+            new_states = jax.vmap(spec.unpack)(out)
+            new_mask = jnp.arange(DM) < new_n
+            for inv in model.invariants:
+                ok = jax.vmap(inv.pred)(new_states)
+                bad = new_mask & ~ok
+                viol_any.append(jnp.any(bad))
+                viol_idx.append(jnp.argmax(bad))
+        else:
+            viol_any, viol_idx = [jnp.bool_(False)], [jnp.int32(0)]
+
+        return (
+            out,  # [D*M, K] per-shard compacted (out_spec concatenates to [D*D*M])
+            out_parent,
+            out_act,
+            new_n[None],
+            vhi2[None],
+            vlo2[None],
+            vn2[None],
+            jnp.stack(viol_any)[None],  # [1, n_inv] per shard -> [D, n_inv]
+            jnp.stack(viol_idx)[None],
+            jnp.any(deadlocked)[None],
+            jnp.argmax(deadlocked)[None],
+        )
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
+        out_specs=tuple([P("d")] * 11),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def check_sharded(
+    model: Model,
+    mesh: Optional[Mesh] = None,
+    max_depth: Optional[int] = None,
+    max_states: Optional[int] = None,
+    min_bucket: int = 256,
+    progress=None,
+    check_deadlock: bool = False,
+) -> CheckResult:
+    """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
+
+    Semantics match engine.check (same models, same counts); violation states
+    are reported without a parent trace — re-run the single-device engine on
+    the violating config to reconstruct a path (trace storage at pod scale is
+    a checkpointing concern, handled level-wise on the host there).
+    """
+    if mesh is None:
+        mesh = Mesh(np.array(jax.devices()), ("d",))
+    D = mesh.devices.size
+    spec = model.spec
+    C = sum(a.n_choices for a in model.actions)
+    K = spec.num_lanes
+
+    inits = [
+        {k: np.asarray(v, np.int32) for k, v in s.items()} for s in model.init_states()
+    ]
+    init_packed = np.unique(
+        np.stack([np.asarray(spec.pack(s)) for s in inits]), axis=0
+    )
+    n0 = init_packed.shape[0]
+
+    t0 = time.perf_counter()
+    # invariants on the init states (semantics must match engine.check)
+    if model.invariants:
+        st0 = jax.vmap(spec.unpack)(jnp.asarray(init_packed))
+        for inv in model.invariants:
+            ok = np.asarray(jax.vmap(inv.pred)(st0))
+            if not ok.all():
+                idx = int(np.argmax(~ok))
+                st = {
+                    k: np.asarray(v)
+                    for k, v in spec.unpack(jnp.asarray(init_packed[idx])).items()
+                }
+                return CheckResult(
+                    model.name,
+                    [n0],
+                    n0,
+                    0,
+                    Violation(
+                        invariant=inv.name,
+                        depth=0,
+                        state=model.decode(st) if model.decode else st,
+                        trace=[],
+                    ),
+                    time.perf_counter() - t0,
+                    0.0,
+                    stats={"devices": D},
+                )
+    # distribute inits to owner shards; per-shard sorted visited arrays
+    hi0, lo0 = fingerprint_lanes(jnp.asarray(init_packed), spec.exact64)
+    hi0, lo0 = np.asarray(hi0), np.asarray(lo0)
+    owner0 = lo0 % D
+    vcap = _next_pow2(max(1024, 4 * n0))
+    vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+    vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+    vn = np.zeros((D,), np.int32)
+    for d in range(D):
+        sel = np.nonzero(owner0 == d)[0]
+        order = np.lexsort((lo0[sel], hi0[sel]))
+        vhi[d, : len(sel)] = hi0[sel][order]
+        vlo[d, : len(sel)] = lo0[sel][order]
+        vn[d] = len(sel)
+
+    # frontier: shard inits by owner so each device starts with its own
+    bucket = max(min_bucket // D, _next_pow2(int(vn.max()) if D else 1), 32)
+    frontier = np.zeros((D, bucket, K), np.uint32)
+    fvalid = np.zeros((D, bucket), bool)
+    for d in range(D):
+        sel = np.nonzero(owner0 == d)[0]
+        frontier[d, : len(sel)] = init_packed[sel]
+        fvalid[d, : len(sel)] = True
+
+    shard1 = NamedSharding(mesh, P("d"))
+    dev_frontier = jax.device_put(frontier.reshape(D * bucket, K), shard1)
+    dev_fvalid = jax.device_put(fvalid.reshape(D * bucket), shard1)
+    dev_vhi = jax.device_put(vhi, shard1)
+    dev_vlo = jax.device_put(vlo, shard1)
+    dev_vn = jax.device_put(vn, shard1)
+
+    levels = [n0]
+    total = n0
+    depth = 0
+    violation = None
+    steps = {}
+
+    while True:
+        if max_depth is not None and depth >= max_depth:
+            break
+        if max_states is not None and total >= max_states:
+            break
+        key = (bucket, vcap)
+        if key not in steps:
+            steps[key] = _make_sharded_step(model, mesh, bucket, vcap)
+        step = steps[key]
+        (
+            out,
+            out_parent,
+            out_act,
+            new_n,
+            dev_vhi,
+            dev_vlo,
+            dev_vn,
+            viol_any,
+            viol_idx,
+            dl_any,
+            dl_idx,
+        ) = step(dev_frontier, dev_fvalid, dev_vhi, dev_vlo, dev_vn)
+        if check_deadlock and np.asarray(dl_any).any():
+            d = int(np.argmax(np.asarray(dl_any)))
+            b_per = dev_frontier.shape[0] // D
+            i = d * b_per + int(np.asarray(dl_idx)[d])
+            row = np.asarray(dev_frontier[i : i + 1])[0]
+            st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
+            violation = Violation(
+                invariant="Deadlock",
+                depth=depth,
+                state=model.decode(st) if model.decode else st,
+                trace=[],
+            )
+            break
+        counts = np.asarray(new_n)
+        n_new = int(counts.sum())
+        depth += 1
+        if n_new:
+            levels.append(n_new)
+            total += n_new
+        if progress:
+            progress(depth, n_new, total)
+
+        viol_any_np = np.asarray(viol_any)  # [D, n_inv]
+        if viol_any_np.any():
+            # first violated invariant (TLC reports one); then its first shard
+            inv_i = int(np.argmax(viol_any_np.any(axis=0)))
+            d = int(np.argmax(viol_any_np[:, inv_i]))
+            M_per = out.shape[0] // D
+            idx = d * M_per + int(np.asarray(viol_idx)[d, inv_i])
+            row = np.asarray(out[idx : idx + 1])[0]
+            st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
+            violation = Violation(
+                invariant=model.invariants[inv_i].name,
+                depth=depth,
+                state=model.decode(st) if model.decode else st,
+                trace=[],
+            )
+            break
+        if n_new == 0:
+            break
+
+        # next frontier: each shard keeps its own new states, padded to a
+        # common bucket
+        M_per = out.shape[0] // D
+        new_bucket = _next_pow2(max(int(counts.max()), 32))
+        out3 = out.reshape(D, M_per, K)
+        dev_frontier = out3[:, :new_bucket, :].reshape(D * new_bucket, K)
+        dev_fvalid = (
+            jnp.arange(new_bucket)[None, :] < jnp.asarray(counts)[:, None]
+        ).reshape(D * new_bucket)
+        dev_frontier = jax.device_put(dev_frontier, shard1)
+        dev_fvalid = jax.device_put(dev_fvalid, shard1)
+        bucket = new_bucket
+        # grow visited capacity if the worst-case next merge could overflow
+        need = int(np.asarray(dev_vn).max()) + D * new_bucket * C
+        if need > vcap:
+            vcap = _next_pow2(need)
+            pad = jnp.full((D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32)
+            dev_vhi = jax.device_put(jnp.concatenate([dev_vhi, pad], axis=1), shard1)
+            dev_vlo = jax.device_put(jnp.concatenate([dev_vlo, pad], axis=1), shard1)
+
+    dt = time.perf_counter() - t0
+    return CheckResult(
+        model=model.name,
+        levels=levels,
+        total=total,
+        diameter=len(levels) - 1,
+        violation=violation,
+        seconds=dt,
+        states_per_sec=total / max(dt, 1e-9),
+        stats={"devices": D, "visited_capacity_per_shard": int(vcap), "fanout": C},
+    )

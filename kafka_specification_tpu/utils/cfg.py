@@ -1,0 +1,171 @@
+"""TLC-compatible .cfg parsing and model instantiation.
+
+The reference corpus shipped no TLC configs (`*.toolbox` is gitignored,
+/root/reference/.gitignore:1), so this framework authors its own (configs/)
+in stock TLC .cfg syntax — the north-star requirement is that existing .cfg
+files drive the TPU engine unchanged (BASELINE.json "north_star").
+
+Supported subset (what TLC configs for this corpus need):
+  CONSTANT / CONSTANTS   name = value   (ints, model-value sets {a, b, c})
+  INVARIANT / INVARIANTS name...
+  CONSTRAINT name                        (AsyncIsr's bound; see below)
+  SPECIFICATION / INIT / NEXT            (parsed, informational — each module
+                                          has exactly one Spec shape)
+  CHECK_DEADLOCK TRUE|FALSE              (default FALSE: the bounded models
+                                          deadlock by design, SURVEY.md §2.4)
+  \\* and (* ... *) comments
+
+Replica sets are given as model-value sets ({r1, r2, r3}); the engine maps
+them to indices 0..N-1.  AsyncIsr's CONSTRAINT references bounds that TLC
+would read from the constraint's definition in a .tla override; here the
+bounds come from the MaxVersion constant (an authored extension, documented
+in configs/AsyncIsr.cfg).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class TlcConfig:
+    constants: dict = field(default_factory=dict)  # name -> int | list[str]
+    invariants: list = field(default_factory=list)
+    constraints: list = field(default_factory=list)
+    specification: str | None = None
+    check_deadlock: bool = False
+
+
+_SECTIONS = {
+    "CONSTANT": "constants",
+    "CONSTANTS": "constants",
+    "INVARIANT": "invariants",
+    "INVARIANTS": "invariants",
+    "CONSTRAINT": "constraints",
+    "CONSTRAINTS": "constraints",
+    "SPECIFICATION": "specification",
+    "INIT": "init",
+    "NEXT": "next",
+    "CHECK_DEADLOCK": "check_deadlock",
+    "SYMMETRY": "symmetry",
+}
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"\(\*.*?\*\)", " ", text, flags=re.S)
+    return "\n".join(line.split("\\*")[0] for line in text.splitlines())
+
+
+def parse_cfg(path_or_text) -> TlcConfig:
+    if isinstance(path_or_text, Path):
+        text = path_or_text.read_text()
+    elif "\n" not in str(path_or_text) and Path(str(path_or_text)).exists():
+        text = Path(str(path_or_text)).read_text()
+    else:
+        text = str(path_or_text)
+    cfg = TlcConfig()
+    section = None
+    for raw in _strip_comments(text).splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        head = line.split()[0].upper()
+        if head in _SECTIONS:
+            section = _SECTIONS[head]
+            rest = line[len(line.split()[0]) :].strip()
+            if not rest:
+                continue
+            line = rest
+        if section == "constants":
+            m = re.match(r"(\w+)\s*(?:=|<-)\s*(.+)", line)
+            if not m:
+                raise ValueError(f"cannot parse constant assignment: {line!r}")
+            name, val = m.group(1), m.group(2).strip()
+            if val.startswith("{"):
+                cfg.constants[name] = [
+                    v.strip() for v in val.strip("{} ").split(",") if v.strip()
+                ]
+            elif re.fullmatch(r"-?\d+", val):
+                cfg.constants[name] = int(val)
+            else:
+                cfg.constants[name] = val  # model value (e.g. Leader = r1)
+        elif section == "invariants":
+            cfg.invariants.extend(line.split())
+        elif section == "constraints":
+            cfg.constraints.extend(line.split())
+        elif section == "specification":
+            cfg.specification = line.split()[0]
+        elif section == "check_deadlock":
+            cfg.check_deadlock = line.strip().upper() == "TRUE"
+        # INIT/NEXT/SYMMETRY: parsed and ignored (corpus uses SPECIFICATION)
+    return cfg
+
+
+# --------------------------------------------------------------------------
+# module registry: .cfg + module name -> Model / OracleModel factories
+# --------------------------------------------------------------------------
+
+KAFKA_VARIANTS = ("KafkaTruncateToHighWatermark", "Kip101", "Kip279")
+
+
+def _setlen(v) -> int:
+    return len(v) if isinstance(v, list) else int(v)
+
+
+def build_model(module: str, cfg: TlcConfig, oracle: bool = False):
+    """Instantiate the tensor model (or its oracle twin) for a TLA+ module
+    name under a parsed TLC config.
+
+    CONSTRAINT is only meaningful for AsyncIsr in this corpus (its bound is
+    driven by the MaxOffset/MaxVersion constants); naming one for any other
+    module is rejected rather than silently ignored."""
+    if cfg.constraints and module != "AsyncIsr":
+        raise ValueError(
+            f"CONSTRAINT {cfg.constraints} is not supported for module "
+            f"{module!r} (only AsyncIsr's bound is defined in this corpus)"
+        )
+    c = cfg.constants
+    if module == "IdSequence":
+        from ..models import id_sequence as m
+
+        return (m.make_oracle if oracle else m.make_model)(int(c["MaxId"]))
+    if module == "FiniteReplicatedLog":
+        from ..models import finite_replicated_log as m
+
+        return (m.make_oracle if oracle else m.make_model)(
+            _setlen(c["Replicas"]), int(c["LogSize"]), _setlen(c["LogRecords"])
+        )
+    if module in KAFKA_VARIANTS or module in ("Kip320", "Kip320FirstTry"):
+        from ..models.kafka_replication import Config
+
+        kcfg = Config(
+            n_replicas=_setlen(c["Replicas"]),
+            log_size=int(c["LogSize"]),
+            max_records=int(c["MaxRecords"]),
+            max_leader_epoch=int(c["MaxLeaderEpoch"]),
+        )
+        invs = tuple(cfg.invariants) or ("TypeOk",)
+        if module in KAFKA_VARIANTS:
+            from ..models import variants as m
+
+            return (m.make_oracle if oracle else m.make_model)(module, kcfg, invs)
+        from ..models import kip320 as m
+
+        if module == "Kip320":
+            return (m.make_oracle if oracle else m.make_model)(kcfg, invs)
+        return (m.make_first_try_oracle if oracle else m.make_first_try_model)(
+            kcfg, invs
+        )
+    if module == "AsyncIsr":
+        from ..models import async_isr as m
+
+        acfg = m.AsyncIsrConfig(
+            n_replicas=_setlen(c["Replicas"]),
+            max_offset=int(c["MaxOffset"]),
+            max_version=int(c.get("MaxVersion", c["MaxOffset"])),
+        )
+        invs = tuple(cfg.invariants) or ("TypeOk", "ValidHighWatermark")
+        return (m.make_oracle if oracle else m.make_model)(acfg, invs)
+    raise KeyError(f"unknown module {module!r}")

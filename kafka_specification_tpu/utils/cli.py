@@ -1,0 +1,164 @@
+"""CLI: the TLC-equivalent front door.
+
+    python -m kafka_specification_tpu.utils.cli check configs/Kip320.cfg
+    python -m kafka_specification_tpu.utils.cli check configs/AsyncIsr.cfg \\
+        --sharded --progress
+    python -m kafka_specification_tpu.utils.cli oracle configs/Kip101.cfg
+
+`check` runs the TPU/JAX engine (single-device by default, --sharded for the
+mesh engine); `oracle` runs the pure-Python reference interpreter on the same
+config (the golden cross-check).  The module name defaults to the .cfg file
+stem, mirroring how TLC pairs Model.cfg with Model.tla.
+
+Output mirrors TLC's closing summary: distinct states, diameter, and on
+violation the invariant name plus a numbered counterexample trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .cfg import build_model, parse_cfg
+
+
+def _print_result(res, as_json: bool):
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "model": res.model,
+                    "distinct_states": res.total,
+                    "diameter": res.diameter,
+                    "levels": res.levels,
+                    "states_per_sec": round(res.states_per_sec, 1),
+                    "seconds": round(res.seconds, 3),
+                    "violation": (
+                        {
+                            "invariant": res.violation.invariant,
+                            "depth": res.violation.depth,
+                        }
+                        if res.violation
+                        else None
+                    ),
+                }
+            )
+        )
+        return
+    print(f"Model: {res.model}")
+    print(
+        f"{res.total} distinct states found, diameter {res.diameter}, "
+        f"{res.seconds:.2f}s ({res.states_per_sec:,.0f} states/sec)"
+    )
+    if res.violation is None:
+        print("No invariant violations. Exhaustive check complete.")
+    else:
+        v = res.violation
+        print(f"Invariant {v.invariant} is VIOLATED at depth {v.depth}.")
+        if v.trace:
+            print("Counterexample trace:")
+            for i, (action, state) in enumerate(v.trace):
+                print(f"  {i}. [{action}] {state}")
+        else:
+            print(f"Violating state: {v.state}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="kafka_specification_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pc = sub.add_parser("check", help="run the TPU/JAX engine on a TLC .cfg")
+    pc.add_argument("cfg")
+    pc.add_argument("--module", help="TLA+ module (default: cfg file stem)")
+    pc.add_argument("--sharded", action="store_true", help="mesh-sharded engine")
+    pc.add_argument("--max-depth", type=int)
+    pc.add_argument("--max-states", type=int)
+    pc.add_argument("--no-trace", action="store_true", help="skip trace storage")
+    pc.add_argument("--min-bucket", type=int, default=256)
+    pc.add_argument("--progress", action="store_true")
+    pc.add_argument("--json", action="store_true")
+    pc.add_argument(
+        "--checkpoint", help="directory for level-synchronous checkpoint/resume"
+    )
+    pc.add_argument("--cpu", action="store_true", help="force the CPU platform")
+
+    po = sub.add_parser("oracle", help="run the Python reference interpreter")
+    po.add_argument("cfg")
+    po.add_argument("--module")
+    po.add_argument("--max-depth", type=int)
+    po.add_argument("--max-states", type=int)
+
+    args = p.parse_args(argv)
+    from pathlib import Path
+
+    module = args.module or Path(args.cfg).stem
+    tlc_cfg = parse_cfg(args.cfg)
+
+    if args.cmd == "oracle":
+        from ..oracle.interp import oracle_bfs
+
+        om = build_model(module, tlc_cfg, oracle=True)
+        t0 = time.perf_counter()
+        r = oracle_bfs(
+            om,
+            max_depth=args.max_depth,
+            max_states=args.max_states,
+            keep_level_sets=False,
+            check_deadlock=tlc_cfg.check_deadlock,
+        )
+        dt = time.perf_counter() - t0
+        print(
+            f"Oracle: {r.total} distinct states, diameter {r.diameter}, "
+            f"{dt:.2f}s ({r.total / max(dt, 1e-9):,.0f} states/sec)"
+        )
+        if r.violation:
+            name, depth, _ = r.violation
+            print(f"Invariant {name} is VIOLATED at depth {depth}.")
+            for i, (action, state) in enumerate(r.trace):
+                print(f"  {i}. [{action}] {state}")
+        else:
+            print("No invariant violations. Exhaustive check complete.")
+        return 0 if r.violation is None else 1
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    model = build_model(module, tlc_cfg)
+    progress = None
+    if args.progress:
+        def progress(depth, new_n, total):
+            print(f"  level {depth}: {new_n} new, {total} total", file=sys.stderr)
+
+    if args.sharded:
+        from ..parallel.sharded import check_sharded
+
+        res = check_sharded(
+            model,
+            max_depth=args.max_depth,
+            max_states=args.max_states,
+            min_bucket=args.min_bucket,
+            progress=progress,
+            check_deadlock=tlc_cfg.check_deadlock,
+        )
+    else:
+        from ..engine.bfs import check
+
+        res = check(
+            model,
+            max_depth=args.max_depth,
+            max_states=args.max_states,
+            store_trace=not args.no_trace,
+            min_bucket=args.min_bucket,
+            progress=progress,
+            checkpoint_dir=args.checkpoint,
+            check_deadlock=tlc_cfg.check_deadlock,
+        )
+    _print_result(res, args.json)
+    return 0 if res.violation is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 from kafka_specification_tpu.engine.bfs import check
@@ -21,11 +22,13 @@ def enumerate_states(model, max_depth=None, min_bucket=32):
         collect_levels=collected,
     )
     levels = []
+    unpack = jax.jit(jax.vmap(spec.unpack))
     for packed in collected:
+        batch = {k: np.asarray(v) for k, v in unpack(packed).items()}
         states = set()
-        for row in packed:
-            s = {k: np.asarray(v) for k, v in spec.unpack(row).items()}
-            states.add(model.decode(s))
+        for i in range(packed.shape[0]):
+            row = {k: v[i] for k, v in batch.items()}
+            states.add(model.decode(row))
         levels.append(states)
     return res, levels
 

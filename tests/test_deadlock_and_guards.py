@@ -1,0 +1,61 @@
+"""Regression tests for review findings: deadlock checking, checkpoint
+identity, sharded init-state invariants, cfg parse edge cases."""
+
+import pytest
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import finite_replicated_log as frl
+from kafka_specification_tpu.models import id_sequence, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.oracle.interp import oracle_bfs
+from kafka_specification_tpu.parallel.sharded import check_sharded
+from kafka_specification_tpu.utils.cfg import parse_cfg, build_model
+
+
+def test_deadlock_detected_when_enabled():
+    """IdSequence deadlocks at nextId = MaxId + 1 (no action enabled);
+    engine and oracle agree on the Deadlock pseudo-invariant and depth."""
+    model = id_sequence.make_model(3)
+    res = check(model, check_deadlock=True, min_bucket=32)
+    assert res.violation is not None
+    assert res.violation.invariant == "Deadlock"
+    assert res.violation.depth == 4
+    assert res.violation.state == 4
+    # the trace walks back to init
+    assert [s for _, s in res.violation.trace] == [0, 1, 2, 3, 4]
+
+    ores = oracle_bfs(id_sequence.make_oracle(3), check_deadlock=True)
+    assert ores.violation[0] == "Deadlock"
+    assert ores.violation[1] == 4
+
+
+def test_deadlock_off_by_default():
+    res = check(id_sequence.make_model(3), min_bucket=32)
+    assert res.ok
+
+
+def test_sharded_checks_init_invariants():
+    m = variants.make_model(
+        "Kip101", Config(2, 2, 1, 1), ("LeaderInIsrLiteral",)
+    )
+    res = check_sharded(m, min_bucket=64)
+    assert res.violation is not None
+    assert res.violation.depth == 0  # literal LeaderInIsr is False at Init
+
+
+def test_checkpoint_rejects_other_model(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    check(frl.make_model(2, 2, 2), max_depth=2, min_bucket=32, checkpoint_dir=ckdir)
+    with pytest.raises(ValueError, match="different"):
+        check(frl.make_model(2, 3, 2), min_bucket=32, checkpoint_dir=ckdir)
+
+
+def test_parse_cfg_single_line_text():
+    cfg = parse_cfg("CHECK_DEADLOCK TRUE")
+    assert cfg.check_deadlock is True
+
+
+def test_constraint_rejected_for_non_asyncisr():
+    cfg = parse_cfg("CONSTANTS\n MaxId = 3\nCONSTRAINT Bound\n")
+    with pytest.raises(ValueError, match="CONSTRAINT"):
+        build_model("IdSequence", cfg)

@@ -1,0 +1,75 @@
+"""Kip320 (flagship) and Kip320FirstTry known-answer + oracle cross-checks.
+
+The four THEOREMs at Kip320.tla:168-171 are the corpus's headline claims:
+Kip320 passes TypeOk/LeaderInIsr/WeakIsr/StrongIsr exhaustively.  The
+rejected Kip320FirstTry design must fail (documented failure sketch at
+Kip320FirstTry.tla:27-39: fast leader elections + an HW bump acknowledged by
+a follower on an older epoch).  LeaderInIsr is checked in its guarded reading;
+the literal reading is False at Init (leader = None) — pinned separately.
+"""
+
+import pytest
+
+from kafka_specification_tpu.engine import check
+from kafka_specification_tpu.models import kip320
+from kafka_specification_tpu.models.kafka_replication import Config
+
+from helpers import assert_matches_oracle
+
+TINY = Config(2, 2, 1, 1)
+SMALL = Config(2, 2, 2, 2)
+THREE = Config(3, 2, 2, 2)
+ALL_INVS = ("TypeOk", "LeaderInIsr", "WeakIsr", "StrongIsr")
+
+
+def test_kip320_tiny_exact_match():
+    res, _ = assert_matches_oracle(
+        kip320.make_model(TINY, ALL_INVS), kip320.make_oracle(TINY, ALL_INVS)
+    )
+    assert res.ok
+    assert res.total == 277
+
+
+def test_kip320_first_try_tiny_exact_match():
+    res, _ = assert_matches_oracle(
+        kip320.make_first_try_model(TINY, ALL_INVS),
+        kip320.make_first_try_oracle(TINY, ALL_INVS),
+    )
+    assert res.ok
+    assert res.total == 337
+
+
+def test_kip320_small_exhaustive_pass():
+    """All four invariants hold on the full 5973-state space (oracle-pinned)."""
+    res, _ = assert_matches_oracle(
+        kip320.make_model(SMALL, ALL_INVS), kip320.make_oracle(SMALL, ALL_INVS)
+    )
+    assert res.ok
+    assert res.total == 5973
+    assert res.diameter == 17
+
+
+@pytest.mark.slow
+def test_kip320_first_try_violation_at_three_replicas():
+    """The rejected design fails at 3 replicas (needs two non-leader
+    followers); depth and count pinned by an oracle run."""
+    m = kip320.make_first_try_model(THREE, ALL_INVS)
+    res = check(m, min_bucket=1024)
+    assert res.violation is not None
+    assert res.violation.invariant == "WeakIsr"
+    assert res.violation.depth == 11
+    assert res.total == 184141
+    # counterexample replays the documented failure shape: elections then an
+    # HW bump then truncation — last step must be a state change on a path
+    # of depth+1 states
+    assert len(res.violation.trace) == 12
+
+
+def test_leader_in_isr_literal_fails_at_init():
+    """The literal LeaderInIsr (Kip320.tla:169 / KafkaReplication.tla:345) is
+    False at Init where quorum leader = None — a latent spec quirk the
+    checker reproduces faithfully."""
+    m = kip320.make_model(TINY, ("LeaderInIsrLiteral",))
+    res = check(m)
+    assert res.violation is not None
+    assert res.violation.depth == 0

@@ -1,0 +1,82 @@
+"""KafkaReplication historical-variant checks against the oracle.
+
+The known-bad/known-good variant matrix is the reference corpus's de-facto
+regression oracle (SURVEY.md §4): TruncateToHW must violate WeakIsr
+(KafkaTruncateToHighWatermark.tla:23-27), Kip101 must fail under consecutive
+fast leader changes — needing MaxLeaderEpoch >= 2 (Kip279.tla:21-23), and
+Kip279's truncation is sound at the minimal config.  Exact distinct-state
+counts/diameters here are pinned by the Python oracle interpreter (stock TLC
+is unavailable in this environment; the oracle is the golden source).
+"""
+
+import pytest
+
+from kafka_specification_tpu.models import variants
+from kafka_specification_tpu.models.kafka_replication import Config
+
+from helpers import assert_matches_oracle
+
+TINY = Config(n_replicas=2, log_size=2, max_records=1, max_leader_epoch=1)
+SMALL = Config(n_replicas=2, log_size=2, max_records=2, max_leader_epoch=2)
+
+
+@pytest.mark.parametrize(
+    "variant", ["KafkaTruncateToHighWatermark", "Kip101", "Kip279"]
+)
+def test_variant_full_state_space_matches_oracle(variant):
+    """Exact per-level state-set equality on the full reachable space
+    (invariant TypeOk only, which never fires)."""
+    m = variants.make_model(variant, TINY, invariants=("TypeOk",))
+    o = variants.make_oracle(variant, TINY, invariants=("TypeOk",))
+    res, _ = assert_matches_oracle(m, o)
+    assert res.ok
+    # golden totals pinned by the oracle
+    assert res.total == (353 if variant == "KafkaTruncateToHighWatermark" else 341)
+    assert res.diameter == 11
+
+
+def test_truncate_to_hw_violates_weak_isr():
+    """Pre-KIP-101 behavior loses committed data
+    (KafkaTruncateToHighWatermark.tla:23-27): WeakIsr violated even at the
+    minimal config; engine and oracle agree on the violation depth."""
+    invs = ("TypeOk", "WeakIsr")
+    m = variants.make_model("KafkaTruncateToHighWatermark", TINY, invariants=invs)
+    o = variants.make_oracle("KafkaTruncateToHighWatermark", TINY, invariants=invs)
+    res, _ = assert_matches_oracle(m, o)
+    assert res.violation is not None
+    assert res.violation.invariant == "WeakIsr"
+    assert res.violation.depth == 8
+    # the reconstructed trace is a full path from the init state
+    assert len(res.violation.trace) == 9
+    assert res.violation.trace[0][0] == "<init>"
+
+
+def test_kip101_fails_under_fast_leader_changes():
+    """Kip101 holds at MaxLeaderEpoch=1 but fails WeakIsr at 2 — the
+    'consecutive fast leader changes' hole that motivated KIP-279
+    (Kip279.tla:21-23)."""
+    invs = ("TypeOk", "WeakIsr")
+    m1 = variants.make_model("Kip101", TINY, invariants=invs)
+    o1 = variants.make_oracle("Kip101", TINY, invariants=invs)
+    res1, _ = assert_matches_oracle(m1, o1)
+    assert res1.ok
+
+    m2 = variants.make_model("Kip101", SMALL, invariants=invs)
+    o2 = variants.make_oracle("Kip101", SMALL, invariants=invs)
+    res2, _ = assert_matches_oracle(m2, o2)
+    assert res2.violation is not None
+    assert res2.violation.invariant == "WeakIsr"
+    assert res2.violation.depth == 11
+
+
+def test_kip279_truncation_sound_at_small_config():
+    """Kip279's tail-matching truncation fixes the Kip101 hole: the same
+    config that breaks Kip101 passes WeakIsr and StrongIsr under Kip279
+    (the remaining Kip279 hole needs 3 replicas — covered in slow tests)."""
+    invs = ("TypeOk", "WeakIsr", "StrongIsr")
+    m = variants.make_model("Kip279", SMALL, invariants=invs)
+    o = variants.make_oracle("Kip279", SMALL, invariants=invs)
+    res, _ = assert_matches_oracle(m, o)
+    assert res.ok
+    assert res.total == 9027
+    assert res.diameter == 17
