@@ -25,6 +25,7 @@ is run with TLC's deadlock check disabled for the same reason).
 
 from __future__ import annotations
 
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -102,22 +103,41 @@ class _Step:
             jnp.concatenate(packed_parts, axis=0),
         )
 
-    def get(self, bucket: int, vcap: int, with_invariants: bool = True):
-        key = (bucket, vcap, with_invariants)
+    def get(
+        self,
+        bucket: int,
+        vcap: int,
+        with_invariants: bool = True,
+        with_merge: bool = True,
+    ):
+        key = (bucket, vcap, with_invariants, with_merge)
         if key not in self._cache:
-            self._cache[key] = jax.jit(self.build_raw(bucket, vcap, with_invariants))
+            self._cache[key] = jax.jit(
+                self.build_raw(bucket, vcap, with_invariants, with_merge)
+            )
         return self._cache[key]
 
-    def build_raw(self, bucket: int, vcap: int, with_invariants: bool = True):
+    def build_raw(
+        self,
+        bucket: int,
+        vcap: int,
+        with_invariants: bool = True,
+        with_merge: bool = True,
+    ):
         """The un-jitted level step (frontier, fvalid, vhi, vlo, vn) -> ...;
-        exposed for the driver's compile checks and custom jit wrapping."""
-        return self._build(bucket, vcap, with_invariants)
+        exposed for the driver's compile checks and custom jit wrapping.
+        with_merge=False skips the visited-set merge (host FpSet backend)."""
+        return self._build(bucket, vcap, with_invariants, with_merge)
 
-    def _build(self, bucket: int, vcap: int, with_invariants: bool):
+    def _build(self, bucket: int, vcap: int, with_invariants: bool, with_merge: bool = True):
         spec, model = self.spec, self.model
         C, K = self.C, self.K
         M = bucket * C
         act_ids = self.act_ids
+
+        # action boundaries for the enablement histogram (TLC's action
+        # coverage analogue, SURVEY.md §5 "Metrics")
+        bounds = np.cumsum([0] + [a.n_choices for a in model.actions])
 
         def step(frontier, fvalid, vhi, vlo, vn):
             states = jax.vmap(spec.unpack)(frontier)
@@ -126,6 +146,12 @@ class _Step:
             dl_any = jnp.any(deadlocked)
             dl_idx = jnp.argmax(deadlocked)
             en = en & fvalid[:, None]
+            act_en = jnp.stack(
+                [
+                    jnp.sum(en[:, bounds[i] : bounds[i + 1]], dtype=jnp.int32)
+                    for i in range(len(model.actions))
+                ]
+            )
             cand = packed.reshape(M, K)
             valid = en.reshape(M)
             parent = jnp.repeat(jnp.arange(bucket, dtype=jnp.int32), C)
@@ -147,9 +173,16 @@ class _Step:
             out = jnp.zeros((M, K), jnp.uint32).at[pos].set(cand)
             out_parent = jnp.full((M,), -1, jnp.int32).at[pos].set(parent)
             out_act = jnp.full((M,), -1, jnp.int32).at[pos].set(act)
+            out_hi = jnp.zeros((M,), jnp.uint32).at[pos].set(hi)
+            out_lo = jnp.zeros((M,), jnp.uint32).at[pos].set(lo)
             new_n = jnp.sum(is_new, dtype=jnp.int32)
 
-            vhi2, vlo2, vn2 = dedup.merge_into_sorted(vhi, vlo, vn, hi, lo, is_new, vcap)
+            if with_merge:
+                vhi2, vlo2, vn2 = dedup.merge_into_sorted(
+                    vhi, vlo, vn, hi, lo, is_new, vcap
+                )
+            else:
+                vhi2, vlo2, vn2 = vhi, vlo, vn
 
             # invariants on the newly discovered states only
             viol_any, viol_idx = [], []
@@ -176,6 +209,9 @@ class _Step:
                 jnp.stack(viol_idx),
                 dl_any,
                 dl_idx,
+                act_en,
+                out_hi,
+                out_lo,
             )
 
         return step
@@ -199,6 +235,8 @@ def check(
     collect_levels: Optional[list] = None,
     checkpoint_dir: Optional[str] = None,
     check_deadlock: bool = False,
+    stats_path: Optional[str] = None,
+    visited_backend: str = "device",
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -206,6 +244,19 @@ def check(
     with no enabled action is reported as a violation of the pseudo-invariant
     "Deadlock" (CONSTRAINT pruning does not mask enabledness).  Default off:
     the bounded corpus models deadlock by design (SURVEY.md §2.4).
+
+    stats_path: append one JSON line per BFS level (depth, frontier size,
+    enabled candidates, new/dup counts, per-action enablement histogram,
+    wall ms) — the PROGRESS.jsonl observability stream (SURVEY.md §5); the
+    same records land in CheckResult.stats["levels"].
+
+    visited_backend: "device" keeps the sorted fingerprint set in HBM (fast
+    path); "host" streams each level's batch-deduped fingerprints through the
+    native C++ open-addressing FpSet (native/fpset.cpp) — the TLC-FPSet
+    spill mode for state spaces whose fingerprints outgrow device memory.
+    Device HBM then holds only O(frontier x fanout) transient data.  With
+    hashed (non-exact64) fingerprints this accepts TLC's usual 64-bit
+    collision risk.
 
     checkpoint_dir: when set, the (visited set, frontier, level counters) are
     persisted after every BFS level and a run restarts from the last saved
@@ -234,16 +285,35 @@ def check(
     init_packed = np.unique(init_packed, axis=0)
     n0 = init_packed.shape[0]
 
+    if visited_backend not in ("device", "host"):
+        raise ValueError(f"visited_backend must be 'device' or 'host', got {visited_backend!r}")
+    host_set = None
+
+    def _u64(hi, lo):
+        return (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+            lo
+        ).astype(np.uint64)
+
     t0 = time.perf_counter()
     hi0, lo0 = fingerprint_lanes(jnp.asarray(init_packed), spec.exact64)
-    order = np.lexsort((np.asarray(lo0), np.asarray(hi0)))
-    vcap = _next_pow2(max(n0, min_bucket * C, 2))
-    vhi = np.full(vcap, 0xFFFFFFFF, np.uint32)
-    vlo = np.full(vcap, 0xFFFFFFFF, np.uint32)
-    vhi[:n0] = np.asarray(hi0)[order]
-    vlo[:n0] = np.asarray(lo0)[order]
-    vhi, vlo = jnp.asarray(vhi), jnp.asarray(vlo)
-    vn = jnp.int32(n0)
+    if visited_backend == "host":
+        from ..native import FpSet
+
+        host_set = FpSet()
+        host_set.insert(_u64(hi0, lo0))
+        vcap = 64  # placeholder shapes; the device never holds the visited set
+        vhi = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
+        vlo = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
+        vn = jnp.int32(0)
+    else:
+        order = np.lexsort((np.asarray(lo0), np.asarray(hi0)))
+        vcap = _next_pow2(max(n0, min_bucket * C, 2))
+        vhi = np.full(vcap, 0xFFFFFFFF, np.uint32)
+        vlo = np.full(vcap, 0xFFFFFFFF, np.uint32)
+        vhi[:n0] = np.asarray(hi0)[order]
+        vlo[:n0] = np.asarray(lo0)[order]
+        vhi, vlo = jnp.asarray(vhi), jnp.asarray(vlo)
+        vn = jnp.int32(n0)
 
     levels = [n0]
     total = n0
@@ -293,10 +363,13 @@ def check(
     frontier_np = init_packed
     depth = 0
     violation = None
+    result_stats: dict = {}
+    collect_stats = stats_path is not None
 
     # identity stamp: a checkpoint may only resume the same model+constants
-    ckpt_ident = f"{model.name}|lanes={spec.num_lanes}|" + ",".join(
-        f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields
+    ckpt_ident = (
+        f"{model.name}|lanes={spec.num_lanes}|backend={visited_backend}|"
+        + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
     )
     if ckpt_path is not None:
         import os
@@ -310,26 +383,35 @@ def check(
                     f"model/config:\n  checkpoint: {found}\n  this run:   {ckpt_ident}"
                 )
             frontier_np = snap["frontier"]
-            vcap = int(snap["vcap"])
-            vhi = jnp.asarray(snap["vhi"])
-            vlo = jnp.asarray(snap["vlo"])
-            vn = jnp.int32(int(snap["vn"]))
+            if host_set is not None:
+                from ..native import FpSet
+
+                host_set = FpSet(initial_capacity=max(64, 2 * len(snap["host_fps"])))
+                host_set.insert(snap["host_fps"])
+            else:
+                vcap = int(snap["vcap"])
+                vhi = jnp.asarray(snap["vhi"])
+                vlo = jnp.asarray(snap["vlo"])
+                vn = jnp.int32(int(snap["vn"]))
             levels = snap["levels"].tolist()
             total = int(snap["total"])
             depth = int(snap["depth"])
 
     def _save_checkpoint():
+        extra = (
+            {"host_fps": host_set.dump()}
+            if host_set is not None
+            else {"vhi": np.asarray(vhi), "vlo": np.asarray(vlo), "vn": int(vn)}
+        )
         np.savez_compressed(
             ckpt_path + ".tmp.npz",
             ident=ckpt_ident,
             frontier=frontier_np,
-            vhi=np.asarray(vhi),
-            vlo=np.asarray(vlo),
-            vn=int(vn),
             vcap=vcap,
             levels=np.asarray(levels),
             total=total,
             depth=depth,
+            **extra,
         )
         import os
 
@@ -343,18 +425,22 @@ def check(
         f = frontier_np.shape[0]
         bucket = _next_pow2(max(f, min_bucket))
         M = bucket * C
-        # ensure visited capacity can absorb worst-case M new states
-        need = int(vn) + M
-        if need > vcap:
-            new_cap = _next_pow2(need)
-            pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
-            vhi = jnp.concatenate([vhi, pad])
-            vlo = jnp.concatenate([vlo, pad])
-            vcap = new_cap
+        if host_set is None:
+            # ensure visited capacity can absorb worst-case M new states
+            need = int(vn) + M
+            if need > vcap:
+                new_cap = _next_pow2(need)
+                pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
+                vhi = jnp.concatenate([vhi, pad])
+                vlo = jnp.concatenate([vlo, pad])
+                vcap = new_cap
 
         frontier = jnp.asarray(_pad_rows(frontier_np, bucket))
         fvalid = jnp.arange(bucket) < f
-        step = step_builder.get(bucket, vcap, check_invariants)
+        step = step_builder.get(
+            bucket, vcap, check_invariants, with_merge=host_set is None
+        )
+        t_level = time.perf_counter()
         (
             out,
             out_parent,
@@ -367,6 +453,9 @@ def check(
             viol_idx,
             dl_any,
             dl_idx,
+            act_en,
+            out_hi,
+            out_lo,
         ) = step(frontier, fvalid, vhi, vlo, vn)
         if check_deadlock and bool(dl_any):
             i = int(dl_idx)
@@ -381,17 +470,53 @@ def check(
                 )
             break
         new_n = int(new_n)
+        host_mask = None
+        if host_set is not None and new_n:
+            # batch-unique candidates -> global dedup through the native
+            # FpSet (the step already compacted their fingerprints)
+            rows = np.asarray(out[:new_n])
+            host_mask = host_set.insert(
+                _u64(np.asarray(out_hi[:new_n]), np.asarray(out_lo[:new_n]))
+            )
+            next_frontier = rows[host_mask]
+            host_parent = np.asarray(out_parent[:new_n])[host_mask]
+            host_act = np.asarray(out_act[:new_n])[host_mask]
+            host_pos = np.cumsum(host_mask) - 1
+            new_n = int(host_mask.sum())
         depth += 1
         if new_n:
             levels.append(new_n)
             total += new_n
-        next_frontier = np.asarray(out[:new_n])
+        if collect_stats:
+            act_en_np = np.asarray(act_en)
+            enabled_total = int(act_en_np.sum())
+            rec = {
+                "depth": depth,
+                "frontier": f,
+                "enabled_candidates": enabled_total,
+                "new": new_n,
+                "duplicates": enabled_total - new_n,
+                "total": total,
+                "level_ms": round((time.perf_counter() - t_level) * 1e3, 1),
+                "action_enablement": {
+                    a.name: int(c)
+                    for a, c in zip(model.actions, act_en_np.tolist())
+                },
+            }
+            result_stats.setdefault("levels", []).append(rec)
+            if stats_path is not None:
+                with open(stats_path, "a") as fh:
+                    fh.write(json.dumps(rec) + "\n")
+        if host_mask is None:
+            next_frontier = np.asarray(out[:new_n])
+            level_parent = np.asarray(out_parent[:new_n])
+            level_act = np.asarray(out_act[:new_n])
+        else:
+            level_parent, level_act = host_parent, host_act
         if collect_levels is not None and new_n:
             collect_levels.append(next_frontier)
         if store_trace:
-            trace_store.append(
-                (next_frontier, np.asarray(out_parent[:new_n]), np.asarray(out_act[:new_n]))
-            )
+            trace_store.append((next_frontier, level_parent, level_act))
         if progress:
             progress(depth, new_n, total)
 
@@ -400,11 +525,26 @@ def check(
             if viol_any_np.any():
                 inv_i = int(np.argmax(viol_any_np))
                 idx = int(np.asarray(viol_idx)[inv_i])
+                inv_name = model.invariants[inv_i].name
+                if host_mask is not None:
+                    # idx is pre-filter; a violating state is necessarily
+                    # globally new (an old one would have fired when first
+                    # discovered), so it survives the host dedup filter
+                    raw = np.asarray(out[idx : idx + 1])[0]
+                    idx = int(host_pos[idx]) if host_mask[idx] else -1
+                    if idx < 0:
+                        violation = Violation(
+                            invariant=inv_name,
+                            depth=depth,
+                            state=decode_state(raw),
+                            trace=[],
+                        )
+                        break
                 if store_trace:
-                    violation = build_violation(model.invariants[inv_i].name, depth, idx)
+                    violation = build_violation(inv_name, depth, idx)
                 else:
                     violation = Violation(
-                        invariant=model.invariants[inv_i].name,
+                        invariant=inv_name,
                         depth=depth,
                         state=decode_state(next_frontier[idx]),
                         trace=[],
@@ -415,6 +555,16 @@ def check(
             _save_checkpoint()
 
     dt = time.perf_counter() - t0
+    result_stats.update(
+        {
+            "visited_capacity": int(vcap),
+            "fanout": C,
+            "lanes": K,
+            "visited_backend": visited_backend,
+        }
+    )
+    if host_set is not None:
+        result_stats["host_fpset_size"] = len(host_set)
     return CheckResult(
         model=model.name,
         levels=levels,
@@ -423,5 +573,5 @@ def check(
         violation=violation,
         seconds=dt,
         states_per_sec=total / max(dt, 1e-9),
-        stats={"visited_capacity": int(vcap), "fanout": C, "lanes": K},
+        stats=result_stats,
     )

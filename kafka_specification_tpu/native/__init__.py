@@ -1,0 +1,148 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+`FpSet` — host-side open-addressing 64-bit fingerprint set (fpset.cpp), the
+checker's spill/backstop dedup store (SURVEY.md §2.5): the device-resident
+sorted set (ops/dedup.py) is the fast path while fingerprints fit in HBM;
+this is the TLC-FPSet-equivalent for runs that outgrow it, and the backend
+of engine.check(..., visited_backend="host").
+
+The shared library is compiled on first use with g++ -O2 (cached next to the
+source); environments without a toolchain fall back to a numpy-based set
+with the same interface.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "fpset.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_fpset.so")
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)) or os.path.getmtime(_SO) < os.path.getmtime(
+                _SRC
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.fpset_create.restype = ctypes.c_void_p
+            lib.fpset_create.argtypes = [ctypes.c_uint64]
+            lib.fpset_destroy.argtypes = [ctypes.c_void_p]
+            lib.fpset_count.restype = ctypes.c_uint64
+            lib.fpset_count.argtypes = [ctypes.c_void_p]
+            lib.fpset_capacity.restype = ctypes.c_uint64
+            lib.fpset_capacity.argtypes = [ctypes.c_void_p]
+            lib.fpset_insert_batch.restype = ctypes.c_uint64
+            lib.fpset_insert_batch.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.fpset_contains_batch.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.fpset_dump.restype = ctypes.c_uint64
+            lib.fpset_dump.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64,
+            ]
+            _lib = lib
+        except Exception as e:  # no toolchain -> numpy fallback
+            _build_error = e
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class FpSet:
+    """64-bit fingerprint set. insert(fps) -> bool mask of novel entries."""
+
+    def __init__(self, initial_capacity: int = 1 << 16):
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.fpset_create(initial_capacity)
+            if not self._h:
+                raise MemoryError("fpset_create failed")
+        else:
+            self._py = set()
+
+    def insert(self, fps: np.ndarray) -> np.ndarray:
+        fps = np.ascontiguousarray(fps, dtype=np.uint64)
+        out = np.empty(fps.shape[0], dtype=np.uint8)
+        if self._lib is not None:
+            rc = self._lib.fpset_insert_batch(
+                self._h,
+                fps.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                fps.shape[0],
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+            if rc == np.iinfo(np.uint64).max:
+                raise MemoryError("fpset grow failed")
+        else:
+            for i, fp in enumerate(fps.tolist()):
+                new = fp not in self._py
+                if new:
+                    self._py.add(fp)
+                out[i] = new
+        return out.astype(bool)
+
+    def contains(self, fps: np.ndarray) -> np.ndarray:
+        fps = np.ascontiguousarray(fps, dtype=np.uint64)
+        out = np.empty(fps.shape[0], dtype=np.uint8)
+        if self._lib is not None:
+            self._lib.fpset_contains_batch(
+                self._h,
+                fps.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                fps.shape[0],
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+        else:
+            for i, fp in enumerate(fps.tolist()):
+                out[i] = fp in self._py
+        return out.astype(bool)
+
+    def __len__(self):
+        if self._lib is not None:
+            return int(self._lib.fpset_count(self._h))
+        return len(self._py)
+
+    def dump(self) -> np.ndarray:
+        if self._lib is None:
+            return np.fromiter(self._py, dtype=np.uint64, count=len(self._py))
+        n = len(self)
+        out = np.empty(n, dtype=np.uint64)
+        w = self._lib.fpset_dump(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n
+        )
+        return out[:w]
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.fpset_destroy(h)
+            self._h = None

@@ -294,9 +294,11 @@ def check_sharded(
             break
 
         # next frontier: each shard keeps its own new states, padded to a
-        # common bucket
+        # common bucket (clamped to the per-shard output width — counts can
+        # exceed half of it in explosive levels, and the slice below must
+        # yield exactly new_bucket columns)
         M_per = out.shape[0] // D
-        new_bucket = _next_pow2(max(int(counts.max()), 32))
+        new_bucket = min(_next_pow2(max(int(counts.max()), 32)), M_per)
         out3 = out.reshape(D, M_per, K)
         dev_frontier = out3[:, :new_bucket, :].reshape(D * new_bucket, K)
         dev_fvalid = (

@@ -82,6 +82,16 @@ def main(argv=None):
     pc.add_argument(
         "--checkpoint", help="directory for level-synchronous checkpoint/resume"
     )
+    pc.add_argument(
+        "--stats", help="append per-level JSONL stats (e.g. PROGRESS.jsonl)"
+    )
+    pc.add_argument(
+        "--visited-backend",
+        choices=["device", "host"],
+        default="device",
+        help="fingerprint set location: device HBM (fast) or the native "
+        "C++ host FpSet (spill mode for huge state spaces)",
+    )
     pc.add_argument("--cpu", action="store_true", help="force the CPU platform")
 
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
@@ -155,6 +165,8 @@ def main(argv=None):
             progress=progress,
             checkpoint_dir=args.checkpoint,
             check_deadlock=tlc_cfg.check_deadlock,
+            stats_path=args.stats,
+            visited_backend=args.visited_backend,
         )
     _print_result(res, args.json)
     return 0 if res.violation is None else 1

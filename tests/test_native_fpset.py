@@ -1,0 +1,88 @@
+"""Native C++ FpSet and the engine's host visited-set backend."""
+
+import numpy as np
+import pytest
+
+from kafka_specification_tpu.native import FpSet, native_available
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import finite_replicated_log as frl
+from kafka_specification_tpu.models import kip320, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+
+
+def test_native_compiles():
+    assert native_available(), "g++ toolchain expected in this image"
+
+
+def test_fpset_insert_contains_dump():
+    s = FpSet(initial_capacity=64)
+    rng = np.random.default_rng(7)
+    a = rng.integers(1, 2**63, size=10_000, dtype=np.uint64)
+    uniq = np.unique(a)
+    mask1 = s.insert(a)
+    # first occurrence of each value reports new
+    assert mask1.sum() == uniq.shape[0]
+    assert len(s) == uniq.shape[0]
+    mask2 = s.insert(a)
+    assert not mask2.any()
+    assert s.contains(a).all()
+    missing = rng.integers(2**63, 2**64 - 1, size=100, dtype=np.uint64)
+    present = s.contains(missing)
+    assert present.sum() == np.isin(missing, uniq).sum()
+    dumped = np.sort(s.dump())
+    np.testing.assert_array_equal(dumped, uniq)
+
+
+def test_fpset_growth_preserves_members():
+    s = FpSet(initial_capacity=64)
+    a = np.arange(1, 50_000, dtype=np.uint64)
+    s.insert(a)
+    assert len(s) == a.shape[0]
+    assert s.contains(a).all()
+
+
+def test_fpset_zero_is_distinct():
+    """Fingerprint value 0 is a real member (exact-mode fps ARE states) and
+    must be distinct from 1."""
+    s = FpSet()
+    m = s.insert(np.array([0, 1, 0], dtype=np.uint64))
+    assert m.tolist() == [True, True, False]
+    assert len(s) == 2
+    assert s.contains(np.array([0, 1, 2], dtype=np.uint64)).tolist() == [
+        True,
+        True,
+        False,
+    ]
+    assert sorted(s.dump().tolist()) == [0, 1]
+
+
+def test_host_backend_matches_device_counts():
+    model = frl.make_model(3, 4, 2)
+    res = check(model, min_bucket=64, visited_backend="host", store_trace=False)
+    assert res.ok
+    assert res.total == 29791  # = 31^3, same as device backend / oracle
+    assert res.stats["visited_backend"] == "host"
+    assert res.stats["host_fpset_size"] == 29791
+
+
+def test_host_backend_violation_with_trace():
+    m = variants.make_model(
+        "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("TypeOk", "WeakIsr")
+    )
+    res = check(m, min_bucket=32, visited_backend="host")
+    assert res.violation is not None
+    assert res.violation.invariant == "WeakIsr"
+    assert res.violation.depth == 8
+    assert len(res.violation.trace) == 9  # full parent-pointer path survives
+
+
+def test_host_backend_exact64_zero_fingerprint():
+    """Regression: exact-mode fingerprints are packed states, so u64 value 0
+    (e.g. IdSequence nextId=0) is a real state that must not be conflated
+    with value 1 (review finding: the old fp==0 remap truncated the search
+    to total=1)."""
+    from kafka_specification_tpu.models import id_sequence
+
+    res = check(id_sequence.make_model(5), min_bucket=32, visited_backend="host")
+    assert res.total == 7
+    assert res.diameter == 6
