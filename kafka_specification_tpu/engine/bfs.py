@@ -154,44 +154,46 @@ class _Step:
             )
             cand = packed.reshape(M, K)
             valid = en.reshape(M)
-            parent = jnp.repeat(jnp.arange(bucket, dtype=jnp.int32), C)
-            act = jnp.tile(act_ids, bucket)
 
             hi, lo = fingerprint_lanes(cand, spec.exact64)
             sent = jnp.uint32(dedup.SENT)
             hi = jnp.where(valid, hi, sent)
             lo = jnp.where(valid, lo, sent)
-            hi, lo, invalid, (cand, parent, act) = dedup.sort_pairs_with_payload(
-                hi, lo, ~valid, (cand, parent, act)
-            )
-            first = dedup.first_occurrence_mask(hi, lo, invalid)
-            seen = dedup.member_sorted(vhi, vlo, vn, hi, lo)
+            # minimal-payload sort: only the original index rides through the
+            # sort network; state rows/parents are gathered once afterwards
+            order = jnp.lexsort((lo, hi))
+            hi_s, lo_s = hi[order], lo[order]
+            invalid_s = (hi_s == sent) & (lo_s == sent)
+            first = dedup.first_occurrence_mask(hi_s, lo_s, invalid_s)
+            seen, rank = dedup.rank_sorted(vhi, vlo, vn, hi_s, lo_s)
             is_new = first & ~seen
 
             # compact new states to the front (OOB scatter indices are dropped)
             pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, M)
-            out = jnp.zeros((M, K), jnp.uint32).at[pos].set(cand)
-            out_parent = jnp.full((M,), -1, jnp.int32).at[pos].set(parent)
-            out_act = jnp.full((M,), -1, jnp.int32).at[pos].set(act)
-            out_hi = jnp.zeros((M,), jnp.uint32).at[pos].set(hi)
-            out_lo = jnp.zeros((M,), jnp.uint32).at[pos].set(lo)
+            out = jnp.zeros((M, K), jnp.uint32).at[pos].set(cand[order])
+            out_parent = jnp.full((M,), -1, jnp.int32).at[pos].set(order // C)
+            out_act = jnp.full((M,), -1, jnp.int32).at[pos].set(act_ids[order % C])
+            out_hi = jnp.full((M,), sent).at[pos].set(hi_s)
+            out_lo = jnp.full((M,), sent).at[pos].set(lo_s)
+            out_rank = jnp.zeros((M,), jnp.int32).at[pos].set(rank)
             new_n = jnp.sum(is_new, dtype=jnp.int32)
 
             if with_merge:
-                vhi2, vlo2, vn2 = dedup.merge_into_sorted(
-                    vhi, vlo, vn, hi, lo, is_new, vcap
+                vhi2, vlo2, vn2 = dedup.merge_ranked(
+                    vhi, vlo, vn, out_hi, out_lo, out_rank, new_n, vcap
                 )
             else:
                 vhi2, vlo2, vn2 = vhi, vlo, vn
 
-            # invariants on the newly discovered states only
+            # invariants on the frontier being expanded (each state is checked
+            # exactly once, at expansion; `states` is already unpacked, and
+            # the frontier is C-times smaller than the candidate buffer).
+            # BFS order is preserved: states are checked before successors.
             viol_any, viol_idx = [], []
             if with_invariants and model.invariants:
-                new_states = jax.vmap(spec.unpack)(out)
-                new_mask = jnp.arange(M) < new_n
                 for inv in model.invariants:
-                    ok = jax.vmap(inv.pred)(new_states)
-                    bad = new_mask & ~ok
+                    ok = jax.vmap(inv.pred)(states)
+                    bad = fvalid & ~ok
                     viol_any.append(jnp.any(bad))
                     viol_idx.append(jnp.argmax(bad))
             else:
@@ -237,6 +239,8 @@ def check(
     check_deadlock: bool = False,
     stats_path: Optional[str] = None,
     visited_backend: str = "device",
+    chunk_size: int = 32768,
+    visited_capacity_hint: Optional[int] = None,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -254,9 +258,18 @@ def check(
     path); "host" streams each level's batch-deduped fingerprints through the
     native C++ open-addressing FpSet (native/fpset.cpp) — the TLC-FPSet
     spill mode for state spaces whose fingerprints outgrow device memory.
-    Device HBM then holds only O(frontier x fanout) transient data.  With
+    Device HBM then holds only O(chunk x fanout) transient data.  With
     hashed (non-exact64) fingerprints this accepts TLC's usual 64-bit
     collision risk.
+
+    chunk_size: frontiers larger than this stream through the compiled step
+    in pieces (cross-chunk dedup via the shared visited set), bounding the
+    number of jit-compiled shapes and peak device memory regardless of
+    state-space size.
+
+    visited_capacity_hint: preallocate the device visited set for ~this many
+    states so capacity doubling (one recompile per doubling) never triggers
+    on runs whose state-space size is roughly known.
 
     checkpoint_dir: when set, the (visited set, frontier, level counters) are
     persisted after every BFS level and a run restarts from the last saved
@@ -307,7 +320,17 @@ def check(
         vn = jnp.int32(0)
     else:
         order = np.lexsort((np.asarray(lo0), np.asarray(hi0)))
-        vcap = _next_pow2(max(n0, min_bucket * C, 2))
+        chunk_clamped = _next_pow2(max(min_bucket, chunk_size))
+        vcap = _next_pow2(
+            max(
+                n0,
+                min_bucket * C,
+                2,
+                (visited_capacity_hint + chunk_clamped * C)
+                if visited_capacity_hint
+                else 0,
+            )
+        )
         vhi = np.full(vcap, 0xFFFFFFFF, np.uint32)
         vlo = np.full(vcap, 0xFFFFFFFF, np.uint32)
         vhi[:n0] = np.asarray(hi0)[order]
@@ -417,102 +440,136 @@ def check(
 
         os.replace(ckpt_path + ".tmp.npz", ckpt_path)
 
+    chunk = _next_pow2(max(min_bucket, chunk_size))
+
     while frontier_np.shape[0] > 0:
         if max_depth is not None and depth >= max_depth:
             break
         if max_states is not None and total >= max_states:
             break
-        f = frontier_np.shape[0]
-        bucket = _next_pow2(max(f, min_bucket))
-        M = bucket * C
-        if host_set is None:
-            # ensure visited capacity can absorb worst-case M new states
-            need = int(vn) + M
-            if need > vcap:
-                new_cap = _next_pow2(need)
-                pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
-                vhi = jnp.concatenate([vhi, pad])
-                vlo = jnp.concatenate([vlo, pad])
-                vcap = new_cap
-
-        frontier = jnp.asarray(_pad_rows(frontier_np, bucket))
-        fvalid = jnp.arange(bucket) < f
-        step = step_builder.get(
-            bucket, vcap, check_invariants, with_merge=host_set is None
-        )
+        f_total = frontier_np.shape[0]
         t_level = time.perf_counter()
-        (
-            out,
-            out_parent,
-            out_act,
-            new_n,
-            vhi,
-            vlo,
-            vn,
-            viol_any,
-            viol_idx,
-            dl_any,
-            dl_idx,
-            act_en,
-            out_hi,
-            out_lo,
-        ) = step(frontier, fvalid, vhi, vlo, vn)
-        if check_deadlock and bool(dl_any):
-            i = int(dl_idx)
+        # A frontier larger than `chunk` is streamed through the same
+        # compiled step in chunk_size pieces: cross-chunk duplicates are
+        # caught because each chunk probes the visited set updated by the
+        # previous one.  This bounds both the number of compiled shapes
+        # (O(log chunk) buckets, ever) and peak device memory (O(chunk*C)).
+        lvl_rows, lvl_parent, lvl_act = [], [], []
+        lvl_new = 0
+        lvl_act_en = np.zeros(len(model.actions), np.int64)
+        verdict = None  # (kind, global_frontier_idx, inv_name)
+        for start in range(0, f_total, chunk):
+            piece = frontier_np[start : start + chunk]
+            fp_n = piece.shape[0]
+            bucket = _next_pow2(max(fp_n, min_bucket))
+            M = bucket * C
+            if host_set is None:
+                need = int(vn) + M
+                if need > vcap:
+                    new_cap = _next_pow2(need)
+                    pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
+                    vhi = jnp.concatenate([vhi, pad])
+                    vlo = jnp.concatenate([vlo, pad])
+                    vcap = new_cap
+            step = step_builder.get(
+                bucket, vcap, check_invariants, with_merge=host_set is None
+            )
+            (
+                out,
+                out_parent,
+                out_act,
+                new_n,
+                vhi,
+                vlo,
+                vn,
+                viol_any,
+                viol_idx,
+                dl_any,
+                dl_idx,
+                act_en,
+                out_hi,
+                out_lo,
+            ) = step(
+                jnp.asarray(_pad_rows(piece, bucket)),
+                jnp.arange(bucket) < fp_n,
+                vhi,
+                vlo,
+                vn,
+            )
+            # frontier-level verdicts (states being expanded = level `depth`)
+            if check_invariants:
+                viol_any_np = np.asarray(viol_any)
+                if viol_any_np.any():
+                    inv_i = int(np.argmax(viol_any_np))
+                    idx = start + int(np.asarray(viol_idx)[inv_i])
+                    verdict = ("invariant", idx, model.invariants[inv_i].name)
+                    break
+            if check_deadlock and bool(dl_any):
+                verdict = ("deadlock", start + int(dl_idx), "Deadlock")
+                break
+            nn = int(new_n)
+            if host_set is not None and nn:
+                rows = np.asarray(out[:nn])
+                mask = host_set.insert(
+                    _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
+                )
+                lvl_rows.append(rows[mask])
+                lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
+                lvl_act.append(np.asarray(out_act[:nn])[mask])
+                lvl_new += int(mask.sum())
+            elif nn:
+                lvl_rows.append(np.asarray(out[:nn]))
+                lvl_parent.append(np.asarray(out_parent[:nn]) + start)
+                lvl_act.append(np.asarray(out_act[:nn]))
+                lvl_new += nn
+            if collect_stats:
+                lvl_act_en += np.asarray(act_en, np.int64)
+
+        if verdict is not None:
+            kind, idx, inv_name = verdict
             if store_trace:
-                violation = build_violation("Deadlock", depth, i)
+                violation = build_violation(inv_name, depth, idx)
             else:
                 violation = Violation(
-                    invariant="Deadlock",
+                    invariant=inv_name,
                     depth=depth,
-                    state=decode_state(frontier_np[i]),
+                    state=decode_state(frontier_np[idx]),
                     trace=[],
                 )
             break
-        new_n = int(new_n)
-        host_mask = None
-        if host_set is not None and new_n:
-            # batch-unique candidates -> global dedup through the native
-            # FpSet (the step already compacted their fingerprints)
-            rows = np.asarray(out[:new_n])
-            host_mask = host_set.insert(
-                _u64(np.asarray(out_hi[:new_n]), np.asarray(out_lo[:new_n]))
-            )
-            next_frontier = rows[host_mask]
-            host_parent = np.asarray(out_parent[:new_n])[host_mask]
-            host_act = np.asarray(out_act[:new_n])[host_mask]
-            host_pos = np.cumsum(host_mask) - 1
-            new_n = int(host_mask.sum())
+
+        new_n = lvl_new
+        next_frontier = (
+            np.concatenate(lvl_rows)
+            if lvl_rows
+            else np.empty((0, K), np.uint32)
+        )
+        level_parent = (
+            np.concatenate(lvl_parent) if lvl_parent else np.empty(0, np.int64)
+        )
+        level_act = np.concatenate(lvl_act) if lvl_act else np.empty(0, np.int64)
         depth += 1
         if new_n:
             levels.append(new_n)
             total += new_n
         if collect_stats:
-            act_en_np = np.asarray(act_en)
-            enabled_total = int(act_en_np.sum())
+            enabled_total = int(lvl_act_en.sum())
             rec = {
                 "depth": depth,
-                "frontier": f,
+                "frontier": f_total,
                 "enabled_candidates": enabled_total,
                 "new": new_n,
                 "duplicates": enabled_total - new_n,
                 "total": total,
                 "level_ms": round((time.perf_counter() - t_level) * 1e3, 1),
                 "action_enablement": {
-                    a.name: int(c)
-                    for a, c in zip(model.actions, act_en_np.tolist())
+                    a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
                 },
             }
             result_stats.setdefault("levels", []).append(rec)
             if stats_path is not None:
                 with open(stats_path, "a") as fh:
                     fh.write(json.dumps(rec) + "\n")
-        if host_mask is None:
-            next_frontier = np.asarray(out[:new_n])
-            level_parent = np.asarray(out_parent[:new_n])
-            level_act = np.asarray(out_act[:new_n])
-        else:
-            level_parent, level_act = host_parent, host_act
         if collect_levels is not None and new_n:
             collect_levels.append(next_frontier)
         if store_trace:
@@ -520,39 +577,29 @@ def check(
         if progress:
             progress(depth, new_n, total)
 
-        if check_invariants:
-            viol_any_np = np.asarray(viol_any)
-            if viol_any_np.any():
-                inv_i = int(np.argmax(viol_any_np))
-                idx = int(np.asarray(viol_idx)[inv_i])
-                inv_name = model.invariants[inv_i].name
-                if host_mask is not None:
-                    # idx is pre-filter; a violating state is necessarily
-                    # globally new (an old one would have fired when first
-                    # discovered), so it survives the host dedup filter
-                    raw = np.asarray(out[idx : idx + 1])[0]
-                    idx = int(host_pos[idx]) if host_mask[idx] else -1
-                    if idx < 0:
-                        violation = Violation(
-                            invariant=inv_name,
-                            depth=depth,
-                            state=decode_state(raw),
-                            trace=[],
-                        )
-                        break
-                if store_trace:
-                    violation = build_violation(inv_name, depth, idx)
-                else:
-                    violation = Violation(
-                        invariant=inv_name,
-                        depth=depth,
-                        state=decode_state(next_frontier[idx]),
-                        trace=[],
-                    )
-                break
         frontier_np = next_frontier
         if ckpt_path is not None:
             _save_checkpoint()
+
+    if violation is None and check_invariants and model.invariants and frontier_np.shape[0]:
+        # the loop was cut (max_depth/max_states) before the remaining
+        # frontier was expanded — its states still need their invariant pass
+        st = jax.vmap(spec.unpack)(jnp.asarray(frontier_np))
+        for inv in model.invariants:
+            ok = np.asarray(jax.vmap(inv.pred)(st))
+            if not ok.all():
+                idx = int(np.argmax(~ok))
+                violation = (
+                    build_violation(inv.name, depth, idx)
+                    if store_trace
+                    else Violation(
+                        invariant=inv.name,
+                        depth=depth,
+                        state=decode_state(frontier_np[idx]),
+                        trace=[],
+                    )
+                )
+                break
 
     dt = time.perf_counter() - t0
     result_stats.update(

@@ -20,18 +20,6 @@ import jax.numpy as jnp
 SENT = 0xFFFFFFFF
 
 
-def sort_pairs_with_payload(hi, lo, invalid, payloads):
-    """Sort candidates so valid entries come first ordered by (hi, lo).
-
-    invalid: bool[N] — True entries are pushed to the end.
-    payloads: tuple of arrays [N, ...] permuted alongside.
-    Returns (hi_s, lo_s, invalid_s, payloads_s).
-    """
-    order = jnp.lexsort((lo, hi, invalid.astype(jnp.uint32)))
-    take = lambda a: jnp.take(a, order, axis=0)
-    return take(hi), take(lo), take(invalid), tuple(take(p) for p in payloads)
-
-
 def first_occurrence_mask(hi_s, lo_s, invalid_s):
     """After sorting: True for the first copy of each distinct valid pair."""
     prev_same = jnp.concatenate(
@@ -40,17 +28,18 @@ def first_occurrence_mask(hi_s, lo_s, invalid_s):
     return (~invalid_s) & (~prev_same)
 
 
-def member_sorted(set_hi, set_lo, set_n, q_hi, q_lo):
-    """Vectorized membership probe of queries against a sorted pair set.
+def rank_sorted(set_hi, set_lo, set_n, q_hi, q_lo):
+    """Vectorized lower-bound rank of queries in a sorted pair set.
 
     set_hi/set_lo: uint32[cap] sorted ascending on (hi, lo) for the first
-    set_n entries (the rest is sentinel padding).  Fixed 32-iteration binary
-    search — static trip count, fully vectorized over queries.
+    set_n entries (the rest is sentinel padding).  Fixed-iteration binary
+    search — static trip count, fully vectorized over queries.  Returns
+    (found_mask, rank) where rank is the insertion index (bisect_left).
     """
     cap = set_hi.shape[0]
     n_q = q_hi.shape[0]
     lo_i = jnp.zeros((n_q,), jnp.int32)
-    hi_i = jnp.full((n_q,), set_n, jnp.int32)
+    hi_i = jnp.broadcast_to(jnp.asarray(set_n, jnp.int32), (n_q,))
     iters = max(1, cap.bit_length())
 
     def body(_, carry):
@@ -63,26 +52,41 @@ def member_sorted(set_hi, set_lo, set_n, q_hi, q_lo):
 
     lo_i, _ = jax.lax.fori_loop(0, iters, body, (lo_i, hi_i))
     idx = jnp.minimum(lo_i, cap - 1)
-    return (lo_i < set_n) & (set_hi[idx] == q_hi) & (set_lo[idx] == q_lo)
+    found = (lo_i < set_n) & (set_hi[idx] == q_hi) & (set_lo[idx] == q_lo)
+    return found, lo_i
 
 
-def merge_into_sorted(set_hi, set_lo, set_n, new_hi, new_lo, new_valid, out_cap):
-    """Merge new pairs into the sorted visited set (concat + sort + slice).
+def member_sorted(set_hi, set_lo, set_n, q_hi, q_lo):
+    """Membership probe (see rank_sorted)."""
+    found, _ = rank_sorted(set_hi, set_lo, set_n, q_hi, q_lo)
+    return found
 
-    Invalid new slots are replaced by sentinel pairs so they sort past the
-    valid region.  out_cap is a static capacity the caller guarantees to be
-    >= set_n + count(new_valid) (host-side doubling policy); the result is
-    sliced to it so the jitted caller keeps a fixed visited-set shape.
-    Returns (hi[out_cap], lo[out_cap], n).
+
+def merge_ranked(set_hi, set_lo, set_n, new_hi, new_lo, new_rank, new_n, out_cap):
+    """Scatter-merge: sorted visited set + compacted sorted new pairs.
+
+    new_hi/new_lo: [M] with the first new_n entries sorted ascending and
+    disjoint from the visited set; new_rank: each new entry's insertion index
+    in the visited set (from rank_sorted).  Builds the merged sorted array
+    with two scatters instead of re-sorting V+M keys:
+      target(new[j])     = rank[j] + j
+      target(visited[i]) = i + (# new entries below visited[i])
+    Out-of-range targets (sentinel tails) drop or overwrite padding with
+    sentinels — both harmless.  Returns (hi[out_cap], lo[out_cap], n).
     """
+    cap = set_hi.shape[0]
+    M = new_hi.shape[0]
+    j = jnp.arange(M, dtype=jnp.int32)
+    valid_new = j < new_n
+    tgt_new = jnp.where(valid_new, new_rank + j, out_cap)
+
+    # rank of each visited entry within the new list
+    _, cnt_before = rank_sorted(new_hi, new_lo, new_n, set_hi, set_lo)
+    tgt_old = jnp.arange(cap, dtype=jnp.int32) + cnt_before
+
     sent = jnp.uint32(SENT)
-    all_hi = jnp.concatenate([set_hi, jnp.where(new_valid, new_hi, sent)])
-    all_lo = jnp.concatenate([set_lo, jnp.where(new_valid, new_lo, sent)])
-    order = jnp.lexsort((all_lo, all_hi))
-    all_hi, all_lo = all_hi[order], all_lo[order]
-    total = all_hi.shape[0]
-    if total < out_cap:
-        pad = jnp.full((out_cap - total,), SENT, jnp.uint32)
-        all_hi = jnp.concatenate([all_hi, pad])
-        all_lo = jnp.concatenate([all_lo, pad])
-    return all_hi[:out_cap], all_lo[:out_cap], set_n + jnp.sum(new_valid, dtype=jnp.int32)
+    out_hi = jnp.full((out_cap,), sent)
+    out_lo = jnp.full((out_cap,), sent)
+    out_hi = out_hi.at[tgt_old].set(set_hi).at[tgt_new].set(new_hi)
+    out_lo = out_lo.at[tgt_old].set(set_lo).at[tgt_new].set(new_lo)
+    return out_hi, out_lo, set_n + new_n

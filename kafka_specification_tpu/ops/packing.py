@@ -60,6 +60,7 @@ class StateSpec:
 
         lane_ids, shifts, widths, los = [], [], [], []
         lane, bit = 0, 0
+        lane_bits = {}
         for f in self.fields:
             w = f.width
             assert w <= 32, f"field {f.name} needs {w} bits > 32"
@@ -71,7 +72,15 @@ class StateSpec:
                 widths.append(w)
                 los.append(f.lo)
                 bit += w
+                lane_bits[lane] = bit
         self.num_lanes = lane + 1 if bit > 0 else lane
+        # a state can only pack to the all-ones sentinel pair if every lane
+        # is completely full of field bits (pad bits are always 0); with a
+        # single lane the exact fingerprint's hi word is constant 0, so the
+        # sentinel pair is unreachable regardless
+        self._may_hit_sentinel = self.num_lanes == 2 and all(
+            lane_bits.get(i, 0) == 32 for i in range(self.num_lanes)
+        )
         self.total_bits = sum(widths)
         self._lane_ids = np.asarray(lane_ids, np.int32)
         self._shifts = np.asarray(shifts, np.uint32)
@@ -84,10 +93,14 @@ class StateSpec:
         for f in self.fields:
             self._field_slices[f.name] = (ofs, ofs + f.num_elements, f.shape)
             ofs += f.num_elements
-        # True iff the whole state fits in 64 bits -> fingerprints can be exact
-        # (force_hashed exists so tests can exercise the hashed dedup mode on
-        # small states)
-        self.exact64 = self.num_lanes <= 2 and not force_hashed
+        # True iff the whole state fits in 64 bits -> fingerprints can be
+        # exact (collision-free dedup).  Demoted to hashed when a state could
+        # pack to the all-ones dedup sentinel (only if every lane is exactly
+        # full — never the case for the corpus encodings).  force_hashed
+        # exists so tests can exercise the hashed mode on small states.
+        self.exact64 = (
+            self.num_lanes <= 2 and not force_hashed and not self._may_hit_sentinel
+        )
 
     # -- flat <-> struct -------------------------------------------------------
 

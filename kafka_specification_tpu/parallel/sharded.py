@@ -63,8 +63,6 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
         en = en & fvalid[:, None]
         cand = packed.reshape(M, K)
         valid = en.reshape(M)
-        parent = me * bucket + jnp.repeat(jnp.arange(bucket, dtype=jnp.int32), C)
-        act = jnp.tile(act_ids, bucket)
 
         hi, lo = fingerprint_lanes(cand, spec.exact64)
         sent = jnp.uint32(dedup.SENT)
@@ -75,37 +73,44 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
         g_hi = jax.lax.all_gather(hi, "d", tiled=True)  # [D*M]
         g_lo = jax.lax.all_gather(lo, "d", tiled=True)
         g_cand = jax.lax.all_gather(cand, "d", tiled=True)  # [D*M, K]
-        g_parent = jax.lax.all_gather(parent, "d", tiled=True)
-        g_act = jax.lax.all_gather(act, "d", tiled=True)
         g_valid = jax.lax.all_gather(valid, "d", tiled=True)
 
         mine = g_valid & ((g_lo % jnp.uint32(D)).astype(jnp.int32) == me)
         g_hi = jnp.where(mine, g_hi, sent)
         g_lo = jnp.where(mine, g_lo, sent)
 
-        s_hi, s_lo, s_inv, (s_cand, s_parent, s_act) = dedup.sort_pairs_with_payload(
-            g_hi, g_lo, ~mine, (g_cand, g_parent, g_act)
-        )
-        first = dedup.first_occurrence_mask(s_hi, s_lo, s_inv)
-        seen = dedup.member_sorted(vhi, vlo, vn, s_hi, s_lo)
+        # minimal-payload sort; parent/action derive from the gathered index:
+        # g = src_device*M + i*C + c
+        order = jnp.lexsort((g_lo, g_hi))
+        hi_s, lo_s = g_hi[order], g_lo[order]
+        invalid_s = (hi_s == sent) & (lo_s == sent)
+        first = dedup.first_occurrence_mask(hi_s, lo_s, invalid_s)
+        seen, rank = dedup.rank_sorted(vhi, vlo, vn, hi_s, lo_s)
         is_new = first & ~seen
 
         DM = D * M
+        src_parent = (order // M) * bucket + (order % M) // C
+        src_act = act_ids[(order % M) % C]
         pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, DM)
-        out = jnp.zeros((DM, K), jnp.uint32).at[pos].set(s_cand)
-        out_parent = jnp.full((DM,), -1, jnp.int32).at[pos].set(s_parent)
-        out_act = jnp.full((DM,), -1, jnp.int32).at[pos].set(s_act)
+        out = jnp.zeros((DM, K), jnp.uint32).at[pos].set(g_cand[order])
+        out_parent = jnp.full((DM,), -1, jnp.int32).at[pos].set(src_parent)
+        out_act = jnp.full((DM,), -1, jnp.int32).at[pos].set(src_act)
+        out_hi = jnp.full((DM,), sent).at[pos].set(hi_s)
+        out_lo = jnp.full((DM,), sent).at[pos].set(lo_s)
+        out_rank = jnp.zeros((DM,), jnp.int32).at[pos].set(rank)
         new_n = jnp.sum(is_new, dtype=jnp.int32)
 
-        vhi2, vlo2, vn2 = dedup.merge_into_sorted(vhi, vlo, vn, s_hi, s_lo, is_new, vcap)
+        vhi2, vlo2, vn2 = dedup.merge_ranked(
+            vhi, vlo, vn, out_hi, out_lo, out_rank, new_n, vcap
+        )
 
+        # invariants on the frontier shard being expanded (checked once per
+        # state, at expansion; `states` is already unpacked)
         viol_any, viol_idx = [], []
         if model.invariants:
-            new_states = jax.vmap(spec.unpack)(out)
-            new_mask = jnp.arange(DM) < new_n
             for inv in model.invariants:
-                ok = jax.vmap(inv.pred)(new_states)
-                bad = new_mask & ~ok
+                ok = jax.vmap(inv.pred)(states)
+                bad = fvalid & ~ok
                 viol_any.append(jnp.any(bad))
                 viol_idx.append(jnp.argmax(bad))
         else:
@@ -230,10 +235,13 @@ def check_sharded(
     violation = None
     steps = {}
 
+    cut = False
     while True:
         if max_depth is not None and depth >= max_depth:
+            cut = True
             break
         if max_states is not None and total >= max_states:
+            cut = True
             break
         key = (bucket, vcap)
         if key not in steps:
@@ -252,6 +260,23 @@ def check_sharded(
             dl_any,
             dl_idx,
         ) = step(dev_frontier, dev_fvalid, dev_vhi, dev_vlo, dev_vn)
+        # frontier-level verdicts (states being expanded = BFS level `depth`)
+        viol_any_np = np.asarray(viol_any)  # [D, n_inv]
+        if viol_any_np.any():
+            # first violated invariant (TLC reports one); then its first shard
+            inv_i = int(np.argmax(viol_any_np.any(axis=0)))
+            d = int(np.argmax(viol_any_np[:, inv_i]))
+            b_per = dev_frontier.shape[0] // D
+            i = d * b_per + int(np.asarray(viol_idx)[d, inv_i])
+            row = np.asarray(dev_frontier[i : i + 1])[0]
+            st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
+            violation = Violation(
+                invariant=model.invariants[inv_i].name,
+                depth=depth,
+                state=model.decode(st) if model.decode else st,
+                trace=[],
+            )
+            break
         if check_deadlock and np.asarray(dl_any).any():
             d = int(np.argmax(np.asarray(dl_any)))
             b_per = dev_frontier.shape[0] // D
@@ -274,22 +299,6 @@ def check_sharded(
         if progress:
             progress(depth, n_new, total)
 
-        viol_any_np = np.asarray(viol_any)  # [D, n_inv]
-        if viol_any_np.any():
-            # first violated invariant (TLC reports one); then its first shard
-            inv_i = int(np.argmax(viol_any_np.any(axis=0)))
-            d = int(np.argmax(viol_any_np[:, inv_i]))
-            M_per = out.shape[0] // D
-            idx = d * M_per + int(np.asarray(viol_idx)[d, inv_i])
-            row = np.asarray(out[idx : idx + 1])[0]
-            st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
-            violation = Violation(
-                invariant=model.invariants[inv_i].name,
-                depth=depth,
-                state=model.decode(st) if model.decode else st,
-                trace=[],
-            )
-            break
         if n_new == 0:
             break
 
@@ -314,6 +323,29 @@ def check_sharded(
             pad = jnp.full((D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32)
             dev_vhi = jax.device_put(jnp.concatenate([dev_vhi, pad], axis=1), shard1)
             dev_vlo = jax.device_put(jnp.concatenate([dev_vlo, pad], axis=1), shard1)
+
+    if violation is None and cut and model.invariants:
+        # cutoff left the last frontier unexpanded — run its invariant pass
+        fr = np.asarray(dev_frontier)
+        fv = np.asarray(dev_fvalid)
+        rows = fr[fv]
+        if rows.shape[0]:
+            st = jax.vmap(spec.unpack)(jnp.asarray(rows))
+            for inv in model.invariants:
+                ok = np.asarray(jax.vmap(inv.pred)(st))
+                if not ok.all():
+                    idx = int(np.argmax(~ok))
+                    dec = {
+                        k: np.asarray(v)
+                        for k, v in spec.unpack(jnp.asarray(rows[idx])).items()
+                    }
+                    violation = Violation(
+                        invariant=inv.name,
+                        depth=depth,
+                        state=model.decode(dec) if model.decode else dec,
+                        trace=[],
+                    )
+                    break
 
     dt = time.perf_counter() - t0
     return CheckResult(

@@ -24,7 +24,7 @@ import time
 from .cfg import build_model, parse_cfg
 
 
-def _print_result(res, as_json: bool):
+def _print_result(res, as_json: bool, model_meta=None):
     if as_json:
         print(
             json.dumps(
@@ -57,12 +57,15 @@ def _print_result(res, as_json: bool):
     else:
         v = res.violation
         print(f"Invariant {v.invariant} is VIOLATED at depth {v.depth}.")
+        from .pretty import render_state, render_trace
+
+        meta = model_meta or {}
         if v.trace:
             print("Counterexample trace:")
-            for i, (action, state) in enumerate(v.trace):
-                print(f"  {i}. [{action}] {state}")
+            print(render_trace(meta, v.trace))
         else:
-            print(f"Violating state: {v.state}")
+            print("Violating state:")
+            print(render_state(meta, v.state))
 
 
 def main(argv=None):
@@ -168,7 +171,7 @@ def main(argv=None):
             stats_path=args.stats,
             visited_backend=args.visited_backend,
         )
-    _print_result(res, args.json)
+    _print_result(res, args.json, model_meta=model.meta)
     return 0 if res.violation is None else 1
 
 

@@ -1,0 +1,104 @@
+"""TLA-style rendering of canonical states for counterexample traces.
+
+The engines' decoded states are canonical Python tuples (compact, hashable,
+comparable with the oracle); this module renders them the way TLC prints a
+state — named records, one variable per line — so a counterexample reads
+like the reference spec's own vocabulary.
+"""
+
+from __future__ import annotations
+
+
+def _set(s):
+    return "{" + ", ".join(f"b{r}" for r in sorted(s)) + "}"
+
+
+def _opt(v, prefix="b"):
+    return "None" if v == -1 else f"{prefix}{v}"
+
+
+def render_kafka_state(state) -> str:
+    """Canonical KafkaReplication-family state -> TLA-like record text
+    (field names per /root/reference/KafkaReplication.tla:45-75)."""
+    logs, rstates, nrid, nep, reqs, (qep, qldr, qisr) = state
+    lines = []
+    log_txt = ", ".join(
+        f"b{r} :> <<"
+        + ", ".join(f"[id|->{i}, epoch|->{e}]" for i, e in log)
+        + ">>"
+        for r, log in enumerate(logs)
+    )
+    lines.append(f"replicaLog = ({log_txt})")
+    rs_txt = ", ".join(
+        f"b{r} :> [hw|->{hw}, leaderEpoch|->{ep}, leader|->{_opt(ldr)}, isr|->{_set(isr)}]"
+        for r, (hw, ep, ldr, isr) in enumerate(rstates)
+    )
+    lines.append(f"replicaState = ({rs_txt})")
+    lines.append(f"nextRecordId = {nrid}")
+    lines.append(f"nextLeaderEpoch = {nep}")
+    req_txt = ", ".join(
+        f"[leaderEpoch|->{e}, leader|->{_opt(l)}, isr|->{_set(isr)}]"
+        for e, l, isr in sorted(reqs)
+    )
+    lines.append(f"leaderAndIsrRequests = {{{req_txt}}}")
+    lines.append(
+        f"quorumState = [leaderEpoch|->{qep}, leader|->{_opt(qldr)}, isr|->{_set(qisr)}]"
+    )
+    return "\n".join("  " + ln for ln in lines)
+
+
+def render_async_isr_state(state) -> str:
+    """Canonical AsyncIsr state -> TLA-like record text (AsyncIsr.tla:31-56)."""
+    (c_isr, c_ver), (l_isr, l_ver, pend, pver, offs), reqs, upds = state
+    lines = [
+        f"controllerState = [isr|->{_set(c_isr)}, version|->{c_ver}]",
+        f"leaderState = [isr|->{_set(l_isr)}, version|->{l_ver}, "
+        f"pendingIsr|->{_set(pend)}, pendingVersion|->{pver}, "
+        f"offsets|->({', '.join(f'b{r} :> {o}' for r, o in enumerate(offs))})]",
+        "requests = {"
+        + ", ".join(
+            f"[isr|->{_set(isr)}, version|->{v}]" for isr, v in sorted(reqs, key=str)
+        )
+        + "}",
+        "updates = {"
+        + ", ".join(
+            f"[isr|->{_set(isr)}, version|->{v}]" for isr, v in sorted(upds, key=str)
+        )
+        + "}",
+    ]
+    return "\n".join("  " + ln for ln in lines)
+
+
+def render_state(model_meta: dict, state) -> str:
+    """Dispatch on the model family; fall back to repr."""
+    variant = model_meta.get("variant", "")
+    try:
+        if "partitions" in model_meta:
+            parts = [
+                f"  partition {p}:\n" + render_state({"variant": variant}, sub)
+                for p, sub in enumerate(state)
+            ]
+            return "\n".join(parts)
+        if variant == "AsyncIsr":
+            return render_async_isr_state(state)
+        if variant in (
+            "KafkaTruncateToHighWatermark",
+            "Kip101",
+            "Kip279",
+            "Kip320",
+            "Kip320FirstTry",
+        ):
+            return render_kafka_state(state)
+    except Exception:
+        pass
+    return "  " + repr(state)
+
+
+def render_trace(model_meta: dict, trace) -> str:
+    """Numbered TLC-style counterexample trace."""
+    out = []
+    for i, (action, state) in enumerate(trace):
+        head = "Initial predicate" if action == "<init>" else f"Action {action}"
+        out.append(f"State {i + 1}: <{head}>")
+        out.append(render_state(model_meta, state))
+    return "\n".join(out)

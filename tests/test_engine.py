@@ -75,3 +75,28 @@ def test_invariants_checked_on_new_states_each_level():
     # trace is a valid path of length depth+1
     assert len(res.violation.trace) == 3
     assert res.violation.trace[0][0] == "<init>"
+
+
+def test_chunked_frontier_matches_golden():
+    """Tiny chunk_size forces multi-chunk levels; counts must be identical
+    (cross-chunk dedup rides the shared visited set)."""
+    model = finite_replicated_log.make_model(3, 4, 2)
+    res = check(model, min_bucket=32, chunk_size=32, store_trace=False)
+    assert res.ok
+    assert res.total == 29791
+    assert res.diameter == 12
+
+
+def test_chunked_violation_depth_stable():
+    base = finite_replicated_log.make_model(2, 2, 1)
+    model = Model(
+        name=base.name,
+        spec=base.spec,
+        init_states=base.init_states,
+        actions=base.actions,
+        invariants=[Invariant("ShortLogs", lambda s: (s["end"] < 2).all())],
+        decode=base.decode,
+    )
+    res = check(model, min_bucket=32, chunk_size=32)
+    assert res.violation is not None and res.violation.depth == 2
+    assert len(res.violation.trace) == 3
