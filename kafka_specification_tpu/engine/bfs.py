@@ -226,6 +226,26 @@ def _pad_rows(arr: np.ndarray, n: int, fill=0):
     return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
 
 
+def walk_trace(trace_store, actions, decode_row, inv_name, depth, idx) -> Violation:
+    """Parent-pointer counterexample reconstruction, shared by both engines.
+
+    trace_store[level] = (rows, parent, act): the level's states in discovery
+    order, each new state's parent index into the previous level, and the
+    action id that produced it.  Walks level `depth` index `idx` back to an
+    init state and returns the Violation with the root->violation trace.
+    """
+    chain = []
+    i = idx
+    for d in range(depth, 0, -1):
+        rows, parent, act = trace_store[d]
+        chain.append((actions[int(act[i])].name, decode_row(rows[i])))
+        i = int(parent[i])
+    rows0, _, _ = trace_store[0]
+    chain.append(("<init>", decode_row(rows0[i])))
+    chain.reverse()
+    return Violation(invariant=inv_name, depth=depth, state=chain[-1][1], trace=chain)
+
+
 def check(
     model: Model,
     max_depth: Optional[int] = None,
@@ -351,19 +371,7 @@ def check(
         return model.decode(s) if model.decode else s
 
     def build_violation(inv_name, depth, idx):
-        # Walk parent pointers back through stored levels.
-        chain = []
-        i = idx
-        for d in range(depth, 0, -1):
-            packed, parent, act = trace_store[d]
-            chain.append((model.actions[int(act[i])].name, decode_state(packed[i])))
-            i = int(parent[i])
-        packed0, _, _ = trace_store[0]
-        chain.append(("<init>", decode_state(packed0[i])))
-        chain.reverse()
-        return Violation(
-            invariant=inv_name, depth=depth, state=chain[-1][1], trace=chain
-        )
+        return walk_trace(trace_store, model.actions, decode_state, inv_name, depth, idx)
 
     # invariants on init states
     if check_invariants and model.invariants:

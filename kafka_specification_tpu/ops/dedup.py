@@ -44,11 +44,17 @@ def rank_sorted(set_hi, set_lo, set_n, q_hi, q_lo):
 
     def body(_, carry):
         lo_i, hi_i = carry
+        active = lo_i < hi_i  # guard: an empty interval must stay put (mid
+        # would read one-past-the-end, which JAX clamps to the last element)
         mid = (lo_i + hi_i) // 2
-        mh = set_hi[mid]
-        ml = set_lo[mid]
+        midc = jnp.minimum(mid, cap - 1)
+        mh = set_hi[midc]
+        ml = set_lo[midc]
         less = (mh < q_hi) | ((mh == q_hi) & (ml < q_lo))
-        return jnp.where(less, mid + 1, lo_i), jnp.where(less, hi_i, mid)
+        return (
+            jnp.where(active & less, mid + 1, lo_i),
+            jnp.where(active & ~less, mid, hi_i),
+        )
 
     lo_i, _ = jax.lax.fori_loop(0, iters, body, (lo_i, hi_i))
     idx = jnp.minimum(lo_i, cap - 1)

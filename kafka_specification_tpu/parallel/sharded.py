@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..engine.bfs import CheckResult, Violation, _next_pow2, _Step
+from ..engine.bfs import CheckResult, Violation, _next_pow2, _Step, walk_trace
 from ..models.base import Model
 from ..ops import dedup
 from ..ops.fingerprint import fingerprint_lanes
@@ -148,13 +148,16 @@ def check_sharded(
     min_bucket: int = 256,
     progress=None,
     check_deadlock: bool = False,
+    chunk_size: int = 16384,
+    store_trace: bool = True,
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
-    Semantics match engine.check (same models, same counts); violation states
-    are reported without a parent trace — re-run the single-device engine on
-    the violating config to reconstruct a path (trace storage at pod scale is
-    a checkpointing concern, handled level-wise on the host there).
+    Semantics match engine.check (same models, same counts).  With
+    store_trace (default), per-level (states, parent, action) records are
+    kept on the host in shard-major discovery order, and a violation is
+    reported with the full parent-pointer counterexample path; disable for
+    pure-throughput runs at pod scale.
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
@@ -183,6 +186,7 @@ def check_sharded(
                     k: np.asarray(v)
                     for k, v in spec.unpack(jnp.asarray(init_packed[idx])).items()
                 }
+                dec = model.decode(st) if model.decode else st
                 return CheckResult(
                     model.name,
                     [n0],
@@ -191,8 +195,8 @@ def check_sharded(
                     Violation(
                         invariant=inv.name,
                         depth=0,
-                        state=model.decode(st) if model.decode else st,
-                        trace=[],
+                        state=dec,
+                        trace=[("<init>", dec)],
                     ),
                     time.perf_counter() - t0,
                     0.0,
@@ -213,18 +217,14 @@ def check_sharded(
         vlo[d, : len(sel)] = lo0[sel][order]
         vn[d] = len(sel)
 
-    # frontier: shard inits by owner so each device starts with its own
-    bucket = max(min_bucket // D, _next_pow2(int(vn.max()) if D else 1), 32)
-    frontier = np.zeros((D, bucket, K), np.uint32)
-    fvalid = np.zeros((D, bucket), bool)
-    for d in range(D):
-        sel = np.nonzero(owner0 == d)[0]
-        frontier[d, : len(sel)] = init_packed[sel]
-        fvalid[d, : len(sel)] = True
+    # per-shard pending frontiers live on the host; each level streams them
+    # through the compiled step in fixed-size chunks (same scheme as
+    # engine.check: cross-chunk dedup rides the per-shard visited sets, so
+    # the compiled-shape count and device memory stay bounded at pod scale)
+    pending = [init_packed[owner0 == d] for d in range(D)]
+    chunk = _next_pow2(max(32, chunk_size))
 
     shard1 = NamedSharding(mesh, P("d"))
-    dev_frontier = jax.device_put(frontier.reshape(D * bucket, K), shard1)
-    dev_fvalid = jax.device_put(fvalid.reshape(D * bucket), shard1)
     dev_vhi = jax.device_put(vhi, shard1)
     dev_vlo = jax.device_put(vlo, shard1)
     dev_vn = jax.device_put(vn, shard1)
@@ -235,116 +235,189 @@ def check_sharded(
     violation = None
     steps = {}
 
+    def decode_row(row):
+        st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
+        return model.decode(st) if model.decode else st
+
+    # per level, shard-major discovery order: (rows, parent_global, act)
+    trace_store = []
+    if store_trace:
+        init_rows = np.concatenate(pending) if n0 else np.empty((0, K), np.uint32)
+        trace_store.append(
+            (init_rows, np.full(n0, -1, np.int64), np.full(n0, -1, np.int64))
+        )
+
+    def build_violation(inv_name, d_level, idx):
+        return walk_trace(trace_store, model.actions, decode_row, inv_name, d_level, idx)
+
     cut = False
-    while True:
+    while any(p.shape[0] for p in pending):
         if max_depth is not None and depth >= max_depth:
             cut = True
             break
         if max_states is not None and total >= max_states:
             cut = True
             break
-        key = (bucket, vcap)
-        if key not in steps:
-            steps[key] = _make_sharded_step(model, mesh, bucket, vcap)
-        step = steps[key]
-        (
-            out,
-            out_parent,
-            out_act,
-            new_n,
-            dev_vhi,
-            dev_vlo,
-            dev_vn,
-            viol_any,
-            viol_idx,
-            dl_any,
-            dl_idx,
-        ) = step(dev_frontier, dev_fvalid, dev_vhi, dev_vlo, dev_vn)
-        # frontier-level verdicts (states being expanded = BFS level `depth`)
-        viol_any_np = np.asarray(viol_any)  # [D, n_inv]
-        if viol_any_np.any():
-            # first violated invariant (TLC reports one); then its first shard
-            inv_i = int(np.argmax(viol_any_np.any(axis=0)))
-            d = int(np.argmax(viol_any_np[:, inv_i]))
-            b_per = dev_frontier.shape[0] // D
-            i = d * b_per + int(np.asarray(viol_idx)[d, inv_i])
-            row = np.asarray(dev_frontier[i : i + 1])[0]
-            st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
-            violation = Violation(
-                invariant=model.invariants[inv_i].name,
-                depth=depth,
-                state=model.decode(st) if model.decode else st,
-                trace=[],
+        next_pending = [[] for _ in range(D)]
+        next_parent = [[] for _ in range(D)]
+        next_act = [[] for _ in range(D)]
+        lvl_new_per_shard = np.zeros(D, np.int64)
+        offs = [0] * D
+        # base offset of each shard's rows in this level's shard-major order
+        prev_base = np.concatenate([[0], np.cumsum([p.shape[0] for p in pending])])
+        verdict = None  # (inv_name, frontier_row_np, global_idx)
+        while verdict is None:
+            rem = max(p.shape[0] - o for p, o in zip(pending, offs))
+            if rem <= 0:
+                break
+            bucket = min(_next_pow2(max(rem, min_bucket // D, 32)), chunk)
+            frontier = np.zeros((D, bucket, K), np.uint32)
+            took = np.zeros(D, np.int32)
+            chunk_off = np.asarray(offs, np.int64)
+            for d in range(D):
+                rows = pending[d][offs[d] : offs[d] + bucket]
+                frontier[d, : rows.shape[0]] = rows
+                took[d] = rows.shape[0]
+                offs[d] += rows.shape[0]
+            fvalid = np.arange(bucket)[None, :] < took[:, None]
+
+            # grow per-shard visited capacity for the worst-case merge
+            need = int(np.asarray(dev_vn).max()) + D * bucket * C
+            if need > vcap:
+                vcap = _next_pow2(need)
+                pad = jnp.full(
+                    (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
+                )
+                dev_vhi = jax.device_put(
+                    jnp.concatenate([dev_vhi, pad], axis=1), shard1
+                )
+                dev_vlo = jax.device_put(
+                    jnp.concatenate([dev_vlo, pad], axis=1), shard1
+                )
+
+            key = (bucket, vcap)
+            if key not in steps:
+                steps[key] = _make_sharded_step(model, mesh, bucket, vcap)
+            (
+                out,
+                out_parent,
+                out_act,
+                new_n,
+                dev_vhi,
+                dev_vlo,
+                dev_vn,
+                viol_any,
+                viol_idx,
+                dl_any,
+                dl_idx,
+            ) = steps[key](
+                jax.device_put(frontier.reshape(D * bucket, K), shard1),
+                jax.device_put(fvalid.reshape(D * bucket), shard1),
+                dev_vhi,
+                dev_vlo,
+                dev_vn,
             )
+            # frontier-level verdicts (states being expanded = level `depth`)
+            viol_any_np = np.asarray(viol_any)  # [D, n_inv]
+            if viol_any_np.any():
+                inv_i = int(np.argmax(viol_any_np.any(axis=0)))
+                d = int(np.argmax(viol_any_np[:, inv_i]))
+                idx = int(np.asarray(viol_idx)[d, inv_i])
+                gidx = int(prev_base[d] + chunk_off[d] + idx)
+                verdict = (model.invariants[inv_i].name, frontier[d, idx], gidx)
+                break
+            if check_deadlock and np.asarray(dl_any).any():
+                d = int(np.argmax(np.asarray(dl_any)))
+                idx = int(np.asarray(dl_idx)[d])
+                gidx = int(prev_base[d] + chunk_off[d] + idx)
+                verdict = ("Deadlock", frontier[d, idx], gidx)
+                break
+            counts = np.asarray(new_n)
+            M_per = out.shape[0] // D
+            # device-side slice to the widest shard before the host copy —
+            # the padded buffer is D*bucket*C rows/shard, mostly empty
+            cmax = int(counts.max())
+            out3 = np.asarray(out.reshape(D, M_per, K)[:, :cmax])
+            if store_trace:
+                parent_np = np.asarray(out_parent.reshape(D, M_per)[:, :cmax])
+                act_np = np.asarray(out_act.reshape(D, M_per)[:, :cmax])
+            for d in range(D):
+                if counts[d]:
+                    next_pending[d].append(out3[d, : counts[d]])
+                    if store_trace:
+                        # step parents are d_src*bucket + i within this padded
+                        # chunk -> level-global index in shard-major order
+                        p = parent_np[d, : counts[d]].astype(np.int64)
+                        src_d = p // bucket
+                        src_i = p % bucket
+                        next_parent[d].append(
+                            prev_base[src_d] + chunk_off[src_d] + src_i
+                        )
+                        next_act[d].append(act_np[d, : counts[d]].astype(np.int64))
+            lvl_new_per_shard += counts
+
+        if verdict is not None:
+            inv_name, row, gidx = verdict
+            if store_trace:
+                violation = build_violation(inv_name, depth, gidx)
+            else:
+                violation = Violation(
+                    invariant=inv_name,
+                    depth=depth,
+                    state=decode_row(row),
+                    trace=[],
+                )
             break
-        if check_deadlock and np.asarray(dl_any).any():
-            d = int(np.argmax(np.asarray(dl_any)))
-            b_per = dev_frontier.shape[0] // D
-            i = d * b_per + int(np.asarray(dl_idx)[d])
-            row = np.asarray(dev_frontier[i : i + 1])[0]
-            st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
-            violation = Violation(
-                invariant="Deadlock",
-                depth=depth,
-                state=model.decode(st) if model.decode else st,
-                trace=[],
-            )
-            break
-        counts = np.asarray(new_n)
-        n_new = int(counts.sum())
+
+        n_new = int(lvl_new_per_shard.sum())
         depth += 1
         if n_new:
             levels.append(n_new)
             total += n_new
         if progress:
             progress(depth, n_new, total)
-
-        if n_new == 0:
-            break
-
-        # next frontier: each shard keeps its own new states, padded to a
-        # common bucket (clamped to the per-shard output width — counts can
-        # exceed half of it in explosive levels, and the slice below must
-        # yield exactly new_bucket columns)
-        M_per = out.shape[0] // D
-        new_bucket = min(_next_pow2(max(int(counts.max()), 32)), M_per)
-        out3 = out.reshape(D, M_per, K)
-        dev_frontier = out3[:, :new_bucket, :].reshape(D * new_bucket, K)
-        dev_fvalid = (
-            jnp.arange(new_bucket)[None, :] < jnp.asarray(counts)[:, None]
-        ).reshape(D * new_bucket)
-        dev_frontier = jax.device_put(dev_frontier, shard1)
-        dev_fvalid = jax.device_put(dev_fvalid, shard1)
-        bucket = new_bucket
-        # grow visited capacity if the worst-case next merge could overflow
-        need = int(np.asarray(dev_vn).max()) + D * new_bucket * C
-        if need > vcap:
-            vcap = _next_pow2(need)
-            pad = jnp.full((D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32)
-            dev_vhi = jax.device_put(jnp.concatenate([dev_vhi, pad], axis=1), shard1)
-            dev_vlo = jax.device_put(jnp.concatenate([dev_vlo, pad], axis=1), shard1)
+        pending = [
+            np.concatenate(next_pending[d])
+            if next_pending[d]
+            else np.empty((0, K), np.uint32)
+            for d in range(D)
+        ]
+        if store_trace:
+            trace_store.append(
+                (
+                    np.concatenate(pending)
+                    if n_new
+                    else np.empty((0, K), np.uint32),
+                    np.concatenate(
+                        [x for lst in next_parent for x in lst]
+                        or [np.empty(0, np.int64)]
+                    ),
+                    np.concatenate(
+                        [x for lst in next_act for x in lst]
+                        or [np.empty(0, np.int64)]
+                    ),
+                )
+            )
 
     if violation is None and cut and model.invariants:
         # cutoff left the last frontier unexpanded — run its invariant pass
-        fr = np.asarray(dev_frontier)
-        fv = np.asarray(dev_fvalid)
-        rows = fr[fv]
+        # (shard-major order matches trace_store's level layout)
+        rows = np.concatenate(pending) if pending else np.empty((0, K), np.uint32)
         if rows.shape[0]:
             st = jax.vmap(spec.unpack)(jnp.asarray(rows))
             for inv in model.invariants:
                 ok = np.asarray(jax.vmap(inv.pred)(st))
                 if not ok.all():
                     idx = int(np.argmax(~ok))
-                    dec = {
-                        k: np.asarray(v)
-                        for k, v in spec.unpack(jnp.asarray(rows[idx])).items()
-                    }
-                    violation = Violation(
-                        invariant=inv.name,
-                        depth=depth,
-                        state=model.decode(dec) if model.decode else dec,
-                        trace=[],
-                    )
+                    if store_trace:
+                        violation = build_violation(inv.name, depth, idx)
+                    else:
+                        violation = Violation(
+                            invariant=inv.name,
+                            depth=depth,
+                            state=decode_row(rows[idx]),
+                            trace=[],
+                        )
                     break
 
     dt = time.perf_counter() - t0

@@ -80,6 +80,12 @@ def main(argv=None):
     pc.add_argument("--max-states", type=int)
     pc.add_argument("--no-trace", action="store_true", help="skip trace storage")
     pc.add_argument("--min-bucket", type=int, default=256)
+    pc.add_argument(
+        "--chunk-size",
+        type=int,
+        default=16384,
+        help="max frontier rows per compiled step call (bounds compiles + memory)",
+    )
     pc.add_argument("--progress", action="store_true")
     pc.add_argument("--json", action="store_true")
     pc.add_argument(
@@ -155,6 +161,8 @@ def main(argv=None):
             min_bucket=args.min_bucket,
             progress=progress,
             check_deadlock=tlc_cfg.check_deadlock,
+            store_trace=not args.no_trace,
+            chunk_size=args.chunk_size,
         )
     else:
         from ..engine.bfs import check
@@ -170,6 +178,7 @@ def main(argv=None):
             check_deadlock=tlc_cfg.check_deadlock,
             stats_path=args.stats,
             visited_backend=args.visited_backend,
+            chunk_size=args.chunk_size,
         )
     _print_result(res, args.json, model_meta=model.meta)
     return 0 if res.violation is None else 1
