@@ -83,8 +83,9 @@ def main(argv=None):
     pc.add_argument(
         "--chunk-size",
         type=int,
-        default=16384,
-        help="max frontier rows per compiled step call (bounds compiles + memory)",
+        default=None,
+        help="max frontier rows per compiled step call (bounds compiles + "
+        "memory); defaults to each engine's own default",
     )
     pc.add_argument("--progress", action="store_true")
     pc.add_argument("--json", action="store_true")
@@ -109,11 +110,35 @@ def main(argv=None):
     po.add_argument("--max-depth", type=int)
     po.add_argument("--max-states", type=int)
 
+    pv = sub.add_parser(
+        "validate",
+        help="cross-check a model's action inventory against the reference "
+        "TLA+ module's Next disjuncts (structural front-end)",
+    )
+    pv.add_argument("cfg")
+    pv.add_argument("--module")
+    pv.add_argument("--reference", default="/root/reference")
+
     args = p.parse_args(argv)
     from pathlib import Path
 
     module = args.module or Path(args.cfg).stem
     tlc_cfg = parse_cfg(args.cfg)
+
+    if args.cmd == "validate":
+        from .tla_frontend import validate_model
+
+        model = build_model(module, tlc_cfg)
+        problems = validate_model(model, args.reference, module)
+        if problems:
+            for pr in problems:
+                print(f"MISMATCH: {pr}")
+            return 1
+        print(
+            f"{module}: {len(model.actions)} actions match the reference "
+            f"Next disjuncts exactly."
+        )
+        return 0
 
     if args.cmd == "oracle":
         from ..oracle.interp import oracle_bfs
@@ -151,6 +176,7 @@ def main(argv=None):
         def progress(depth, new_n, total):
             print(f"  level {depth}: {new_n} new, {total} total", file=sys.stderr)
 
+    chunk_kw = {} if args.chunk_size is None else {"chunk_size": args.chunk_size}
     if args.sharded:
         from ..parallel.sharded import check_sharded
 
@@ -162,7 +188,7 @@ def main(argv=None):
             progress=progress,
             check_deadlock=tlc_cfg.check_deadlock,
             store_trace=not args.no_trace,
-            chunk_size=args.chunk_size,
+            **chunk_kw,
         )
     else:
         from ..engine.bfs import check
@@ -178,7 +204,7 @@ def main(argv=None):
             check_deadlock=tlc_cfg.check_deadlock,
             stats_path=args.stats,
             visited_backend=args.visited_backend,
-            chunk_size=args.chunk_size,
+            **chunk_kw,
         )
     _print_result(res, args.json, model_meta=model.meta)
     return 0 if res.violation is None else 1

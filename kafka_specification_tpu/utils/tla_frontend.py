@@ -1,0 +1,166 @@
+"""TLA+ structural front-end: parse module structure, validate the models.
+
+The corpus is only 10 modules, so the kernel layer is hand-translated with
+file:line citations (SURVEY.md §7 step 2 explicitly defers a full TLA+
+expression parser).  What this module provides is the *auditable* half of a
+front-end: a tokenizer/parser for TLA+ module structure —
+
+    module name, EXTENDS, CONSTANTS, VARIABLES,
+    top-level operator definitions (`Name == ...` / `Name(args) == ...`),
+    the disjunct list of each `Next` definition,
+    INSTANCE ... WITH substitutions,
+
+— plus `validate_model`, which cross-checks a tensor model's action list
+against the `Next` disjuncts of the reference module it claims to implement
+(following the EXTENDS chain for inherited definitions).  This runs in the
+test suite against /root/reference, so any drift between the reference corpus
+and the hand-translated kernels is caught mechanically.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class TlaModule:
+    name: str
+    extends: list = field(default_factory=list)
+    constants: list = field(default_factory=list)
+    variables: list = field(default_factory=list)
+    definitions: dict = field(default_factory=dict)  # name -> body text
+    instances: dict = field(default_factory=dict)  # alias -> (module, {subs})
+
+
+_COMMENT_BLOCK = re.compile(r"\(\*.*?\*\)", re.S)
+_COMMENT_LINE = re.compile(r"\\\*.*")
+_MODULE_HEAD = re.compile(r"-{4,}\s*MODULE\s+(\w+)\s*-{4,}")
+_DEF_HEAD = re.compile(
+    r"^(?:LOCAL\s+)?(\w+)(?:\((.*?)\))?\s*==", re.M
+)
+_INSTANCE = re.compile(
+    r"^(?:LOCAL\s+)?(\w+)\s*==\s*INSTANCE\s+(\w+)(?:\s+WITH\s+(.*))?", re.M
+)
+
+
+def parse_tla(path_or_text) -> TlaModule:
+    text = (
+        Path(path_or_text).read_text()
+        if isinstance(path_or_text, Path)
+        or ("\n" not in str(path_or_text) and Path(str(path_or_text)).exists())
+        else str(path_or_text)
+    )
+    text = _COMMENT_BLOCK.sub("", text)
+    text = _COMMENT_LINE.sub("", text)
+
+    m = _MODULE_HEAD.search(text)
+    if not m:
+        raise ValueError("no MODULE header found")
+    mod = TlaModule(name=m.group(1))
+    body = text[m.end() :].split("====")[0]
+
+    ext = re.search(r"\bEXTENDS\s+([\w,\s]+?)(?:\n\s*\n|\n(?=\S))", body)
+    if ext:
+        mod.extends = [x.strip() for x in ext.group(1).split(",") if x.strip()]
+
+    for kw, target in (("CONSTANTS?", mod.constants), ("VARIABLES?", mod.variables)):
+        km = re.search(rf"\b{kw}\b\s*((?:\w+\s*,\s*)*\w+)", body)
+        if km:
+            target.extend(
+                x.strip() for x in km.group(1).replace("\n", " ").split(",") if x.strip()
+            )
+
+    # top-level definitions: find each `Name ==` at line start, body runs to
+    # the next definition head
+    heads = [(m.start(), m.group(1)) for m in _DEF_HEAD.finditer(body)]
+    for (start, name), nxt in zip(heads, heads[1:] + [(len(body), None)]):
+        mod.definitions[name] = body[start : nxt[0]]
+
+    for im in _INSTANCE.finditer(body):
+        alias, target, withs = im.group(1), im.group(2), im.group(3) or ""
+        subs = dict(re.findall(r"(\w+)\s*<-\s*(\w+)", withs))
+        mod.instances[alias] = (target, subs)
+        mod.definitions.pop(alias, None)
+
+    return mod
+
+
+def next_disjuncts(mod: TlaModule, name: str = "Next", known: set | None = None) -> list[str]:
+    """Action operator names of a Next definition.
+
+    Primary form: top-level disjuncts `\\/ Name` (all Kafka-family variants).
+    Fallback for quantified bodies (`Next == \\E x \\in S : Action(x)`, as in
+    IdSequence/FiniteReplicatedLog): every applied/bare operator name in the
+    body that is a known module definition, in order of first appearance.
+    """
+    body = mod.definitions.get(name)
+    if body is None:
+        raise KeyError(f"{mod.name} has no definition {name}")
+    body = body.split("==", 1)[1]
+    names = re.findall(r"\\/\s*(\w+)", body)
+    if names:
+        return names
+    known = known if known is not None else set(mod.definitions)
+    known = known - {name}
+    out = []
+    # applications only — bare known names in quantifier domains (`\in IdSet`)
+    # are value operators, not actions
+    for tok in re.findall(r"\b(\w+)\s*\(", body):
+        if tok in known and tok not in out:
+            out.append(tok)
+    return out
+
+
+def load_chain(ref_dir, module: str) -> dict[str, TlaModule]:
+    """Parse `module` and its EXTENDS ancestors from ref_dir."""
+    ref_dir = Path(ref_dir)
+    seen: dict[str, TlaModule] = {}
+
+    def visit(name):
+        if name in seen or not (ref_dir / f"{name}.tla").exists():
+            return
+        m = parse_tla(ref_dir / f"{name}.tla")
+        seen[name] = m
+        for e in m.extends:
+            visit(e)
+
+    visit(module)
+    return seen
+
+
+def defined_names(chain: dict[str, TlaModule]) -> set[str]:
+    out = set()
+    for m in chain.values():
+        out.update(m.definitions)
+    return out
+
+
+def validate_model(model, ref_dir, module: str) -> list[str]:
+    """Cross-check a tensor model's actions against the reference module's
+    Next disjuncts.  Returns a list of discrepancy strings (empty = clean).
+
+    The model's action names must be exactly the reference Next disjuncts
+    (order preserved is not required by TLC semantics and not enforced);
+    every disjunct must resolve to a definition somewhere in the EXTENDS
+    chain.
+    """
+    chain = load_chain(ref_dir, module)
+    if module not in chain:
+        return [f"reference module {module} not found under {ref_dir}"]
+    names = defined_names(chain)
+    disjuncts = next_disjuncts(chain[module], known=names)
+    problems = []
+    for d in disjuncts:
+        if d not in names:
+            problems.append(f"Next disjunct {d} has no definition in the chain")
+    model_actions = [a.name for a in model.actions]
+    if sorted(model_actions) != sorted(disjuncts):
+        missing = set(disjuncts) - set(model_actions)
+        extra = set(model_actions) - set(disjuncts)
+        if missing:
+            problems.append(f"model lacks reference actions: {sorted(missing)}")
+        if extra:
+            problems.append(f"model has non-reference actions: {sorted(extra)}")
+    return problems

@@ -46,11 +46,15 @@ def test_sharded_on_mesh_subset():
 
 
 def test_sharded_chunked_levels_exact_count():
-    """Tiny chunk_size forces multiple step calls per level across the mesh;
-    counts must still be exact (cross-chunk dedup via per-shard visited)."""
-    res = check_sharded(frl.make_model(3, 4, 1), min_bucket=8, chunk_size=8)
+    """chunk_size well below the peak per-shard frontier (FRL(3,4,2) peaks at
+    ~1k rows/shard; the floor clamp is 32) forces several step calls per
+    level; counts must still be exact (cross-chunk dedup via the per-shard
+    visited sets)."""
+    res = check_sharded(
+        frl.make_model(3, 4, 2), min_bucket=8, chunk_size=128, store_trace=False
+    )
     assert res.ok
-    assert res.total == 125
+    assert res.total == 29791
     assert res.diameter == 12
 
 

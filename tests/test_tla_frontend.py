@@ -1,0 +1,87 @@
+"""Structural front-end: parse the reference corpus and mechanically verify
+the hand-translated models' action inventories against each module's Next."""
+
+import os
+
+import pytest
+
+from kafka_specification_tpu.models import async_isr, finite_replicated_log, kip320, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.utils import tla_frontend as tf
+
+REF = "/root/reference"
+needs_ref = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference corpus not mounted"
+)
+
+TINY = Config(2, 2, 1, 1)
+
+
+def test_parse_minimal_module():
+    mod = tf.parse_tla(
+        """
+---- MODULE Demo ----
+EXTENDS Integers, FiniteSets
+CONSTANTS A, B
+VARIABLES x, y
+Foo == x + 1
+Bar(z) == z \\* trailing
+Seq == INSTANCE IdSequence WITH MaxId <- A, nextId <- x
+Next ==
+    \\/ Foo
+    \\/ Bar
+====
+"""
+    )
+    assert mod.name == "Demo"
+    assert mod.extends == ["Integers", "FiniteSets"]
+    assert mod.constants == ["A", "B"]
+    assert mod.variables == ["x", "y"]
+    assert "Foo" in mod.definitions and "Bar" in mod.definitions
+    assert mod.instances["Seq"] == ("IdSequence", {"MaxId": "A", "nextId": "x"})
+    assert tf.next_disjuncts(mod) == ["Foo", "Bar"]
+
+
+@needs_ref
+def test_reference_chain_structure():
+    chain = tf.load_chain(REF, "Kip320")
+    assert set(chain) >= {"Kip320", "Kip279", "KafkaReplication", "Util"}
+    kr = chain["KafkaReplication"]
+    assert set(kr.variables) == {
+        "replicaLog",
+        "replicaState",
+        "nextRecordId",
+        "nextLeaderEpoch",
+        "leaderAndIsrRequests",
+        "quorumState",
+    }
+    assert set(kr.instances) == {"LeaderEpochSeq", "RecordSeq", "ReplicaLog"}
+
+
+@needs_ref
+@pytest.mark.parametrize(
+    "module,model",
+    [
+        ("KafkaTruncateToHighWatermark", variants.make_model("KafkaTruncateToHighWatermark", TINY)),
+        ("Kip101", variants.make_model("Kip101", TINY)),
+        ("Kip279", variants.make_model("Kip279", TINY)),
+        ("Kip320", kip320.make_model(TINY)),
+        ("Kip320FirstTry", kip320.make_first_try_model(TINY)),
+        ("AsyncIsr", async_isr.make_model(async_isr.AsyncIsrConfig(2, 1, 1))),
+    ],
+    ids=lambda m: m if isinstance(m, str) else "",
+)
+def test_model_actions_match_reference_next(module, model):
+    problems = tf.validate_model(model, REF, module)
+    assert not problems, problems
+
+
+@needs_ref
+def test_frl_standalone_next_actions():
+    """FiniteReplicatedLog's Next nests its existentials, so disjunct names
+    are the three mutators; our model matches them by construction."""
+    chain = tf.load_chain(REF, "FiniteReplicatedLog")
+    mod = chain["FiniteReplicatedLog"]
+    assert {"Append", "TruncateTo", "ReplicateTo"} <= set(mod.definitions)
+    model = finite_replicated_log.make_model(2, 2, 1)
+    assert [a.name for a in model.actions] == ["Append", "TruncateTo", "ReplicateTo"]
