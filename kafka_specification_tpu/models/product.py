@@ -17,6 +17,7 @@ prefix; kernels are lifted by slicing the partition's sub-state in and out.
 
 from __future__ import annotations
 
+from ..oracle.interp import OracleAction, OracleModel
 from ..ops.packing import Field, StateSpec
 from .base import Action, Invariant, Model
 
@@ -42,10 +43,15 @@ def product_model(base: Model, k: int, name: str | None = None) -> Model:
         return out
 
     def init_states():
+        # K independent instances: the init set is the k-fold cross product
+        # (every corpus model has one deterministic init, but the combinator
+        # must not silently drop mixed-init tuples for bases that don't)
+        import itertools
+
         outs = []
-        for binit in base.init_states():
+        for combo in itertools.product(base.init_states(), repeat=k):
             s = {}
-            for p in range(k):
+            for p, binit in enumerate(combo):
                 for key, v in binit.items():
                     s[f"p{p}.{key}"] = v
             outs.append(s)
@@ -97,4 +103,42 @@ def product_model(base: Model, k: int, name: str | None = None) -> Model:
         constraint=constraint,
         decode=decode,
         meta={**base.meta, "partitions": k, "base": base.name},
+    )
+
+
+def product_oracle(base: OracleModel, k: int) -> OracleModel:
+    """Oracle twin of product_model: state = k-tuple of base states; each
+    action steps one partition.  Canonical form matches product_model's
+    decode (a tuple of per-partition decodes)."""
+    assert k >= 1
+
+    def init():
+        import itertools
+
+        return [tuple(c) for c in itertools.product(base.init_states(), repeat=k)]
+
+    actions = []
+    for p in range(k):
+        for a in base.actions:
+            def succ(s, p=p, a=a):
+                for t in a.successors(s[p]):
+                    yield s[:p] + (t,) + s[p + 1 :]
+
+            actions.append(OracleAction(f"p{p}.{a.name}", succ))
+
+    invariants = [
+        (name, lambda s, pred=pred: all(pred(x) for x in s))
+        for name, pred in base.invariants
+    ]
+    constraint = None
+    if base.constraint is not None:
+        def constraint(s):
+            return all(base.constraint(x) for x in s)
+
+    return OracleModel(
+        name=f"{base.name} x{k}partitions",
+        init_states=init,
+        actions=actions,
+        invariants=invariants,
+        constraint=constraint,
     )

@@ -150,14 +150,25 @@ def build_model(module: str, cfg: TlcConfig, oracle: bool = False):
         if module in KAFKA_VARIANTS:
             from ..models import variants as m
 
-            return (m.make_oracle if oracle else m.make_model)(module, kcfg, invs)
-        from ..models import kip320 as m
+            built = (m.make_oracle if oracle else m.make_model)(module, kcfg, invs)
+        else:
+            from ..models import kip320 as m
 
-        if module == "Kip320":
-            return (m.make_oracle if oracle else m.make_model)(kcfg, invs)
-        return (m.make_first_try_oracle if oracle else m.make_first_try_model)(
-            kcfg, invs
-        )
+            if module == "Kip320":
+                built = (m.make_oracle if oracle else m.make_model)(kcfg, invs)
+            else:
+                built = (
+                    m.make_first_try_oracle if oracle else m.make_first_try_model
+                )(kcfg, invs)
+        # Partitions = K (authored constant, not in the reference): the
+        # K-partition product space — the reading of the "5 brokers /
+        # 3 partitions" stretch workload (BASELINE.md note; models/product.py)
+        k = _setlen(c.get("Partitions", 1))
+        if k > 1:
+            from ..models.product import product_model, product_oracle
+
+            built = (product_oracle if oracle else product_model)(built, k)
+        return built
     if module == "AsyncIsr":
         from ..models import async_isr as m
 

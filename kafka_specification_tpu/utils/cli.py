@@ -102,6 +102,11 @@ def main(argv=None):
         help="fingerprint set location: device HBM (fast) or the native "
         "C++ host FpSet (spill mode for huge state spaces)",
     )
+    pc.add_argument(
+        "--profile",
+        metavar="DIR",
+        help="wrap the run in a jax.profiler trace (TensorBoard format)",
+    )
     pc.add_argument("--cpu", action="store_true", help="force the CPU platform")
 
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
@@ -128,6 +133,10 @@ def main(argv=None):
     if args.cmd == "validate":
         from .tla_frontend import validate_model
 
+        # validate the base (single-partition) model: Partitions is an
+        # authored product-space constant with no reference counterpart,
+        # and the combinator renames actions to p<k>.<Name>
+        tlc_cfg.constants.pop("Partitions", None)
         model = build_model(module, tlc_cfg)
         problems = validate_model(model, args.reference, module)
         if problems:
@@ -176,7 +185,21 @@ def main(argv=None):
         def progress(depth, new_n, total):
             print(f"  level {depth}: {new_n} new, {total} total", file=sys.stderr)
 
+    import contextlib
+
+    prof = contextlib.nullcontext()
+    if args.profile:
+        import jax
+
+        prof = jax.profiler.trace(args.profile)
     chunk_kw = {} if args.chunk_size is None else {"chunk_size": args.chunk_size}
+    with prof:
+        res = _run_engine(args, model, tlc_cfg, progress, chunk_kw)
+    _print_result(res, args.json, model_meta=model.meta)
+    return 0 if res.violation is None else 1
+
+
+def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
     if args.sharded:
         from ..parallel.sharded import check_sharded
 
@@ -206,8 +229,7 @@ def main(argv=None):
             visited_backend=args.visited_backend,
             **chunk_kw,
         )
-    _print_result(res, args.json, model_meta=model.meta)
-    return 0 if res.violation is None else 1
+    return res
 
 
 if __name__ == "__main__":

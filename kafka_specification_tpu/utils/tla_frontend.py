@@ -99,7 +99,14 @@ def next_disjuncts(mod: TlaModule, name: str = "Next", known: set | None = None)
     if body is None:
         raise KeyError(f"{mod.name} has no definition {name}")
     body = body.split("==", 1)[1]
-    names = re.findall(r"\\/\s*(\w+)", body)
+    # top-level disjuncts: plain `\/ Name` or quantified
+    # `\/ \E x \in S, ... : Name(args)` (mixed forms supported)
+    names = [
+        m.group(1) or m.group(2)
+        for m in re.finditer(
+            r"\\/\s*(?:(\w+)|\\E[^:]*:\s*(\w+)\s*\()", body
+        )
+    ]
     if names:
         return names
     known = known if known is not None else set(mod.definitions)

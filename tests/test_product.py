@@ -3,41 +3,9 @@
 from kafka_specification_tpu.engine.bfs import check
 from kafka_specification_tpu.models import id_sequence, kip320
 from kafka_specification_tpu.models.kafka_replication import Config
-from kafka_specification_tpu.models.product import product_model
-from kafka_specification_tpu.oracle.interp import (
-    OracleAction,
-    OracleModel,
-    oracle_bfs,
-)
+from kafka_specification_tpu.models.product import product_model, product_oracle
 
 from helpers import assert_matches_oracle
-
-
-def _product_oracle(base, k):
-    """Generic oracle product for cross-checking the combinator."""
-
-    def init():
-        outs = []
-        for s in base.init_states():
-            outs.append((s,) * k)
-        return outs
-
-    actions = []
-    for p in range(k):
-        for a in base.actions:
-            def succ(s, p=p, a=a):
-                for t in a.successors(s[p]):
-                    yield s[:p] + (t,) + s[p + 1 :]
-
-            actions.append(OracleAction(f"p{p}.{a.name}", succ))
-
-    invariants = [
-        (name, lambda s, pred=pred: all(pred(x) for x in s))
-        for name, pred in base.invariants
-    ]
-    return OracleModel(
-        name=f"{base.name}-x{k}", init_states=init, actions=actions, invariants=invariants
-    )
 
 
 def test_product_idsequence_matches_generic_oracle():
@@ -45,7 +13,7 @@ def test_product_idsequence_matches_generic_oracle():
     base = id_sequence.make_model(2)
     model = product_model(base, k)
     obase = id_sequence.make_oracle(2)
-    oracle = _product_oracle(obase, k)
+    oracle = product_oracle(obase, k)
     res, ores = assert_matches_oracle(model, oracle)
     assert res.ok
     assert res.total == 4**k  # |base|^k reachable product states

@@ -85,3 +85,18 @@ def test_frl_standalone_next_actions():
     assert {"Append", "TruncateTo", "ReplicateTo"} <= set(mod.definitions)
     model = finite_replicated_log.make_model(2, 2, 1)
     assert [a.name for a in model.actions] == ["Append", "TruncateTo", "ReplicateTo"]
+
+def test_next_disjuncts_mixed_plain_and_quantified():
+    mod = tf.parse_tla(
+        """
+---- MODULE Mixed ----
+VARIABLES x
+Simple == x' = x
+Quantified(r) == x' = r
+Next ==
+    \\/ Simple
+    \\/ \\E r \\in {1, 2} : Quantified(r)
+====
+"""
+    )
+    assert tf.next_disjuncts(mod) == ["Simple", "Quantified"]
