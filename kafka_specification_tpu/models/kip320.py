@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ..oracle.interp import OracleAction, OracleModel
 from .base import Action, Model
 from . import kafka_replication as kr
-from .kafka_replication import NIL, NONE, Config, _bit, _member, _forall_isr
+from .kafka_replication import NONE, Config, _bit, _member, _forall_isr
 from .variants import _invariant_kernels, _invariant_oracles, DEFAULT_INVARIANTS
 
 

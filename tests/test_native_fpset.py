@@ -1,12 +1,11 @@
 """Native C++ FpSet and the engine's host visited-set backend."""
 
 import numpy as np
-import pytest
 
 from kafka_specification_tpu.native import FpSet, native_available
 from kafka_specification_tpu.engine.bfs import check
 from kafka_specification_tpu.models import finite_replicated_log as frl
-from kafka_specification_tpu.models import kip320, variants
+from kafka_specification_tpu.models import variants
 from kafka_specification_tpu.models.kafka_replication import Config
 
 

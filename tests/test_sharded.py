@@ -3,7 +3,6 @@ single-device engine / oracle golden values, violations must be detected."""
 
 import jax
 import numpy as np
-import pytest
 from jax.sharding import Mesh
 
 from kafka_specification_tpu.parallel.sharded import check_sharded
@@ -62,8 +61,6 @@ def test_sharded_violation_trace_is_valid_path():
     """The sharded engine reconstructs full counterexample traces across
     chunks and shards; the trace must replay through the oracle semantics
     and end in the violating state."""
-    from kafka_specification_tpu.oracle.interp import oracle_bfs
-
     m = variants.make_model(
         "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("TypeOk", "WeakIsr")
     )

@@ -42,3 +42,15 @@ def test_kip320_three_broker_exhaustive_pass():
     assert res.ok
     assert res.total == 737_794
     assert res.diameter == 25
+
+
+def test_kip320_first_try_strong_isr_only():
+    """The canonical rejected-design claim (Kip320FirstTry.tla:27-39): with
+    only StrongIsr checked, the violation surfaces at depth 12 after 284,803
+    states (oracle-pinned)."""
+    m = kip320.make_first_try_model(THREE, invariants=("StrongIsr",))
+    res = check(m, min_bucket=2048, chunk_size=16384, store_trace=False)
+    assert res.violation is not None
+    assert res.violation.invariant == "StrongIsr"
+    assert res.violation.depth == 12
+    assert res.total == 284_803
