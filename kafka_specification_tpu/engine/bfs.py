@@ -71,10 +71,17 @@ class _Step:
     """Builds and caches the jitted level step for one model."""
 
     def __init__(self, model: Model):
+        import os
+
         self.model = model
         self.spec = model.spec
         self.K = self.spec.num_lanes
         self.C = model.total_fanout
+        # opt-in Pallas fingerprint kernel (hashed mode only; bit-identical
+        # to the jnp path — see ops/pallas_fingerprint.py)
+        self.use_pallas = (
+            os.environ.get("KSPEC_USE_PALLAS") == "1" and not self.spec.exact64
+        )
         # global action id per flattened choice column
         act_ids = np.concatenate(
             [np.full(a.n_choices, i, np.int32) for i, a in enumerate(model.actions)]
@@ -155,10 +162,19 @@ class _Step:
             cand = packed.reshape(M, K)
             valid = en.reshape(M)
 
-            hi, lo = fingerprint_lanes(cand, spec.exact64)
             sent = jnp.uint32(dedup.SENT)
-            hi = jnp.where(valid, hi, sent)
-            lo = jnp.where(valid, lo, sent)
+            if self.use_pallas:
+                from ..ops.pallas_fingerprint import fingerprint_pallas
+
+                interp = jax.default_backend() == "cpu"
+                block = C * min(bucket, 256)
+                hi, lo = fingerprint_pallas(
+                    cand, valid, block_rows=block, interpret=interp
+                )
+            else:
+                hi, lo = fingerprint_lanes(cand, spec.exact64)
+                hi = jnp.where(valid, hi, sent)
+                lo = jnp.where(valid, lo, sent)
             # minimal-payload sort: only the original index rides through the
             # sort network; state rows/parents are gathered once afterwards
             order = jnp.lexsort((lo, hi))
@@ -256,6 +272,7 @@ def check(
     progress=None,
     collect_levels: Optional[list] = None,
     checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
     check_deadlock: bool = False,
     stats_path: Optional[str] = None,
     visited_backend: str = "device",
@@ -397,9 +414,13 @@ def check(
     result_stats: dict = {}
     collect_stats = stats_path is not None
 
-    # identity stamp: a checkpoint may only resume the same model+constants
+    # identity stamp: a checkpoint may only resume the same model, constants,
+    # invariant selection, and deadlock setting (a resume never re-checks
+    # already-explored levels, so a stricter check must start fresh)
+    inv_names = ",".join(sorted(i.name for i in model.invariants)) if check_invariants else "-"
     ckpt_ident = (
         f"{model.name}|lanes={spec.num_lanes}|backend={visited_backend}|"
+        f"inv={inv_names}|dl={check_deadlock}|"
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
     )
     if ckpt_path is not None:
@@ -434,7 +455,8 @@ def check(
             if host_set is not None
             else {"vhi": np.asarray(vhi), "vlo": np.asarray(vlo), "vn": int(vn)}
         )
-        np.savez_compressed(
+        # uncompressed: fingerprints are high-entropy, zlib only burns time
+        np.savez(
             ckpt_path + ".tmp.npz",
             ident=ckpt_ident,
             frontier=frontier_np,
@@ -586,7 +608,7 @@ def check(
             progress(depth, new_n, total)
 
         frontier_np = next_frontier
-        if ckpt_path is not None:
+        if ckpt_path is not None and depth % checkpoint_every == 0:
             _save_checkpoint()
 
     if violation is None and check_invariants and model.invariants and frontier_np.shape[0]:

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 # the default JAX backend at import time
 _C1 = 0xCC9E2D51
 _C2 = 0x1B873593
+SEED_HI = 0x9747B28C
+SEED_LO = 0x3C6EF372
 
 
 def _rotl32(x, r):
@@ -56,6 +58,6 @@ def fingerprint_lanes(lanes: jnp.ndarray, exact: bool) -> tuple[jnp.ndarray, jnp
         lo = lanes[..., 0]
         hi = lanes[..., 1] if k > 1 else jnp.zeros_like(lo)
         return hi, lo
-    hi = _murmur3_lanes(lanes, 0x9747B28C)
-    lo = _murmur3_lanes(lanes, 0x3C6EF372)
+    hi = _murmur3_lanes(lanes, SEED_HI)
+    lo = _murmur3_lanes(lanes, SEED_LO)
     return hi, lo

@@ -150,6 +150,8 @@ def check_sharded(
     check_deadlock: bool = False,
     chunk_size: int = 16384,
     store_trace: bool = True,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -158,6 +160,11 @@ def check_sharded(
     kept on the host in shard-major discovery order, and a violation is
     reported with the full parent-pointer counterexample path; disable for
     pure-throughput runs at pod scale.
+
+    checkpoint_dir: level-synchronous checkpoint/resume — persists the
+    per-shard pending frontiers and fingerprint shards after every level;
+    a run restarts from the last saved level (store_trace forced off, as in
+    engine.check).  A checkpoint binds to (model, constants, mesh size).
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
@@ -224,16 +231,71 @@ def check_sharded(
     pending = [init_packed[owner0 == d] for d in range(D)]
     chunk = _next_pow2(max(32, chunk_size))
 
-    shard1 = NamedSharding(mesh, P("d"))
-    dev_vhi = jax.device_put(vhi, shard1)
-    dev_vlo = jax.device_put(vlo, shard1)
-    dev_vn = jax.device_put(vn, shard1)
-
     levels = [n0]
     total = n0
     depth = 0
     violation = None
     steps = {}
+
+    ckpt_path = None
+    inv_names = ",".join(sorted(i.name for i in model.invariants))
+    ckpt_ident = (
+        f"{model.name}|lanes={spec.num_lanes}|D={D}|"
+        f"inv={inv_names}|dl={check_deadlock}|"
+        + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
+    )
+    if checkpoint_dir is not None:
+        import os
+
+        store_trace = False
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(checkpoint_dir, "sharded_checkpoint.npz")
+        if os.path.exists(ckpt_path):
+            snap = np.load(ckpt_path)
+            found = str(snap["ident"]) if "ident" in snap else "<none>"
+            if found != ckpt_ident:
+                raise ValueError(
+                    f"checkpoint at {ckpt_path} was written by a different "
+                    f"model/config/mesh:\n  checkpoint: {found}\n"
+                    f"  this run:   {ckpt_ident}"
+                )
+            plens = snap["pending_lens"]
+            flat = snap["pending"]
+            pending, at = [], 0
+            for ln in plens:
+                pending.append(flat[at : at + int(ln)])
+                at += int(ln)
+            vcap = int(snap["vcap"])
+            vhi, vlo, vn = snap["vhi"], snap["vlo"], snap["vn"]
+            levels = snap["levels"].tolist()
+            total = int(snap["total"])
+            depth = int(snap["depth"])
+
+    shard1 = NamedSharding(mesh, P("d"))
+    dev_vhi = jax.device_put(vhi, shard1)
+    dev_vlo = jax.device_put(vlo, shard1)
+    dev_vn = jax.device_put(vn, shard1)
+
+    def _save_checkpoint():
+        import os
+
+        # uncompressed: fingerprints are high-entropy, zlib only burns time
+        np.savez(
+            ckpt_path + ".tmp.npz",
+            ident=ckpt_ident,
+            pending=np.concatenate(pending)
+            if any(p.shape[0] for p in pending)
+            else np.empty((0, K), np.uint32),
+            pending_lens=np.asarray([p.shape[0] for p in pending]),
+            vhi=np.asarray(dev_vhi),
+            vlo=np.asarray(dev_vlo),
+            vn=np.asarray(dev_vn),
+            vcap=vcap,
+            levels=np.asarray(levels),
+            total=total,
+            depth=depth,
+        )
+        os.replace(ckpt_path + ".tmp.npz", ckpt_path)
 
     def decode_row(row):
         st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
@@ -382,6 +444,8 @@ def check_sharded(
             else np.empty((0, K), np.uint32)
             for d in range(D)
         ]
+        if ckpt_path is not None and depth % checkpoint_every == 0:
+            _save_checkpoint()
         if store_trace:
             trace_store.append(
                 (

@@ -211,6 +211,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
             progress=progress,
             check_deadlock=tlc_cfg.check_deadlock,
             store_trace=not args.no_trace,
+            checkpoint_dir=args.checkpoint,
             **chunk_kw,
         )
     else:

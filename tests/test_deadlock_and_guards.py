@@ -59,3 +59,16 @@ def test_constraint_rejected_for_non_asyncisr():
     cfg = parse_cfg("CONSTANTS\n MaxId = 3\nCONSTRAINT Bound\n")
     with pytest.raises(ValueError, match="CONSTRAINT"):
         build_model("IdSequence", cfg)
+
+
+def test_checkpoint_rejects_different_invariant_selection(tmp_path):
+    """A resume never re-checks already-explored levels, so a checkpoint must
+    bind to the invariant selection (review finding)."""
+    ckdir = str(tmp_path / "ck")
+    m0 = variants.make_model("KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ())
+    check(m0, max_depth=2, min_bucket=32, checkpoint_dir=ckdir)
+    m1 = variants.make_model(
+        "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("WeakIsr",)
+    )
+    with pytest.raises(ValueError, match="different"):
+        check(m1, min_bucket=32, checkpoint_dir=ckdir)

@@ -79,3 +79,25 @@ def test_sharded_violation_trace_is_valid_path():
     for name, nxt in v.trace[1:]:
         assert nxt in set(actions[name].successors(cur)), name
         cur = nxt
+
+
+def test_sharded_checkpoint_resume(tmp_path):
+    ckdir = str(tmp_path / "sck")
+    m = frl.make_model(2, 2, 2)
+    partial = check_sharded(m, max_depth=2, min_bucket=32, checkpoint_dir=ckdir)
+    assert partial.total < 49
+    resumed = check_sharded(m, min_bucket=32, checkpoint_dir=ckdir)
+    assert resumed.ok
+    assert resumed.total == 49
+
+
+def test_sharded_checkpoint_rejects_other_mesh_or_model(tmp_path):
+    import pytest as _pytest
+
+    ckdir = str(tmp_path / "sck")
+    check_sharded(frl.make_model(2, 2, 2), max_depth=1, min_bucket=32, checkpoint_dir=ckdir)
+    with _pytest.raises(ValueError, match="different"):
+        check_sharded(frl.make_model(2, 3, 2), min_bucket=32, checkpoint_dir=ckdir)
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
+    with _pytest.raises(ValueError, match="different"):
+        check_sharded(frl.make_model(2, 2, 2), mesh=mesh4, min_bucket=32, checkpoint_dir=ckdir)
