@@ -25,3 +25,38 @@ Layout:
 """
 
 __version__ = "0.1.0"
+
+
+def check(*args, **kwargs):
+    """Single-device exhaustive check (see engine.bfs.check)."""
+    from .engine.bfs import check as _check
+
+    return _check(*args, **kwargs)
+
+
+def check_sharded(*args, **kwargs):
+    """Mesh-sharded exhaustive check (see parallel.sharded.check_sharded)."""
+    from .parallel.sharded import check_sharded as _check_sharded
+
+    return _check_sharded(*args, **kwargs)
+
+
+def oracle_bfs(*args, **kwargs):
+    """Pure-Python reference interpreter (see oracle.interp.oracle_bfs)."""
+    from .oracle.interp import oracle_bfs as _oracle_bfs
+
+    return _oracle_bfs(*args, **kwargs)
+
+
+def load_config(path):
+    """Parse a TLC .cfg file (see utils.cfg.parse_cfg)."""
+    from .utils.cfg import parse_cfg
+
+    return parse_cfg(path)
+
+
+def build_model(module, cfg, oracle=False):
+    """Instantiate a model from a TLA+ module name + parsed TLC config."""
+    from .utils.cfg import build_model as _build_model
+
+    return _build_model(module, cfg, oracle=oracle)

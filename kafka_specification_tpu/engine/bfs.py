@@ -242,6 +242,26 @@ def _pad_rows(arr: np.ndarray, n: int, fill=0):
     return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
 
 
+def atomic_savez(path: str, **arrays):
+    """np.savez to a tmp file + atomic rename (shared checkpoint writer)."""
+    import os
+
+    np.savez(path + ".tmp.npz", **arrays)
+    os.replace(path + ".tmp.npz", path)
+
+
+def load_validated_snapshot(path: str, ident: str):
+    """Load a checkpoint and verify its identity stamp (shared)."""
+    snap = np.load(path)
+    found = str(snap["ident"]) if "ident" in snap else "<none>"
+    if found != ident:
+        raise ValueError(
+            f"checkpoint at {path} was written by a different "
+            f"model/config:\n  checkpoint: {found}\n  this run:   {ident}"
+        )
+    return snap
+
+
 def walk_trace(trace_store, actions, decode_row, inv_name, depth, idx) -> Violation:
     """Parent-pointer counterexample reconstruction, shared by both engines.
 
@@ -309,11 +329,12 @@ def check(
     on runs whose state-space size is roughly known.
 
     checkpoint_dir: when set, the (visited set, frontier, level counters) are
-    persisted after every BFS level and a run restarts from the last saved
-    level if a checkpoint exists — the natural fit for a level-synchronous
-    engine (SURVEY.md §5 "Checkpoint / resume"; TLC keeps this externally).
-    Checkpointed runs don't retain parent-pointer traces across restarts, so
-    store_trace is forced off.
+    persisted every `checkpoint_every` BFS levels (default 1 = per level; a
+    crash loses at most checkpoint_every-1 levels of work) and a run restarts
+    from the last saved level if a checkpoint exists — the natural fit for a
+    level-synchronous engine (SURVEY.md §5 "Checkpoint / resume"; TLC keeps
+    this externally).  Checkpointed runs don't retain parent-pointer traces
+    across restarts, so store_trace is forced off.
     """
     spec = model.spec
     step_builder = _Step(model)
@@ -427,13 +448,7 @@ def check(
         import os
 
         if os.path.exists(ckpt_path):
-            snap = np.load(ckpt_path)
-            found = str(snap["ident"]) if "ident" in snap else "<none>"
-            if found != ckpt_ident:
-                raise ValueError(
-                    f"checkpoint at {ckpt_path} was written by a different "
-                    f"model/config:\n  checkpoint: {found}\n  this run:   {ckpt_ident}"
-                )
+            snap = load_validated_snapshot(ckpt_path, ckpt_ident)
             frontier_np = snap["frontier"]
             if host_set is not None:
                 from ..native import FpSet
@@ -442,22 +457,31 @@ def check(
                 host_set.insert(snap["host_fps"])
             else:
                 vcap = int(snap["vcap"])
-                vhi = jnp.asarray(snap["vhi"])
-                vlo = jnp.asarray(snap["vlo"])
-                vn = jnp.int32(int(snap["vn"]))
+                n = int(snap["vn"])
+                pad = np.full(vcap - n, 0xFFFFFFFF, np.uint32)
+                vhi = jnp.asarray(np.concatenate([snap["vhi"], pad]))
+                vlo = jnp.asarray(np.concatenate([snap["vlo"], pad]))
+                vn = jnp.int32(n)
             levels = snap["levels"].tolist()
             total = int(snap["total"])
             depth = int(snap["depth"])
 
     def _save_checkpoint():
+        # only the live prefix of the visited set is saved (the sentinel
+        # padding is rebuilt on resume from vcap/vn); uncompressed — live
+        # fingerprints are high-entropy and zlib only burns time
+        n = int(vn)
         extra = (
             {"host_fps": host_set.dump()}
             if host_set is not None
-            else {"vhi": np.asarray(vhi), "vlo": np.asarray(vlo), "vn": int(vn)}
+            else {
+                "vhi": np.asarray(vhi[:n]),
+                "vlo": np.asarray(vlo[:n]),
+                "vn": n,
+            }
         )
-        # uncompressed: fingerprints are high-entropy, zlib only burns time
-        np.savez(
-            ckpt_path + ".tmp.npz",
+        atomic_savez(
+            ckpt_path,
             ident=ckpt_ident,
             frontier=frontier_np,
             vcap=vcap,
@@ -466,9 +490,6 @@ def check(
             depth=depth,
             **extra,
         )
-        import os
-
-        os.replace(ckpt_path + ".tmp.npz", ckpt_path)
 
     chunk = _next_pow2(max(min_bucket, chunk_size))
 

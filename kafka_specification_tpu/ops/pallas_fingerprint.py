@@ -25,12 +25,11 @@ from .fingerprint import SEED_HI, SEED_LO, _murmur3_lanes
 from . import dedup
 
 
-def _kernel(lanes_ref, valid_ref, hi_ref, lo_ref, *, k: int):
+def _kernel(lanes_ref, valid_ref, hi_ref, lo_ref):
     # one authoritative hash implementation: the kernel body is plain jnp
     # over the VMEM-resident block, so it reuses ops.fingerprint directly
     lanes = lanes_ref[...]  # [block, K] uint32
     valid = valid_ref[...]  # [block] bool
-    del k
     sent = jnp.uint32(dedup.SENT)
     hi_ref[...] = jnp.where(valid, _murmur3_lanes(lanes, SEED_HI), sent)
     lo_ref[...] = jnp.where(valid, _murmur3_lanes(lanes, SEED_LO), sent)
@@ -47,7 +46,7 @@ def fingerprint_pallas(lanes, valid, block_rows: int = 1024, interpret: bool = F
     assert m % block_rows == 0, (m, block_rows)
     grid = (m // block_rows,)
     return pl.pallas_call(
-        functools.partial(_kernel, k=k),
+        _kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_rows, k), lambda i: (i, 0)),

@@ -30,7 +30,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..engine.bfs import CheckResult, Violation, _next_pow2, _Step, walk_trace
+from ..engine.bfs import (
+    CheckResult,
+    Violation,
+    _next_pow2,
+    _Step,
+    atomic_savez,
+    load_validated_snapshot,
+    walk_trace,
+)
 from ..models.base import Model
 from ..ops import dedup
 from ..ops.fingerprint import fingerprint_lanes
@@ -162,9 +170,11 @@ def check_sharded(
     pure-throughput runs at pod scale.
 
     checkpoint_dir: level-synchronous checkpoint/resume — persists the
-    per-shard pending frontiers and fingerprint shards after every level;
-    a run restarts from the last saved level (store_trace forced off, as in
-    engine.check).  A checkpoint binds to (model, constants, mesh size).
+    per-shard pending frontiers and fingerprint shards every
+    `checkpoint_every` levels (default 1 = per level; a crash loses at most
+    checkpoint_every-1 levels); a run restarts from the last saved level
+    (store_trace forced off, as in engine.check).  A checkpoint binds to
+    (model, constants, invariant selection, deadlock flag, mesh size).
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
@@ -251,14 +261,7 @@ def check_sharded(
         os.makedirs(checkpoint_dir, exist_ok=True)
         ckpt_path = os.path.join(checkpoint_dir, "sharded_checkpoint.npz")
         if os.path.exists(ckpt_path):
-            snap = np.load(ckpt_path)
-            found = str(snap["ident"]) if "ident" in snap else "<none>"
-            if found != ckpt_ident:
-                raise ValueError(
-                    f"checkpoint at {ckpt_path} was written by a different "
-                    f"model/config/mesh:\n  checkpoint: {found}\n"
-                    f"  this run:   {ckpt_ident}"
-                )
+            snap = load_validated_snapshot(ckpt_path, ckpt_ident)
             plens = snap["pending_lens"]
             flat = snap["pending"]
             pending, at = [], 0
@@ -266,7 +269,11 @@ def check_sharded(
                 pending.append(flat[at : at + int(ln)])
                 at += int(ln)
             vcap = int(snap["vcap"])
-            vhi, vlo, vn = snap["vhi"], snap["vlo"], snap["vn"]
+            vn = snap["vn"]
+            w = snap["vhi"].shape[1]
+            pad = np.full((D, vcap - w), 0xFFFFFFFF, np.uint32)
+            vhi = np.concatenate([snap["vhi"], pad], axis=1)
+            vlo = np.concatenate([snap["vlo"], pad], axis=1)
             levels = snap["levels"].tolist()
             total = int(snap["total"])
             depth = int(snap["depth"])
@@ -277,25 +284,22 @@ def check_sharded(
     dev_vn = jax.device_put(vn, shard1)
 
     def _save_checkpoint():
-        import os
-
-        # uncompressed: fingerprints are high-entropy, zlib only burns time
-        np.savez(
-            ckpt_path + ".tmp.npz",
+        atomic_savez(
+            ckpt_path,
             ident=ckpt_ident,
             pending=np.concatenate(pending)
             if any(p.shape[0] for p in pending)
             else np.empty((0, K), np.uint32),
             pending_lens=np.asarray([p.shape[0] for p in pending]),
-            vhi=np.asarray(dev_vhi),
-            vlo=np.asarray(dev_vlo),
+            # trim the common sentinel tail (rebuilt on resume from vcap)
+            vhi=np.asarray(dev_vhi)[:, : int(np.asarray(dev_vn).max())],
+            vlo=np.asarray(dev_vlo)[:, : int(np.asarray(dev_vn).max())],
             vn=np.asarray(dev_vn),
             vcap=vcap,
             levels=np.asarray(levels),
             total=total,
             depth=depth,
         )
-        os.replace(ckpt_path + ".tmp.npz", ckpt_path)
 
     def decode_row(row):
         st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
