@@ -100,3 +100,26 @@ def test_chunked_violation_depth_stable():
     res = check(model, min_bucket=32, chunk_size=32)
     assert res.violation is not None and res.violation.depth == 2
     assert len(res.violation.trace) == 3
+
+
+def test_multiple_initial_states():
+    """TLC enumerates all Init states; the engine must seed BFS with the
+    whole (deduplicated) init set and count level 0 accordingly."""
+    base = id_sequence.make_model(6)
+
+    def inits():
+        return [{"nextId": 0}, {"nextId": 3}, {"nextId": 3}, {"nextId": 5}]
+
+    model = Model(
+        name="IdSeq-multi-init",
+        spec=base.spec,
+        init_states=inits,
+        actions=base.actions,
+        invariants=base.invariants,
+        decode=base.decode,
+    )
+    res = check(model, min_bucket=32)
+    assert res.levels[0] == 3  # deduplicated init set
+    # reachable: 0..7 from the three seeds
+    assert res.total == 8
+    assert res.ok
