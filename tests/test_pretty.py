@@ -1,0 +1,35 @@
+"""TLA-style counterexample rendering."""
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import async_isr, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.utils.pretty import render_state, render_trace
+
+
+def test_render_kafka_trace_round_trip():
+    m = variants.make_model(
+        "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("TypeOk", "WeakIsr")
+    )
+    res = check(m, min_bucket=32)
+    text = render_trace(m.meta, res.violation.trace)
+    assert "State 1: <Initial predicate>" in text
+    assert "replicaLog" in text and "quorumState" in text
+    assert "leaderEpoch|->" in text
+    # one state block per trace step
+    import re
+
+    assert len(re.findall(r"^State \d+:", text, re.M)) == len(res.violation.trace)
+
+
+def test_render_async_isr_state():
+    cfg = async_isr.AsyncIsrConfig(2, 1, 1)
+    m = async_isr.make_model(cfg)
+    decoded = m.decode(
+        {k: __import__("numpy").asarray(v) for k, v in async_isr.init_state(cfg).items()}
+    )
+    text = render_state(m.meta, decoded)
+    assert "controllerState" in text and "pendingVersion|->-1" in text
+
+
+def test_render_unknown_falls_back_to_repr():
+    assert render_state({}, (1, 2, 3)).strip() == "(1, 2, 3)"
