@@ -60,6 +60,8 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
     D = mesh.devices.size
     act_ids = expander.act_ids
 
+    bounds = np.cumsum([0] + [a.n_choices for a in model.actions])
+
     def shard_body(frontier, fvalid, vhi, vlo, vn):
         # per-shard views: frontier [bucket, K], vhi [1, vcap], vn [1]
         vhi, vlo, vn = vhi[0], vlo[0], vn[0]
@@ -69,6 +71,12 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
         en_pre, en, packed = jax.vmap(expander._expand_one)(states)
         deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
         en = en & fvalid[:, None]
+        act_en = jnp.stack(
+            [
+                jnp.sum(en[:, bounds[i] : bounds[i + 1]], dtype=jnp.int32)
+                for i in range(len(model.actions))
+            ]
+        )
         cand = packed.reshape(M, K)
         valid = en.reshape(M)
 
@@ -136,13 +144,14 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
             jnp.stack(viol_idx)[None],
             jnp.any(deadlocked)[None],
             jnp.argmax(deadlocked)[None],
+            act_en[None],  # [1, n_actions] -> [D, n_actions]
         )
 
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
-        out_specs=tuple([P("d")] * 11),
+        out_specs=tuple([P("d")] * 12),
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -160,6 +169,7 @@ def check_sharded(
     store_trace: bool = True,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
+    stats_path: Optional[str] = None,
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -324,9 +334,11 @@ def check_sharded(
         if max_states is not None and total >= max_states:
             cut = True
             break
+        t_level = time.perf_counter()
         next_pending = [[] for _ in range(D)]
         next_parent = [[] for _ in range(D)]
         next_act = [[] for _ in range(D)]
+        lvl_act_en = np.zeros(len(model.actions), np.int64)
         lvl_new_per_shard = np.zeros(D, np.int64)
         offs = [0] * D
         # base offset of each shard's rows in this level's shard-major order
@@ -376,6 +388,7 @@ def check_sharded(
                 viol_idx,
                 dl_any,
                 dl_idx,
+                act_en,
             ) = steps[key](
                 jax.device_put(frontier.reshape(D * bucket, K), shard1),
                 jax.device_put(fvalid.reshape(D * bucket), shard1),
@@ -421,6 +434,8 @@ def check_sharded(
                         )
                         next_act[d].append(act_np[d, : counts[d]].astype(np.int64))
             lvl_new_per_shard += counts
+            if stats_path is not None:
+                lvl_act_en += np.asarray(act_en, np.int64).sum(axis=0)
 
         if verdict is not None:
             inv_name, row, gidx = verdict
@@ -440,6 +455,25 @@ def check_sharded(
         if n_new:
             levels.append(n_new)
             total += n_new
+        if stats_path is not None:
+            import json
+
+            enabled_total = int(lvl_act_en.sum())
+            rec = {
+                "depth": depth,
+                "frontier": int(prev_base[-1]),
+                "enabled_candidates": enabled_total,
+                "new": n_new,
+                "duplicates": enabled_total - n_new,
+                "total": total,
+                "level_ms": round((time.perf_counter() - t_level) * 1e3, 1),
+                "shard_new": lvl_new_per_shard.tolist(),
+                "action_enablement": {
+                    a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
+                },
+            }
+            with open(stats_path, "a") as fh:
+                fh.write(json.dumps(rec) + "\n")
         if progress:
             progress(depth, n_new, total)
         pending = [

@@ -212,6 +212,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
             check_deadlock=tlc_cfg.check_deadlock,
             store_trace=not args.no_trace,
             checkpoint_dir=args.checkpoint,
+            stats_path=args.stats,
             **chunk_kw,
         )
     else:
