@@ -33,3 +33,17 @@ def test_render_async_isr_state():
 
 def test_render_unknown_falls_back_to_repr():
     assert render_state({}, (1, 2, 3)).strip() == "(1, 2, 3)"
+
+
+def test_render_product_state_per_partition():
+    from kafka_specification_tpu.models import kip320
+    from kafka_specification_tpu.models.product import product_model
+    from kafka_specification_tpu.models.kafka_replication import Config
+    import numpy as np
+
+    base = kip320.make_model(Config(2, 2, 1, 1))
+    model = product_model(base, 2)
+    init = {k: np.asarray(v) for k, v in model.init_states()[0].items()}
+    text = render_state(model.meta, model.decode(init))
+    assert "partition 0:" in text and "partition 1:" in text
+    assert text.count("replicaLog") == 2

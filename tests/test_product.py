@@ -1,5 +1,7 @@
 """Product-space combinator (the multi-partition stretch definition)."""
 
+import pytest
+
 from kafka_specification_tpu.engine.bfs import check
 from kafka_specification_tpu.models import id_sequence, kip320
 from kafka_specification_tpu.models.kafka_replication import Config
@@ -26,3 +28,20 @@ def test_product_kip320_two_partitions_smoke():
     assert res.ok
     # level 1 of the product = 2 x level 1 of the base (one partition steps)
     assert res.levels[1] == 2 * 4
+
+
+@pytest.mark.slow
+def test_product_kafka_variant_matches_oracle():
+    """Two-partition product of a full Kafka variant, cross-checked against
+    the oracle product state-for-state (validates the per-partition kernel
+    slicing at full model complexity): 353^2 = 124,609 reachable states."""
+    from kafka_specification_tpu.models import variants
+
+    cfg = Config(2, 2, 1, 1)
+    base = variants.make_model("KafkaTruncateToHighWatermark", cfg, ("TypeOk",))
+    obase = variants.make_oracle("KafkaTruncateToHighWatermark", cfg, ("TypeOk",))
+    model = product_model(base, 2)
+    oracle = product_oracle(obase, 2)
+    res, _ = assert_matches_oracle(model, oracle, min_bucket=1024)
+    assert res.ok
+    assert res.total == 353 * 353
