@@ -421,4 +421,5 @@ def make_oracle(cfg: AsyncIsrConfig, invariants=("TypeOk", "ValidHighWatermark")
             OracleAction("FollowerReplicate", follower_replicate),
         ],
         invariants=[(n, table[n]) for n in invariants],
+        meta={"variant": "AsyncIsr", "cfg": cfg},
     )

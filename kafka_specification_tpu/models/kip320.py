@@ -628,6 +628,7 @@ def make_oracle(cfg: Config, invariants: Sequence[str] = DEFAULT_INVARIANTS) -> 
         init_states=lambda: [kr.o_init(cfg)],
         actions=actions,
         invariants=_invariant_oracles(cfg, invariants),
+        meta={"variant": "Kip320", "cfg": cfg},
     )
 
 
@@ -651,4 +652,5 @@ def make_first_try_oracle(
         init_states=lambda: [kr.o_init(cfg)],
         actions=actions,
         invariants=_invariant_oracles(cfg, invariants),
+        meta={"variant": "Kip320FirstTry", "cfg": cfg},
     )

@@ -141,4 +141,5 @@ def product_oracle(base: OracleModel, k: int) -> OracleModel:
         actions=actions,
         invariants=invariants,
         constraint=constraint,
+        meta={**base.meta, "partitions": k, "base": base.name},
     )

@@ -115,4 +115,5 @@ def make_oracle(
         init_states=lambda: [kr.o_init(cfg)],
         actions=actions,
         invariants=_invariant_oracles(cfg, invariants),
+        meta={"variant": variant, "cfg": cfg},
     )

@@ -32,6 +32,8 @@ class OracleModel:
     actions: Sequence[OracleAction]
     invariants: Sequence[tuple[str, Callable[[object], bool]]]
     constraint: Optional[Callable[[object], bool]] = None
+    # same vocabulary as Model.meta (drives TLA-style trace rendering)
+    meta: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -169,8 +169,10 @@ def main(argv=None):
         if r.violation:
             name, depth, _ = r.violation
             print(f"Invariant {name} is VIOLATED at depth {depth}.")
-            for i, (action, state) in enumerate(r.trace):
-                print(f"  {i}. [{action}] {state}")
+            from .pretty import render_trace
+
+            print("Counterexample trace:")
+            print(render_trace(om.meta, r.trace))
         else:
             print("No invariant violations. Exhaustive check complete.")
         return 0 if r.violation is None else 1
