@@ -1,0 +1,172 @@
+"""Differential random walks: kernel vs oracle successor sets, per action,
+at constants far beyond exhaustive reach.
+
+Exhaustive engine-vs-oracle equality (helpers.assert_matches_oracle) only
+covers the small constants BFS can finish; encodings and kernels can have
+bugs that first manifest at larger N/L/E (wider bitmasks, more lanes, deeper
+logs — e.g. the 5-broker stretch config).  These walks start at Init and
+repeatedly (1) compute every action's successor set with the vmapped kernels
+on a single state, (2) compute the oracle's successor set for the same
+action, (3) require exact per-action equality, then step to a random
+successor.  Thirty steps x several large configs probe deep, irregular
+states no tiny-config BFS reaches.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafka_specification_tpu.models import async_isr, kip320, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+
+
+def _kernel_successors(model, state_np):
+    """Per-action decoded successor sets of one state via the vmapped kernels."""
+    state = {k: jnp.asarray(v, jnp.int32) for k, v in state_np.items()}
+    out = {}
+    for a in model.actions:
+        choices = jnp.arange(a.n_choices, dtype=jnp.int32)
+        ok, nxt = jax.vmap(lambda c: a.kernel(state, c))(choices)
+        if model.constraint is not None:
+            ok = ok & jax.vmap(model.constraint)(nxt)
+        ok = np.asarray(ok)
+        batch = {k: np.asarray(v) for k, v in nxt.items()}
+        succs = set()
+        for i in np.nonzero(ok)[0]:
+            row = {k: v[i] for k, v in batch.items()}
+            succs.add(model.decode(row))
+        out[a.name] = succs
+    return out
+
+
+def _oracle_successors(oracle, ostate):
+    out = {}
+    for a in oracle.actions:
+        succs = set()
+        for t in a.successors(ostate):
+            if oracle.constraint is not None and not oracle.constraint(t):
+                continue
+            succs.add(t)
+        out[a.name] = succs
+    return out
+
+
+def _walk(model, oracle, encode_back, steps=30, seed=0):
+    rng = np.random.default_rng(seed)
+    state_np = {k: np.asarray(v, np.int32) for k, v in model.init_states()[0].items()}
+    ostate = oracle.init_states()[0]
+    assert model.decode(state_np) == ostate
+    for step in range(steps):
+        ks = _kernel_successors(model, state_np)
+        os_ = _oracle_successors(oracle, ostate)
+        assert set(ks) == set(os_)
+        for name in ks:
+            assert ks[name] == os_[name], (
+                f"step {step}, action {name}: "
+                f"kernel-only={list(ks[name] - os_[name])[:2]} "
+                f"oracle-only={list(os_[name] - ks[name])[:2]}"
+            )
+        all_succ = sorted(
+            {s for ss in os_.values() for s in ss}, key=repr
+        )
+        if not all_succ:
+            break
+        ostate = all_succ[rng.integers(len(all_succ))]
+        state_np = encode_back(ostate)
+
+
+def _kafka_encode_back(cfg):
+    """Canonical decoded state -> tensor state dict (inverse of make_decode)."""
+
+    def enc(st):
+        logs, rstates, nrid, nep, reqs, (qep, qldr, qisr) = st
+        def mask(fs):
+            return sum(1 << r for r in fs)
+
+        rid = np.full((cfg.n, cfg.l), -1, np.int32)
+        repoch = np.full((cfg.n, cfg.l), -1, np.int32)
+        end = np.zeros(cfg.n, np.int32)
+        for r, log in enumerate(logs):
+            end[r] = len(log)
+            for o, (i, e) in enumerate(log):
+                rid[r, o], repoch[r, o] = i, e
+        req_ldr = np.full(cfg.e + 1, -2, np.int32)
+        req_isr = np.zeros(cfg.e + 1, np.int32)
+        for (e, l, isr) in reqs:
+            req_ldr[e] = l
+            req_isr[e] = mask(isr)
+        return {
+            "end": end,
+            "rid": rid,
+            "repoch": repoch,
+            "hw": np.asarray([rs[0] for rs in rstates], np.int32),
+            "ep": np.asarray([rs[1] for rs in rstates], np.int32),
+            "ldr": np.asarray([rs[2] for rs in rstates], np.int32),
+            "isr": np.asarray([mask(rs[3]) for rs in rstates], np.int32),
+            "nrid": np.int32(nrid),
+            "nep": np.int32(nep),
+            "qep": np.int32(qep),
+            "qldr": np.int32(qldr),
+            "qisr": np.int32(mask(qisr)),
+            "req_ldr": req_ldr,
+            "req_isr": req_isr,
+        }
+
+    return enc
+
+
+BIG_CONFIGS = [Config(4, 3, 3, 3), Config(5, 2, 3, 3)]
+
+
+@pytest.mark.parametrize("cfg", BIG_CONFIGS, ids=lambda c: f"{c.n}r-L{c.l}-E{c.e}")
+def test_walk_kip320_large_constants(cfg):
+    _walk(
+        kip320.make_model(cfg, invariants=()),
+        kip320.make_oracle(cfg, invariants=()),
+        _kafka_encode_back(cfg),
+        steps=25,
+        seed=cfg.n,
+    )
+
+
+def test_walk_kip101_large_constants():
+    cfg = Config(4, 3, 3, 3)
+    _walk(
+        variants.make_model("Kip101", cfg, invariants=()),
+        variants.make_oracle("Kip101", cfg, invariants=()),
+        _kafka_encode_back(cfg),
+        steps=25,
+        seed=7,
+    )
+
+
+def test_walk_async_isr_large_constants():
+    cfg = async_isr.AsyncIsrConfig(n_replicas=4, max_offset=4, max_version=4)
+
+    def enc(st):
+        (c_isr, c_ver), (l_isr, l_ver, pend, pver, offs), reqs, upds = st
+
+        def mask(fs):
+            return sum(1 << r for r in fs)
+
+        upd_isr = np.full(cfg.max_version + 1, -1, np.int32)
+        for isr, v in upds:
+            upd_isr[v] = mask(isr)
+        req_bits = np.zeros(cfg.max_version + 1, np.int32)
+        for isr, v in reqs:
+            req_bits[v] |= 1 << mask(isr)
+        return {
+            "c_isr": np.int32(mask(c_isr)),
+            "c_ver": np.int32(c_ver),
+            "l_isr": np.int32(mask(l_isr)),
+            "l_ver": np.int32(l_ver),
+            "l_pend": np.int32(mask(pend)),
+            "l_pver": np.int32(pver),
+            "offs": np.asarray(offs, np.int32),
+            "upd_isr": upd_isr,
+            "req_bits": req_bits,
+        }
+
+    _walk(async_isr.make_model(cfg, ()), async_isr.make_oracle(cfg, ()), enc, steps=30, seed=3)
