@@ -131,21 +131,22 @@ def main(argv=None):
     tlc_cfg = parse_cfg(args.cfg)
 
     if args.cmd == "validate":
-        from .tla_frontend import validate_model
+        from .tla_frontend import validate_cfg_constants, validate_model
 
+        problems = validate_cfg_constants(tlc_cfg, args.reference, module)
         # validate the base (single-partition) model: Partitions is an
         # authored product-space constant with no reference counterpart,
         # and the combinator renames actions to p<k>.<Name>
         tlc_cfg.constants.pop("Partitions", None)
         model = build_model(module, tlc_cfg)
-        problems = validate_model(model, args.reference, module)
+        problems += validate_model(model, args.reference, module)
         if problems:
             for pr in problems:
                 print(f"MISMATCH: {pr}")
             return 1
         print(
-            f"{module}: {len(model.actions)} actions match the reference "
-            f"Next disjuncts exactly."
+            f"{module}: constants assigned; {len(model.actions)} actions "
+            f"match the reference Next disjuncts exactly."
         )
         return 0
 

@@ -144,6 +144,41 @@ def defined_names(chain: dict[str, TlaModule]) -> set[str]:
     return out
 
 
+# constants a .cfg may set that are authored by this framework rather than
+# declared by the reference modules (documented in configs/)
+AUTHORED_CONSTANTS = {"Partitions", "MaxVersion"}
+
+
+def validate_cfg_constants(tlc_cfg, ref_dir, module: str) -> list[str]:
+    """TLC refuses to run with unassigned CONSTANTS; mirror that check.
+
+    Returns discrepancies: declared-but-unassigned constants (following the
+    EXTENDS chain; INSTANCE-substituted constants of instanced modules are
+    bound inside the spec and not required), and assigned names that are
+    neither declared nor framework-authored (likely typos).
+    """
+    chain = load_chain(ref_dir, module)
+    if module not in chain:
+        return [f"reference module {module} not found under {ref_dir}"]
+    declared = set()
+    for m in chain.values():
+        declared.update(m.constants)
+    # constants of INSTANCE'd modules are bound by WITH substitution
+    instanced = set()
+    for m in chain.values():
+        for target, _subs in m.instances.values():
+            if target in chain:
+                instanced.update(chain[target].constants)
+    required = declared - instanced
+    assigned = set(tlc_cfg.constants)
+    problems = []
+    for name in sorted(required - assigned):
+        problems.append(f"CONSTANT {name} is declared by {module}'s chain but unassigned")
+    for name in sorted(assigned - declared - AUTHORED_CONSTANTS):
+        problems.append(f"cfg assigns {name}, which no module in the chain declares")
+    return problems
+
+
 def validate_model(model, ref_dir, module: str) -> list[str]:
     """Cross-check a tensor model's actions against the reference module's
     Next disjuncts.  Returns a list of discrepancy strings (empty = clean).

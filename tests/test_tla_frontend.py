@@ -100,3 +100,23 @@ Next ==
 """
     )
     assert tf.next_disjuncts(mod) == ["Simple", "Quantified"]
+
+
+@needs_ref
+def test_validate_cfg_constants():
+    from kafka_specification_tpu.utils.cfg import parse_cfg
+
+    # every shipped config assigns the full constant set of its module
+    import pathlib
+
+    aliases = {"Kip320Stretch": "Kip320"}
+    for cfg_file in pathlib.Path("configs").glob("*.cfg"):
+        module = aliases.get(cfg_file.stem, cfg_file.stem)
+        problems = tf.validate_cfg_constants(parse_cfg(cfg_file), REF, module)
+        assert not problems, (cfg_file, problems)
+
+    # missing + typo'd constants are reported
+    bad = parse_cfg("CONSTANTS\n Replicas = {a, b}\n LogSizee = 2\n")
+    problems = tf.validate_cfg_constants(bad, REF, "Kip320")
+    assert any("LogSize is declared" in p for p in problems)
+    assert any("LogSizee" in p and "no module" in p for p in problems)
