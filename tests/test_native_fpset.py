@@ -85,3 +85,23 @@ def test_host_backend_exact64_zero_fingerprint():
     res = check(id_sequence.make_model(5), min_bucket=32, visited_backend="host")
     assert res.total == 7
     assert res.diameter == 6
+
+
+def test_host_backend_with_checkpoint_and_chunking(tmp_path):
+    """Flag-interaction matrix: host FpSet dedup + checkpoint/resume +
+    multi-chunk levels must compose (the checkpoint stores the dumped
+    fingerprint set)."""
+    ckdir = str(tmp_path / "ck")
+    model = frl.make_model(3, 4, 2)
+    partial = check(
+        model, max_depth=5, min_bucket=32, chunk_size=64,
+        visited_backend="host", checkpoint_dir=ckdir,
+    )
+    assert partial.total < 29791
+    resumed = check(
+        model, min_bucket=32, chunk_size=64,
+        visited_backend="host", checkpoint_dir=ckdir,
+    )
+    assert resumed.ok
+    assert resumed.total == 29791
+    assert resumed.stats["host_fpset_size"] == 29791

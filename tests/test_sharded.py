@@ -101,3 +101,13 @@ def test_sharded_checkpoint_rejects_other_mesh_or_model(tmp_path):
     mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
     with _pytest.raises(ValueError, match="different"):
         check_sharded(frl.make_model(2, 2, 2), mesh=mesh4, min_bucket=32, checkpoint_dir=ckdir)
+
+
+def test_sharded_deadlock_detection():
+    from kafka_specification_tpu.models import id_sequence
+
+    res = check_sharded(id_sequence.make_model(3), min_bucket=32, check_deadlock=True)
+    assert res.violation is not None
+    assert res.violation.invariant == "Deadlock"
+    assert res.violation.depth == 4
+    assert [s for _, s in res.violation.trace] == [0, 1, 2, 3, 4]
