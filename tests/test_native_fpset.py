@@ -98,10 +98,14 @@ def test_host_backend_with_checkpoint_and_chunking(tmp_path):
         visited_backend="host", checkpoint_dir=ckdir,
     )
     assert partial.total < 29791
+    import os
+
+    assert os.path.exists(os.path.join(ckdir, "bfs_checkpoint.npz"))
     resumed = check(
         model, min_bucket=32, chunk_size=64,
         visited_backend="host", checkpoint_dir=ckdir,
     )
     assert resumed.ok
     assert resumed.total == 29791
+    assert resumed.diameter == 12  # level bookkeeping restored across resume
     assert resumed.stats["host_fpset_size"] == 29791

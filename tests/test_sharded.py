@@ -7,7 +7,7 @@ from jax.sharding import Mesh
 
 from kafka_specification_tpu.parallel.sharded import check_sharded
 from kafka_specification_tpu.models import finite_replicated_log as frl
-from kafka_specification_tpu.models import kip320, variants
+from kafka_specification_tpu.models import id_sequence, kip320, variants
 from kafka_specification_tpu.models.kafka_replication import Config
 
 
@@ -104,8 +104,6 @@ def test_sharded_checkpoint_rejects_other_mesh_or_model(tmp_path):
 
 
 def test_sharded_deadlock_detection():
-    from kafka_specification_tpu.models import id_sequence
-
     res = check_sharded(id_sequence.make_model(3), min_bucket=32, check_deadlock=True)
     assert res.violation is not None
     assert res.violation.invariant == "Deadlock"
