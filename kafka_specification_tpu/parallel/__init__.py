@@ -1,0 +1,3 @@
+from .sharded import check_sharded
+
+__all__ = ["check_sharded"]
