@@ -128,7 +128,11 @@ def main(argv=None):
     from pathlib import Path
 
     module = args.module or Path(args.cfg).stem
-    tlc_cfg = parse_cfg(args.cfg)
+    try:
+        tlc_cfg = parse_cfg(args.cfg)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot parse {args.cfg}: {e}", file=sys.stderr)
+        return 2
 
     if args.cmd == "validate":
         from .tla_frontend import validate_cfg_constants, validate_model
@@ -138,7 +142,7 @@ def main(argv=None):
         # authored product-space constant with no reference counterpart,
         # and the combinator renames actions to p<k>.<Name>
         tlc_cfg.constants.pop("Partitions", None)
-        model = build_model(module, tlc_cfg)
+        model = _build_or_fail(module, tlc_cfg)
         problems += validate_model(model, args.reference, module)
         if problems:
             for pr in problems:
@@ -153,7 +157,7 @@ def main(argv=None):
     if args.cmd == "oracle":
         from ..oracle.interp import oracle_bfs
 
-        om = build_model(module, tlc_cfg, oracle=True)
+        om = _build_or_fail(module, tlc_cfg, oracle=True)
         t0 = time.perf_counter()
         r = oracle_bfs(
             om,
@@ -182,7 +186,7 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    model = build_model(module, tlc_cfg)
+    model = _build_or_fail(module, tlc_cfg)
     progress = None
     if args.progress:
         def progress(depth, new_n, total):
@@ -200,6 +204,18 @@ def main(argv=None):
         res = _run_engine(args, model, tlc_cfg, progress, chunk_kw)
     _print_result(res, args.json, model_meta=model.meta)
     return 0 if res.violation is None else 1
+
+
+
+def _build_or_fail(module, tlc_cfg, oracle=False):
+    try:
+        return build_model(module, tlc_cfg, oracle=oracle)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        raise SystemExit(2)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(2)
 
 
 def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
