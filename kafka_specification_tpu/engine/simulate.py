@@ -1,0 +1,119 @@
+"""Random simulation mode (TLC's `-simulate`).
+
+Exhaustive BFS is the framework's main mode; simulation complements it for
+state spaces too large to exhaust: random walks from the initial states,
+checking invariants at every step, reporting the violating walk as the
+counterexample trace.  Deterministic under a seed (numpy Generator drives
+all choices), so reported traces replay.
+
+Implementation: per step, the same vmapped action kernels run on a single
+state (vmap over the choice lattice only); an enabled successor is drawn
+uniformly from the enabled (state-constraint-satisfying) candidates.  The
+walk terminates early at deadlocks (no enabled successor).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import Model
+from .bfs import CheckResult, Violation
+
+
+def _successor_fn(model: Model):
+    """jitted: state dict -> (enabled[C] bool, batched successor struct)."""
+    spec = model.spec
+
+    @jax.jit
+    def step(state):
+        oks, nxts = [], []
+        for a in model.actions:
+            choices = jnp.arange(a.n_choices, dtype=jnp.int32)
+            ok, nxt = jax.vmap(lambda c, a=a: a.kernel(state, c))(choices)
+            if model.constraint is not None:
+                ok = ok & jax.vmap(model.constraint)(nxt)
+            oks.append(ok)
+            nxts.append(nxt)
+        batched = {
+            k: jnp.concatenate([n[k] for n in nxts], axis=0) for k in nxts[0]
+        }
+        inv_ok = jnp.stack(
+            [jnp.all(inv.pred(state)) for inv in model.invariants]
+        ) if model.invariants else jnp.ones((1,), bool)
+        return jnp.concatenate(oks), batched, inv_ok
+
+    return step
+
+
+def simulate(
+    model: Model,
+    num_walks: int = 100,
+    max_depth: int = 100,
+    seed: int = 0,
+    progress=None,
+) -> CheckResult:
+    """Random-walk checking. Returns a CheckResult whose `total` counts
+    visited (not necessarily distinct) states; `violation` carries the full
+    violating walk as its trace."""
+    rng = np.random.default_rng(seed)
+    step = _successor_fn(model)
+    act_of = np.concatenate(
+        [np.full(a.n_choices, i) for i, a in enumerate(model.actions)]
+    )
+    t0 = time.perf_counter()
+    visited = 0
+    violation: Optional[Violation] = None
+    inits = model.init_states()
+
+    for walk in range(num_walks):
+        state = {
+            k: np.asarray(v, np.int32)
+            for k, v in inits[rng.integers(len(inits))].items()
+        }
+        trace = [("<init>", model.decode(state) if model.decode else dict(state))]
+        for d in range(max_depth):
+            en, batched, inv_ok = step({k: jnp.asarray(v) for k, v in state.items()})
+            visited += 1
+            inv_ok = np.asarray(inv_ok)
+            if model.invariants and not inv_ok.all():
+                bad = int(np.argmax(~inv_ok))
+                violation = Violation(
+                    invariant=model.invariants[bad].name,
+                    depth=d,
+                    state=trace[-1][1],
+                    trace=trace,
+                )
+                break
+            en = np.asarray(en)
+            idxs = np.nonzero(en)[0]
+            if idxs.size == 0:
+                break  # deadlock: the walk ends (matches TLC simulation)
+            pick = int(idxs[rng.integers(idxs.size)])
+            state = {k: np.asarray(v)[pick] for k, v in batched.items()}
+            trace.append(
+                (
+                    model.actions[int(act_of[pick])].name,
+                    model.decode(state) if model.decode else dict(state),
+                )
+            )
+        if violation is not None:
+            break
+        if progress:
+            progress(walk + 1, visited)
+
+    dt = time.perf_counter() - t0
+    return CheckResult(
+        model=model.name,
+        levels=[],
+        total=visited,
+        diameter=0,
+        violation=violation,
+        seconds=dt,
+        states_per_sec=visited / max(dt, 1e-9),
+        stats={"mode": "simulate", "walks": num_walks, "max_depth": max_depth, "seed": seed},
+    )

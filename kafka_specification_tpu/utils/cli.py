@@ -115,6 +115,17 @@ def main(argv=None):
     po.add_argument("--max-depth", type=int)
     po.add_argument("--max-states", type=int)
 
+    ps = sub.add_parser(
+        "simulate", help="random-walk checking (TLC -simulate equivalent)"
+    )
+    ps.add_argument("cfg")
+    ps.add_argument("--module")
+    ps.add_argument("--walks", type=int, default=100)
+    ps.add_argument("--depth", type=int, default=100)
+    ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument("--cpu", action="store_true", help="force the CPU platform")
+    ps.add_argument("--json", action="store_true")
+
     pv = sub.add_parser(
         "validate",
         help="cross-check a model's action inventory against the reference "
@@ -153,6 +164,27 @@ def main(argv=None):
             f"match the reference Next disjuncts exactly."
         )
         return 0
+
+    if args.cmd == "simulate":
+        if args.cpu:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from ..engine.simulate import simulate
+
+        model = _build_or_fail(module, tlc_cfg)
+        res = simulate(
+            model, num_walks=args.walks, max_depth=args.depth, seed=args.seed
+        )
+        if res.violation is None:
+            print(
+                f"Simulation: {args.walks} walks x depth {args.depth}, "
+                f"{res.total} states visited, no violations "
+                f"({res.states_per_sec:,.0f} states/sec)."
+            )
+        else:
+            _print_result(res, args.json, model_meta=model.meta)
+        return 0 if res.violation is None else 1
 
     if args.cmd == "oracle":
         from ..oracle.interp import oracle_bfs
