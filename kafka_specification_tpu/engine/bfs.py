@@ -89,6 +89,105 @@ class _Step:
         self.act_ids = jnp.asarray(act_ids)
         self._cache = {}
 
+    def expand_width(self, bucket: int, shift: int) -> int:
+        """Candidate rows produced by make_expand(bucket, shift)."""
+        return (max(1, bucket >> shift) if shift else bucket) * self.C
+
+    def make_expand(self, bucket: int, shift: int):
+        """Expansion kernel: (states[B], fvalid[B]) ->
+        (en_pre[B, C], cand[T, K], valid[T], parent[T], actid[T],
+         act_en[n_actions], overflow) with T = expand_width(bucket, shift).
+
+        shift=0: one phase over the full padded lattice (T = B*C).
+        shift>0: two phases — a full-lattice guard sweep whose state
+        *updates* are dead code (XLA eliminates them; guards alone are a few
+        % of the kernel cost), then per-action compaction of the enabled
+        (state, choice) pairs into n_choices*(B>>shift) rows where the
+        kernel, functional update, constraint and lane packing actually run.
+        overflow=True iff some action enabled more pairs than its compact
+        buffer holds — the caller must re-run at a smaller shift; outputs
+        are incomplete in that case but never wrong-state (valid rows are
+        always real successors)."""
+        model, spec = self.model, self.spec
+        C = self.C
+        act_ids = self.act_ids
+        # action boundaries for the enablement histogram (TLC's action
+        # coverage analogue, SURVEY.md §5 "Metrics")
+        bounds = np.cumsum([0] + [a.n_choices for a in model.actions])
+        B = bucket
+        M = B * C
+
+        def _expand_full(states, fvalid):
+            en_pre, en, packed = jax.vmap(self._expand_one)(states)  # [B,C]x2, [B,C,K]
+            en = en & fvalid[:, None]
+            act_en = jnp.stack(
+                [
+                    jnp.sum(en[:, bounds[i] : bounds[i + 1]], dtype=jnp.int32)
+                    for i in range(len(model.actions))
+                ]
+            )
+            cand = packed.reshape(M, spec.num_lanes)
+            valid = en.reshape(M)
+            flat = jnp.arange(M, dtype=jnp.int32)
+            return (
+                en_pre,
+                cand,
+                valid,
+                flat // C,
+                act_ids[flat % C],
+                act_en,
+                jnp.bool_(False),
+            )
+
+        def _expand_compact(states, fvalid):
+            def _guards_one(state):
+                parts = []
+                for a in model.actions:
+                    choices = jnp.arange(a.n_choices, dtype=jnp.int32)
+                    ok = jax.vmap(lambda c, s=state, a=a: a.kernel(s, c)[0])(choices)
+                    parts.append(ok)
+                return jnp.concatenate(parts)
+
+            en_pre = jax.vmap(_guards_one)(states)  # [B, C] pre-constraint
+            cand_parts, valid_parts, parent_parts, act_parts = [], [], [], []
+            act_en_parts, ovf_parts = [], []
+            for ai, a in enumerate(model.actions):
+                na = a.n_choices
+                W = max(1, B >> shift) * na
+                ga = (en_pre[:, bounds[ai] : bounds[ai + 1]] & fvalid[:, None]).reshape(
+                    B * na
+                )
+                n_en = jnp.sum(ga, dtype=jnp.int32)
+                ovf_parts.append(n_en > W)
+                cpos = jnp.where(ga, jnp.cumsum(ga) - 1, W)
+                cidx = jnp.zeros((W,), jnp.int32).at[cpos].set(
+                    jnp.arange(B * na, dtype=jnp.int32)
+                )
+                rowvalid = jnp.arange(W) < n_en
+                sidx = cidx // na
+                ch = cidx % na
+                gstate = jax.tree.map(lambda x: x[sidx], states)
+                ok, nxt = jax.vmap(a.kernel)(gstate, ch)
+                ok = ok & rowvalid
+                if model.constraint is not None:
+                    ok = ok & jax.vmap(model.constraint)(nxt)
+                cand_parts.append(jax.vmap(spec.pack)(nxt))
+                valid_parts.append(ok)
+                parent_parts.append(sidx)
+                act_parts.append(jnp.full((W,), ai, jnp.int32))
+                act_en_parts.append(jnp.sum(ok, dtype=jnp.int32))
+            return (
+                en_pre,
+                jnp.concatenate(cand_parts, axis=0),
+                jnp.concatenate(valid_parts),
+                jnp.concatenate(parent_parts),
+                jnp.concatenate(act_parts),
+                jnp.stack(act_en_parts),
+                jnp.any(jnp.stack(ovf_parts)),
+            )
+
+        return _expand_compact if shift else _expand_full
+
     def _expand_one(self, state: dict):
         """All successors of one state: (enabled_pre_constraint[C],
         enabled[C], packed[C, K]).  The pre-constraint mask feeds deadlock
@@ -116,11 +215,12 @@ class _Step:
         vcap: int,
         with_invariants: bool = True,
         with_merge: bool = True,
+        compact: Optional[int] = None,
     ):
-        key = (bucket, vcap, with_invariants, with_merge)
+        key = (bucket, vcap, with_invariants, with_merge, compact)
         if key not in self._cache:
             self._cache[key] = jax.jit(
-                self.build_raw(bucket, vcap, with_invariants, with_merge)
+                self.build_raw(bucket, vcap, with_invariants, with_merge, compact)
             )
         return self._cache[key]
 
@@ -130,40 +230,57 @@ class _Step:
         vcap: int,
         with_invariants: bool = True,
         with_merge: bool = True,
+        compact: Optional[int] = None,
     ):
         """The un-jitted level step (frontier, fvalid, vhi, vlo, vn) -> ...;
         exposed for the driver's compile checks and custom jit wrapping.
-        with_merge=False skips the visited-set merge (host FpSet backend)."""
-        return self._build(bucket, vcap, with_invariants, with_merge)
+        with_merge=False skips the visited-set merge (host FpSet backend).
 
-    def _build(self, bucket: int, vcap: int, with_invariants: bool, with_merge: bool = True):
+        compact: a right-shift amount (1, 2, ...) enabling the two-phase
+        expansion.  Phase A sweeps all guards over the full padded choice
+        lattice with the state *updates* dead-code-eliminated by XLA (guards
+        alone are ~3% of the kernel cost — the expensive parts, the
+        functional updates and the lane packing, never run for disabled
+        candidates).  Phase B compacts each action's enabled (state, choice)
+        pairs into a buffer of W_a = n_choices_a * (bucket >> compact) rows
+        and re-runs that action's kernel, update and pack at the compacted
+        width only.  The sort / visited-probe / merge then also run at the
+        compacted total width (only a few percent of the lattice is ever
+        enabled — RESULTS.md measures ~6% on Kip320).  The step returns
+        overflow=True iff some action enabled more pairs than its buffer
+        holds, in which case its outputs are INCOMPLETE and the caller must
+        re-run the chunk at a smaller shift (the host loop retries; results
+        stay exact either way)."""
+        return self._build(bucket, vcap, with_invariants, with_merge, compact)
+
+    def _build(
+        self,
+        bucket: int,
+        vcap: int,
+        with_invariants: bool,
+        with_merge: bool = True,
+        compact: Optional[int] = None,
+    ):
         spec, model = self.spec, self.model
         C, K = self.C, self.K
-        M = bucket * C
-        act_ids = self.act_ids
-
-        # action boundaries for the enablement histogram (TLC's action
-        # coverage analogue, SURVEY.md §5 "Metrics")
-        bounds = np.cumsum([0] + [a.n_choices for a in model.actions])
+        shift = int(compact) if compact else 0
+        if shift and (bucket >> shift) < 1:
+            shift = 0
+        expand = self.make_expand(bucket, shift)
+        # total candidate width the sort/probe/outputs run at
+        T = self.expand_width(bucket, shift)
 
         def step(frontier, fvalid, vhi, vlo, vn):
             states = jax.vmap(spec.unpack)(frontier)
-            en_pre, en, packed = jax.vmap(self._expand_one)(states)  # [B,C]x2, [B,C,K]
+            en_pre, cand, valid, parent, actid, act_en, overflow = expand(
+                states, fvalid
+            )
             deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
             dl_any = jnp.any(deadlocked)
             dl_idx = jnp.argmax(deadlocked)
-            en = en & fvalid[:, None]
-            act_en = jnp.stack(
-                [
-                    jnp.sum(en[:, bounds[i] : bounds[i + 1]], dtype=jnp.int32)
-                    for i in range(len(model.actions))
-                ]
-            )
-            cand = packed.reshape(M, K)
-            valid = en.reshape(M)
 
             sent = jnp.uint32(dedup.SENT)
-            if self.use_pallas:
+            if self.use_pallas and not shift:
                 from ..ops.pallas_fingerprint import fingerprint_pallas
 
                 interp = jax.default_backend() == "cpu"
@@ -185,13 +302,13 @@ class _Step:
             is_new = first & ~seen
 
             # compact new states to the front (OOB scatter indices are dropped)
-            pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, M)
-            out = jnp.zeros((M, K), jnp.uint32).at[pos].set(cand[order])
-            out_parent = jnp.full((M,), -1, jnp.int32).at[pos].set(order // C)
-            out_act = jnp.full((M,), -1, jnp.int32).at[pos].set(act_ids[order % C])
-            out_hi = jnp.full((M,), sent).at[pos].set(hi_s)
-            out_lo = jnp.full((M,), sent).at[pos].set(lo_s)
-            out_rank = jnp.zeros((M,), jnp.int32).at[pos].set(rank)
+            pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, T)
+            out = jnp.zeros((T, K), jnp.uint32).at[pos].set(cand[order])
+            out_parent = jnp.full((T,), -1, jnp.int32).at[pos].set(parent[order])
+            out_act = jnp.full((T,), -1, jnp.int32).at[pos].set(actid[order])
+            out_hi = jnp.full((T,), sent).at[pos].set(hi_s)
+            out_lo = jnp.full((T,), sent).at[pos].set(lo_s)
+            out_rank = jnp.zeros((T,), jnp.int32).at[pos].set(rank)
             new_n = jnp.sum(is_new, dtype=jnp.int32)
 
             if with_merge:
@@ -230,6 +347,7 @@ class _Step:
                 act_en,
                 out_hi,
                 out_lo,
+                overflow,
             )
 
         return step
@@ -298,6 +416,7 @@ def check(
     visited_backend: str = "device",
     chunk_size: int = 32768,
     visited_capacity_hint: Optional[int] = None,
+    compact_shift: int = 2,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -327,6 +446,14 @@ def check(
     visited_capacity_hint: preallocate the device visited set for ~this many
     states so capacity doubling (one recompile per doubling) never triggers
     on runs whose state-space size is roughly known.
+
+    compact_shift: two-phase expansion — sweep guards over the full padded
+    lattice (state updates dead-code-eliminated), then run each action's
+    update+pack and the sort/probe/merge at 1/2^compact_shift of the lattice
+    width (only a few percent is ever enabled).  Purely a performance knob:
+    a chunk whose enabled count overflows a compact buffer is re-run at
+    double the width (the step reports overflow; results stay exact).  0
+    disables compaction.
 
     checkpoint_dir: when set, the (visited set, frontier, level counters) are
     persisted every `checkpoint_every` BFS levels (default 1 = per level; a
@@ -522,31 +649,48 @@ def check(
                     vhi = jnp.concatenate([vhi, pad])
                     vlo = jnp.concatenate([vlo, pad])
                     vcap = new_cap
-            step = step_builder.get(
-                bucket, vcap, check_invariants, with_merge=host_set is None
-            )
-            (
-                out,
-                out_parent,
-                out_act,
-                new_n,
-                vhi,
-                vlo,
-                vn,
-                viol_any,
-                viol_idx,
-                dl_any,
-                dl_idx,
-                act_en,
-                out_hi,
-                out_lo,
-            ) = step(
-                jnp.asarray(_pad_rows(piece, bucket)),
-                jnp.arange(bucket) < fp_n,
-                vhi,
-                vlo,
-                vn,
-            )
+            # Candidate compaction: expand/pack/sort/probe/merge at the
+            # enabled width (a few % of M) instead of the padded-lattice
+            # width.  On overflow (an action enabled more pairs than its
+            # compact buffer holds) the visited set returned by the step is
+            # discarded and the chunk re-runs at double the width — exact
+            # results either way, the shift is purely a performance knob.
+            while True:
+                sh = compact_shift if (compact_shift > 0 and bucket >= 4096) else 0
+                step = step_builder.get(
+                    bucket,
+                    vcap,
+                    check_invariants,
+                    with_merge=host_set is None,
+                    compact=sh or None,
+                )
+                (
+                    out,
+                    out_parent,
+                    out_act,
+                    new_n,
+                    vhi_n,
+                    vlo_n,
+                    vn_n,
+                    viol_any,
+                    viol_idx,
+                    dl_any,
+                    dl_idx,
+                    act_en,
+                    out_hi,
+                    out_lo,
+                    overflow,
+                ) = step(
+                    jnp.asarray(_pad_rows(piece, bucket)),
+                    jnp.arange(bucket) < fp_n,
+                    vhi,
+                    vlo,
+                    vn,
+                )
+                if sh == 0 or not bool(overflow):
+                    vhi, vlo, vn = vhi_n, vlo_n, vn_n
+                    break
+                compact_shift -= 1
             # frontier-level verdicts (states being expanded = level `depth`)
             if check_invariants:
                 viol_any_np = np.asarray(viol_any)

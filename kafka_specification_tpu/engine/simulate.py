@@ -62,6 +62,16 @@ def simulate(
     violating walk as its trace."""
     rng = np.random.default_rng(seed)
     step = _successor_fn(model)
+    # standalone invariant kernel for the walk's final state (the state
+    # reached by the max_depth-th transition is never fed back to `step`,
+    # but TLC -simulate checks every state on the walk — see below)
+    inv_fn = (
+        jax.jit(
+            lambda s: jnp.stack([jnp.all(inv.pred(s)) for inv in model.invariants])
+        )
+        if model.invariants
+        else None
+    )
     act_of = np.concatenate(
         [np.full(a.n_choices, i) for i, a in enumerate(model.actions)]
     )
@@ -101,6 +111,23 @@ def simulate(
                     model.decode(state) if model.decode else dict(state),
                 )
             )
+        else:
+            # depth limit reached: the last transition's target state has
+            # not been invariant-checked yet (violation/deadlock exits have
+            # — `step` ran on those states before the break)
+            if inv_fn is not None:
+                inv_ok = np.asarray(
+                    inv_fn({k: jnp.asarray(v) for k, v in state.items()})
+                )
+                visited += 1
+                if not inv_ok.all():
+                    bad = int(np.argmax(~inv_ok))
+                    violation = Violation(
+                        invariant=model.invariants[bad].name,
+                        depth=max_depth,
+                        state=trace[-1][1],
+                        trace=trace,
+                    )
         if violation is not None:
             break
         if progress:

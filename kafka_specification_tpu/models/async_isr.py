@@ -20,7 +20,8 @@ updates are uniquely keyed by version -> version-indexed array.  `requests`
 (leader -> controller) reuse the leader's *current* version (:92-99,:107-114),
 so several distinct ISRs can share a version -> encoded as a per-version
 bitset over ISR subsets (`req_bits[v]` bit s <=> request (isr=s, version=v)
-present); N <= 5 keeps the subset lattice within one uint32 lane.
+present); N <= 4 keeps the 2^N-bit subset lattice within one signed int32
+element (the packing dtype).
 
 WLOG the fixed `Leader` constant is replica 0.
 """

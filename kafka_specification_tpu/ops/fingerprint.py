@@ -51,6 +51,23 @@ def _murmur3_lanes(lanes: jnp.ndarray, seed: int) -> jnp.ndarray:
     return _fmix32(h ^ jnp.uint32(4 * k))
 
 
+def hash_pair(lanes: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Hashed-mode fingerprint pair (shared by the jnp and Pallas paths).
+
+    The all-ones pair is the dedup padding sentinel: a valid state hashing
+    to it would be indistinguishable from padding and silently *dropped*
+    (worse than an ordinary collision, which merely conflates two states),
+    so it is remapped to a reserved neighbour (~n*2^-64 probability per
+    state; costs at most one extra ordinary collision).
+    """
+    hi = _murmur3_lanes(lanes, SEED_HI)
+    lo = _murmur3_lanes(lanes, SEED_LO)
+    sent = jnp.uint32(0xFFFFFFFF)
+    is_sent = (hi == sent) & (lo == sent)
+    lo = jnp.where(is_sent, jnp.uint32(0xFFFFFFFE), lo)
+    return hi, lo
+
+
 def fingerprint_lanes(lanes: jnp.ndarray, exact: bool) -> tuple[jnp.ndarray, jnp.ndarray]:
     """uint32[..., K] packed states -> (hi, lo) uint32 fingerprints."""
     if exact:
@@ -58,6 +75,4 @@ def fingerprint_lanes(lanes: jnp.ndarray, exact: bool) -> tuple[jnp.ndarray, jnp
         lo = lanes[..., 0]
         hi = lanes[..., 1] if k > 1 else jnp.zeros_like(lo)
         return hi, lo
-    hi = _murmur3_lanes(lanes, SEED_HI)
-    lo = _murmur3_lanes(lanes, SEED_LO)
-    return hi, lo
+    return hash_pair(lanes)

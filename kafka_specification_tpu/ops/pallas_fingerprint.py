@@ -21,18 +21,20 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .fingerprint import SEED_HI, SEED_LO, _murmur3_lanes
+from .fingerprint import hash_pair
 from . import dedup
 
 
 def _kernel(lanes_ref, valid_ref, hi_ref, lo_ref):
     # one authoritative hash implementation: the kernel body is plain jnp
     # over the VMEM-resident block, so it reuses ops.fingerprint directly
+    # (including the sentinel-collision remap)
     lanes = lanes_ref[...]  # [block, K] uint32
     valid = valid_ref[...]  # [block] bool
     sent = jnp.uint32(dedup.SENT)
-    hi_ref[...] = jnp.where(valid, _murmur3_lanes(lanes, SEED_HI), sent)
-    lo_ref[...] = jnp.where(valid, _murmur3_lanes(lanes, SEED_LO), sent)
+    hi, lo = hash_pair(lanes)
+    hi_ref[...] = jnp.where(valid, hi, sent)
+    lo_ref[...] = jnp.where(valid, lo, sent)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))

@@ -5,15 +5,17 @@ equivalent shards the frontier AND the fingerprint set across a 1-D device
 mesh and exchanges ownership over ICI collectives:
 
 - the frontier lives sharded across devices (axis 'd'); each device expands
-  its shard with the same vmapped action kernels as the single-device engine,
+  its shard with the same vmapped action kernels as the single-device engine
+  (including the two-phase guard-sweep/compact expansion),
 - every candidate successor is owned by the device selected by its
   fingerprint (owner = fp_lo mod D — fingerprint-range sharding),
-- candidates are exchanged with `lax.all_gather` (the north-star design in
-  BASELINE.json); each device filters to the candidates it owns, dedups them
-  against its local sorted fingerprint shard, and keeps its new states as its
-  shard of the next frontier — hash ownership keeps shards balanced with no
-  host-side reshuffle,
-- `lax.psum` provides frontier-size consensus and termination detection.
+- candidates are routed to their owner with bucket-by-owner `lax.all_to_all`
+  (per-shard ICI traffic ≈ the candidate width, independent of mesh size —
+  SURVEY §2.6), with `lax.all_gather` + ownership filtering kept as the
+  simple fallback (exchange="all_gather"); the owner dedups them against its
+  local sorted fingerprint shard and keeps its new states as its shard of
+  the next frontier — hash ownership keeps shards balanced with no
+  host-side reshuffle.
 
 Everything runs under `jax.jit` + `shard_map` over a `jax.sharding.Mesh`, so
 the same code drives 8 virtual CPU devices in CI, one real TPU chip, or a
@@ -44,23 +46,66 @@ from ..ops import dedup
 from ..ops.fingerprint import fingerprint_lanes
 
 
-def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
+def _norm_shift(bucket: int, shift: int) -> int:
+    """Shift actually applied by the step for this bucket (single source of
+    truth shared with check_sharded's buffer sizing)."""
+    return 0 if (shift and (bucket >> shift) < 1) else shift
+
+
+def _default_dest_w(T: int, D: int) -> int:
+    return max(64, T // D)
+
+
+def _make_sharded_step(
+    model: Model,
+    mesh: Mesh,
+    bucket: int,
+    vcap: int,
+    compact: Optional[int] = None,
+    exchange: str = "all_to_all",
+    dest_w: Optional[int] = None,
+    with_merge: bool = True,
+):
     """Jitted sharded level step.
 
     Global shapes (D = mesh size):
       frontier [D*bucket, K], fvalid [D*bucket]
       vhi/vlo  [D, vcap]  (per-device sorted fingerprint shard), vn [D]
-    Returns per-shard compacted new states [D*M, K], per-shard new counts [D],
-    updated visited, and violation flags.
+    Returns per-shard compacted new states [D*R, K] (R = per-shard receive
+    width), per-shard new counts [D], updated visited, violation flags, and
+    two overflow flags (expansion compaction / destination buckets) — when
+    either is set the outputs are incomplete and the caller must re-run the
+    chunk at a larger width.
+
+    compact: two-phase expansion shift (engine.bfs._Step.make_expand) — the
+    guard sweep runs on the full lattice, update+pack+sort only on the
+    enabled ~6%.
+
+    exchange: how candidate fingerprints reach their owner shard
+    (owner = fp_lo mod D — fingerprint-range sharding):
+      - "all_to_all": bucket-by-owner + lax.all_to_all.  Each shard routes
+        its candidates into D per-destination buckets of dest_w rows and
+        sends each bucket only to its owner: per-shard ICI traffic is
+        D*dest_w ≈ the candidate width, independent of mesh size (the
+        SURVEY §2.6 design; docs/DISTRIBUTED.md has the padding-factor
+        accounting).
+      - "all_gather": every shard receives ALL candidates and filters to
+        the ones it owns — D× the bytes, kept as the simple/robust
+        fallback.
     """
     spec = model.spec
     expander = _Step(model)
     K, C = spec.num_lanes, expander.C
-    M = bucket * C
     D = mesh.devices.size
-    act_ids = expander.act_ids
-
-    bounds = np.cumsum([0] + [a.n_choices for a in model.actions])
+    shift = _norm_shift(bucket, int(compact) if compact else 0)
+    expand = expander.make_expand(bucket, shift)
+    T = expander.expand_width(bucket, shift)
+    if exchange not in ("all_to_all", "all_gather"):
+        raise ValueError(f"unknown exchange {exchange!r}")
+    # per-destination row budget (all_to_all): default 4x headroom over a
+    # uniform spread of the typical ~6%-enabled candidate load
+    W = dest_w if dest_w is not None else _default_dest_w(T, D)
+    R = D * W if exchange == "all_to_all" else D * T  # receive width
 
     def shard_body(frontier, fvalid, vhi, vlo, vn):
         # per-shard views: frontier [bucket, K], vhi [1, vcap], vn [1]
@@ -68,57 +113,78 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
         me = jax.lax.axis_index("d")
 
         states = jax.vmap(spec.unpack)(frontier)
-        en_pre, en, packed = jax.vmap(expander._expand_one)(states)
-        deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
-        en = en & fvalid[:, None]
-        act_en = jnp.stack(
-            [
-                jnp.sum(en[:, bounds[i] : bounds[i + 1]], dtype=jnp.int32)
-                for i in range(len(model.actions))
-            ]
+        en_pre, cand, valid, parent, actid, act_en, ovf_expand = expand(
+            states, fvalid
         )
-        cand = packed.reshape(M, K)
-        valid = en.reshape(M)
+        deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
 
         hi, lo = fingerprint_lanes(cand, spec.exact64)
         sent = jnp.uint32(dedup.SENT)
         hi = jnp.where(valid, hi, sent)
         lo = jnp.where(valid, lo, sent)
+        # parent as a mesh-global frontier row id (survives the exchange)
+        parent_g = me.astype(jnp.int32) * bucket + parent
 
-        # exchange: gather all candidates, keep the ones this shard owns
-        g_hi = jax.lax.all_gather(hi, "d", tiled=True)  # [D*M]
-        g_lo = jax.lax.all_gather(lo, "d", tiled=True)
-        g_cand = jax.lax.all_gather(cand, "d", tiled=True)  # [D*M, K]
-        g_valid = jax.lax.all_gather(valid, "d", tiled=True)
+        if exchange == "all_to_all":
+            owner = jnp.where(valid, (lo % jnp.uint32(D)).astype(jnp.int32), D)
+            s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
+            for d in range(D):
+                mask = owner == d
+                cnt = jnp.sum(mask, dtype=jnp.int32)
+                cnts.append(cnt)
+                cpos = jnp.where(mask, jnp.cumsum(mask) - 1, W)
+                s_hi.append(jnp.full((W,), sent).at[cpos].set(hi))
+                s_lo.append(jnp.full((W,), sent).at[cpos].set(lo))
+                s_cand.append(jnp.zeros((W, K), jnp.uint32).at[cpos].set(cand))
+                s_par.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(parent_g))
+                s_act.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(actid))
+            ovf_dest = jnp.any(jnp.stack(cnts) > W)
+            a2a = lambda x: jax.lax.all_to_all(  # noqa: E731
+                x, "d", split_axis=0, concat_axis=0, tiled=True
+            )
+            r_hi = a2a(jnp.stack(s_hi)).reshape(R)
+            r_lo = a2a(jnp.stack(s_lo)).reshape(R)
+            r_cand = a2a(jnp.stack(s_cand)).reshape(R, K)
+            r_parent = a2a(jnp.stack(s_par)).reshape(R)
+            r_act = a2a(jnp.stack(s_act)).reshape(R)
+        else:
+            ovf_dest = jnp.bool_(False)
+            r_hi = jax.lax.all_gather(hi, "d", tiled=True)  # [D*T]
+            r_lo = jax.lax.all_gather(lo, "d", tiled=True)
+            r_cand = jax.lax.all_gather(cand, "d", tiled=True)  # [D*T, K]
+            r_valid = jax.lax.all_gather(valid, "d", tiled=True)
+            r_parent = jax.lax.all_gather(parent_g, "d", tiled=True)
+            r_act = jax.lax.all_gather(actid, "d", tiled=True)
+            mine = r_valid & ((r_lo % jnp.uint32(D)).astype(jnp.int32) == me)
+            r_hi = jnp.where(mine, r_hi, sent)
+            r_lo = jnp.where(mine, r_lo, sent)
 
-        mine = g_valid & ((g_lo % jnp.uint32(D)).astype(jnp.int32) == me)
-        g_hi = jnp.where(mine, g_hi, sent)
-        g_lo = jnp.where(mine, g_lo, sent)
-
-        # minimal-payload sort; parent/action derive from the gathered index:
-        # g = src_device*M + i*C + c
-        order = jnp.lexsort((g_lo, g_hi))
-        hi_s, lo_s = g_hi[order], g_lo[order]
+        # minimal-payload sort over the received (owned) candidates
+        order = jnp.lexsort((r_lo, r_hi))
+        hi_s, lo_s = r_hi[order], r_lo[order]
         invalid_s = (hi_s == sent) & (lo_s == sent)
         first = dedup.first_occurrence_mask(hi_s, lo_s, invalid_s)
         seen, rank = dedup.rank_sorted(vhi, vlo, vn, hi_s, lo_s)
         is_new = first & ~seen
 
-        DM = D * M
-        src_parent = (order // M) * bucket + (order % M) // C
-        src_act = act_ids[(order % M) % C]
-        pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, DM)
-        out = jnp.zeros((DM, K), jnp.uint32).at[pos].set(g_cand[order])
-        out_parent = jnp.full((DM,), -1, jnp.int32).at[pos].set(src_parent)
-        out_act = jnp.full((DM,), -1, jnp.int32).at[pos].set(src_act)
-        out_hi = jnp.full((DM,), sent).at[pos].set(hi_s)
-        out_lo = jnp.full((DM,), sent).at[pos].set(lo_s)
-        out_rank = jnp.zeros((DM,), jnp.int32).at[pos].set(rank)
+        pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, R)
+        out = jnp.zeros((R, K), jnp.uint32).at[pos].set(r_cand[order])
+        out_parent = jnp.full((R,), -1, jnp.int32).at[pos].set(r_parent[order])
+        out_act = jnp.full((R,), -1, jnp.int32).at[pos].set(r_act[order])
+        out_hi = jnp.full((R,), sent).at[pos].set(hi_s)
+        out_lo = jnp.full((R,), sent).at[pos].set(lo_s)
+        out_rank = jnp.zeros((R,), jnp.int32).at[pos].set(rank)
         new_n = jnp.sum(is_new, dtype=jnp.int32)
 
-        vhi2, vlo2, vn2 = dedup.merge_ranked(
-            vhi, vlo, vn, out_hi, out_lo, out_rank, new_n, vcap
-        )
+        if with_merge:
+            vhi2, vlo2, vn2 = dedup.merge_ranked(
+                vhi, vlo, vn, out_hi, out_lo, out_rank, new_n, vcap
+            )
+        else:
+            # host-FpSet backend: the device holds no visited set (the
+            # placeholder probe above sees vn=0); the host inserts each
+            # shard's batch-deduped fingerprints into its own FpSet
+            vhi2, vlo2, vn2 = vhi, vlo, vn
 
         # invariants on the frontier shard being expanded (checked once per
         # state, at expansion; `states` is already unpacked)
@@ -133,7 +199,7 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
             viol_any, viol_idx = [jnp.bool_(False)], [jnp.int32(0)]
 
         return (
-            out,  # [D*M, K] per-shard compacted (out_spec concatenates to [D*D*M])
+            out,  # [R, K] per-shard compacted (out_spec concatenates to [D*R])
             out_parent,
             out_act,
             new_n[None],
@@ -145,13 +211,17 @@ def _make_sharded_step(model: Model, mesh: Mesh, bucket: int, vcap: int):
             jnp.any(deadlocked)[None],
             jnp.argmax(deadlocked)[None],
             act_en[None],  # [1, n_actions] -> [D, n_actions]
+            ovf_expand[None],
+            ovf_dest[None],
+            out_hi,  # [R] per shard (host-FpSet backend reads these)
+            out_lo,
         )
 
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
-        out_specs=tuple([P("d")] * 12),
+        out_specs=tuple([P("d")] * 16),
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -170,6 +240,9 @@ def check_sharded(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
     stats_path: Optional[str] = None,
+    compact_shift: int = 2,
+    exchange: str = "all_to_all",
+    visited_backend: str = "device",
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -185,12 +258,28 @@ def check_sharded(
     checkpoint_every-1 levels); a run restarts from the last saved level
     (store_trace forced off, as in engine.check).  A checkpoint binds to
     (model, constants, invariant selection, deadlock flag, mesh size).
+
+    compact_shift: two-phase expansion (see engine.check) — guards sweep the
+    full lattice, update/pack/sort/exchange run at 1/2^shift of it.  0
+    disables.  exchange: "all_to_all" (bucket-by-owner routing, per-shard
+    ICI traffic independent of mesh size) or "all_gather" (every shard sees
+    every candidate — D× the bytes, simple fallback).  Both are exact; any
+    buffer overflow is detected on device and the chunk re-runs wider.
+
+    visited_backend: "device" keeps each shard's sorted fingerprint set in
+    its own HBM; "host" gives each shard its own native C++ open-addressing
+    FpSet on the host (keyed by owner — ownership routing guarantees a
+    fingerprint always lands in the same shard's set), so the distributed
+    engine can check state spaces whose fingerprints outgrow HBM — the
+    TLC-FPSet spill mode of engine.check, now at pod scale.  Device memory
+    then holds only O(chunk × fanout) transient data per shard.
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
     D = mesh.devices.size
     spec = model.spec
-    C = sum(a.n_choices for a in model.actions)
+    expander = _Step(model)  # width bookkeeping only; steps build their own
+    C = expander.C
     K = spec.num_lanes
 
     inits = [
@@ -229,20 +318,44 @@ def check_sharded(
                     0.0,
                     stats={"devices": D},
                 )
+    if visited_backend not in ("device", "host"):
+        raise ValueError(
+            f"visited_backend must be 'device' or 'host', got {visited_backend!r}"
+        )
+    host_sets = None
+
+    def _u64(hi, lo):
+        return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+
     # distribute inits to owner shards; per-shard sorted visited arrays
     hi0, lo0 = fingerprint_lanes(jnp.asarray(init_packed), spec.exact64)
     hi0, lo0 = np.asarray(hi0), np.asarray(lo0)
     owner0 = lo0 % D
-    vcap = _next_pow2(max(1024, 4 * n0))
-    vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
-    vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
-    vn = np.zeros((D,), np.int32)
-    for d in range(D):
-        sel = np.nonzero(owner0 == d)[0]
-        order = np.lexsort((lo0[sel], hi0[sel]))
-        vhi[d, : len(sel)] = hi0[sel][order]
-        vlo[d, : len(sel)] = lo0[sel][order]
-        vn[d] = len(sel)
+    if visited_backend == "host":
+        from ..native import FpSet
+
+        # one FpSet per shard: ownership routing sends a fingerprint to the
+        # same shard every time, so per-shard sets never need cross-talk
+        host_sets = [FpSet() for _ in range(D)]
+        for d in range(D):
+            sel = np.nonzero(owner0 == d)[0]
+            if len(sel):
+                host_sets[d].insert(_u64(hi0[sel], lo0[sel]))
+        vcap = 64  # device placeholders; the device never holds the set
+        vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vn = np.zeros((D,), np.int32)
+    else:
+        vcap = _next_pow2(max(1024, 4 * n0))
+        vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vn = np.zeros((D,), np.int32)
+        for d in range(D):
+            sel = np.nonzero(owner0 == d)[0]
+            order = np.lexsort((lo0[sel], hi0[sel]))
+            vhi[d, : len(sel)] = hi0[sel][order]
+            vlo[d, : len(sel)] = lo0[sel][order]
+            vn[d] = len(sel)
 
     # per-shard pending frontiers live on the host; each level streams them
     # through the compiled step in fixed-size chunks (same scheme as
@@ -251,16 +364,19 @@ def check_sharded(
     pending = [init_packed[owner0 == d] for d in range(D)]
     chunk = _next_pow2(max(32, chunk_size))
 
+    if exchange not in ("all_to_all", "all_gather"):
+        raise ValueError(f"unknown exchange {exchange!r}")
     levels = [n0]
     total = n0
     depth = 0
     violation = None
     steps = {}
+    w_extra = 0  # extra doublings of the all_to_all per-destination width
 
     ckpt_path = None
     inv_names = ",".join(sorted(i.name for i in model.invariants))
     ckpt_ident = (
-        f"{model.name}|lanes={spec.num_lanes}|D={D}|"
+        f"{model.name}|lanes={spec.num_lanes}|D={D}|backend={visited_backend}|"
         f"inv={inv_names}|dl={check_deadlock}|"
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
     )
@@ -278,12 +394,23 @@ def check_sharded(
             for ln in plens:
                 pending.append(flat[at : at + int(ln)])
                 at += int(ln)
-            vcap = int(snap["vcap"])
-            vn = snap["vn"]
-            w = snap["vhi"].shape[1]
-            pad = np.full((D, vcap - w), 0xFFFFFFFF, np.uint32)
-            vhi = np.concatenate([snap["vhi"], pad], axis=1)
-            vlo = np.concatenate([snap["vlo"], pad], axis=1)
+            if host_sets is not None:
+                from ..native import FpSet
+
+                fps_flat, at = snap["host_fps"], 0
+                host_sets = []
+                for ln in snap["host_lens"]:
+                    s = FpSet(initial_capacity=max(64, 2 * int(ln)))
+                    s.insert(fps_flat[at : at + int(ln)])
+                    at += int(ln)
+                    host_sets.append(s)
+            else:
+                vcap = int(snap["vcap"])
+                vn = snap["vn"]
+                w = snap["vhi"].shape[1]
+                pad = np.full((D, vcap - w), 0xFFFFFFFF, np.uint32)
+                vhi = np.concatenate([snap["vhi"], pad], axis=1)
+                vlo = np.concatenate([snap["vlo"], pad], axis=1)
             levels = snap["levels"].tolist()
             total = int(snap["total"])
             depth = int(snap["depth"])
@@ -294,6 +421,21 @@ def check_sharded(
     dev_vn = jax.device_put(vn, shard1)
 
     def _save_checkpoint():
+        if host_sets is not None:
+            dumps = [s.dump() for s in host_sets]
+            extra = {
+                "host_fps": np.concatenate(dumps)
+                if dumps
+                else np.empty(0, np.uint64),
+                "host_lens": np.asarray([len(x) for x in dumps]),
+            }
+        else:
+            # trim the common sentinel tail (rebuilt on resume from vcap)
+            extra = {
+                "vhi": np.asarray(dev_vhi)[:, : int(np.asarray(dev_vn).max())],
+                "vlo": np.asarray(dev_vlo)[:, : int(np.asarray(dev_vn).max())],
+                "vn": np.asarray(dev_vn),
+            }
         atomic_savez(
             ckpt_path,
             ident=ckpt_ident,
@@ -301,14 +443,11 @@ def check_sharded(
             if any(p.shape[0] for p in pending)
             else np.empty((0, K), np.uint32),
             pending_lens=np.asarray([p.shape[0] for p in pending]),
-            # trim the common sentinel tail (rebuilt on resume from vcap)
-            vhi=np.asarray(dev_vhi)[:, : int(np.asarray(dev_vn).max())],
-            vlo=np.asarray(dev_vlo)[:, : int(np.asarray(dev_vn).max())],
-            vn=np.asarray(dev_vn),
             vcap=vcap,
             levels=np.asarray(levels),
             total=total,
             depth=depth,
+            **extra,
         )
 
     def decode_row(row):
@@ -359,43 +498,76 @@ def check_sharded(
                 offs[d] += rows.shape[0]
             fvalid = np.arange(bucket)[None, :] < took[:, None]
 
-            # grow per-shard visited capacity for the worst-case merge
-            need = int(np.asarray(dev_vn).max()) + D * bucket * C
-            if need > vcap:
-                vcap = _next_pow2(need)
-                pad = jnp.full(
-                    (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
+            # overflow-retry loop: expansion-compaction overflow halves the
+            # shift, destination-bucket overflow doubles the per-dest width;
+            # a failed attempt's visited arrays are simply discarded (the
+            # step is functional), so results stay exact at every width
+            while True:
+                sh = _norm_shift(
+                    bucket, compact_shift if (compact_shift > 0 and bucket >= 1024) else 0
                 )
-                dev_vhi = jax.device_put(
-                    jnp.concatenate([dev_vhi, pad], axis=1), shard1
-                )
-                dev_vlo = jax.device_put(
-                    jnp.concatenate([dev_vlo, pad], axis=1), shard1
-                )
+                T = expander.expand_width(bucket, sh)
+                W = min(T, _default_dest_w(T, D) << w_extra)
+                R = D * W if exchange == "all_to_all" else D * T
+                if host_sets is None:
+                    # grow per-shard visited capacity for the worst-case merge
+                    need = int(np.asarray(dev_vn).max()) + R
+                    if need > vcap:
+                        vcap = _next_pow2(need)
+                        pad = jnp.full(
+                            (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
+                        )
+                        dev_vhi = jax.device_put(
+                            jnp.concatenate([dev_vhi, pad], axis=1), shard1
+                        )
+                        dev_vlo = jax.device_put(
+                            jnp.concatenate([dev_vlo, pad], axis=1), shard1
+                        )
 
-            key = (bucket, vcap)
-            if key not in steps:
-                steps[key] = _make_sharded_step(model, mesh, bucket, vcap)
-            (
-                out,
-                out_parent,
-                out_act,
-                new_n,
-                dev_vhi,
-                dev_vlo,
-                dev_vn,
-                viol_any,
-                viol_idx,
-                dl_any,
-                dl_idx,
-                act_en,
-            ) = steps[key](
-                jax.device_put(frontier.reshape(D * bucket, K), shard1),
-                jax.device_put(fvalid.reshape(D * bucket), shard1),
-                dev_vhi,
-                dev_vlo,
-                dev_vn,
-            )
+                key = (bucket, vcap, sh, exchange, W)
+                if key not in steps:
+                    steps[key] = _make_sharded_step(
+                        model,
+                        mesh,
+                        bucket,
+                        vcap,
+                        compact=sh or None,
+                        exchange=exchange,
+                        dest_w=W,
+                        with_merge=host_sets is None,
+                    )
+                (
+                    out,
+                    out_parent,
+                    out_act,
+                    new_n,
+                    vhi_n,
+                    vlo_n,
+                    vn_n,
+                    viol_any,
+                    viol_idx,
+                    dl_any,
+                    dl_idx,
+                    act_en,
+                    ovf_expand,
+                    ovf_dest,
+                    out_hi,
+                    out_lo,
+                ) = steps[key](
+                    jax.device_put(frontier.reshape(D * bucket, K), shard1),
+                    jax.device_put(fvalid.reshape(D * bucket), shard1),
+                    dev_vhi,
+                    dev_vlo,
+                    dev_vn,
+                )
+                if sh and np.asarray(ovf_expand).any():
+                    compact_shift = sh - 1
+                    continue
+                if exchange == "all_to_all" and W < T and np.asarray(ovf_dest).any():
+                    w_extra += 1
+                    continue
+                dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
+                break
             # frontier-level verdicts (states being expanded = level `depth`)
             viol_any_np = np.asarray(viol_any)  # [D, n_inv]
             if viol_any_np.any():
@@ -414,26 +586,46 @@ def check_sharded(
             counts = np.asarray(new_n)
             M_per = out.shape[0] // D
             # device-side slice to the widest shard before the host copy —
-            # the padded buffer is D*bucket*C rows/shard, mostly empty
+            # the padded buffer is mostly empty
             cmax = int(counts.max())
             out3 = np.asarray(out.reshape(D, M_per, K)[:, :cmax])
             if store_trace:
                 parent_np = np.asarray(out_parent.reshape(D, M_per)[:, :cmax])
                 act_np = np.asarray(out_act.reshape(D, M_per)[:, :cmax])
+            if host_sets is not None and cmax:
+                hi3 = np.asarray(out_hi.reshape(D, M_per)[:, :cmax])
+                lo3 = np.asarray(out_lo.reshape(D, M_per)[:, :cmax])
+            newc = np.zeros(D, np.int64)
             for d in range(D):
-                if counts[d]:
-                    next_pending[d].append(out3[d, : counts[d]])
+                c = int(counts[d])
+                if not c:
+                    continue
+                rows = out3[d, :c]
+                p = parent_np[d, :c].astype(np.int64) if store_trace else None
+                a = act_np[d, :c].astype(np.int64) if store_trace else None
+                if host_sets is not None:
+                    # global dedup via this shard's own FpSet (batch dedup
+                    # already happened on device; insert() returns the mask
+                    # of first-time fingerprints)
+                    mask = host_sets[d].insert(_u64(hi3[d, :c], lo3[d, :c]))
+                    rows = rows[mask]
                     if store_trace:
-                        # step parents are d_src*bucket + i within this padded
-                        # chunk -> level-global index in shard-major order
-                        p = parent_np[d, : counts[d]].astype(np.int64)
-                        src_d = p // bucket
-                        src_i = p % bucket
-                        next_parent[d].append(
-                            prev_base[src_d] + chunk_off[src_d] + src_i
-                        )
-                        next_act[d].append(act_np[d, : counts[d]].astype(np.int64))
-            lvl_new_per_shard += counts
+                        p, a = p[mask], a[mask]
+                    c = rows.shape[0]
+                    if not c:
+                        continue
+                next_pending[d].append(rows)
+                if store_trace:
+                    # step parents are d_src*bucket + i within this padded
+                    # chunk -> level-global index in shard-major order
+                    src_d = p // bucket
+                    src_i = p % bucket
+                    next_parent[d].append(
+                        prev_base[src_d] + chunk_off[src_d] + src_i
+                    )
+                    next_act[d].append(a)
+                newc[d] = c
+            lvl_new_per_shard += newc
             if stats_path is not None:
                 lvl_act_en += np.asarray(act_en, np.int64).sum(axis=0)
 
@@ -531,5 +723,16 @@ def check_sharded(
         violation=violation,
         seconds=dt,
         states_per_sec=total / max(dt, 1e-9),
-        stats={"devices": D, "visited_capacity_per_shard": int(vcap), "fanout": C},
+        stats={
+            "devices": D,
+            "visited_capacity_per_shard": int(vcap),
+            "fanout": C,
+            "visited_backend": visited_backend,
+            "exchange": exchange,
+            **(
+                {"host_fpset_sizes": [len(s) for s in host_sets]}
+                if host_sets is not None
+                else {}
+            ),
+        },
     )

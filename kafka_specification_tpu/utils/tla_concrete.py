@@ -1,0 +1,232 @@
+"""Concrete (set-semantics) evaluator for the TLA+ expression IR.
+
+The third, fully independent execution path for the parsed modules (next to
+the hand-written kernels/oracles and the mechanically emitted kernels of
+utils/tla_emit.py): evaluates the IR directly over Python values the way
+TLC's interpreter does — records as dicts, functions as {index: value}
+dicts, sets as frozensets, CHOOSE by deterministic search — and enumerates
+action successors by trying every witness of every existential.
+
+Used by tests to cross-check all three paths on exact state sets; also
+demonstrates Util's Min/Max/Range working straight from their CHOOSE-based
+definitions (Util.tla:22-24) with no hand translation at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from . import tla_expr as E
+
+
+def _freeze(v):
+    """Hashable canonical form of a concrete TLA value (for state sets)."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, frozenset):
+        return frozenset(_freeze(x) for x in v)
+    return v
+
+
+class ConcreteEval:
+    def __init__(self, defs: dict, consts: dict):
+        self.defs = defs  # name -> (params, ast)
+        self.consts = consts  # name -> int | frozenset
+
+    def eval(self, ast, env: dict) -> Any:
+        ev = self.eval
+        if isinstance(ast, E.Num):
+            return ast.v
+        if isinstance(ast, E.At):
+            return env["@"]
+        if isinstance(ast, E.Name):
+            if ast.id in env:
+                return env[ast.id]
+            if ast.id in self.consts:
+                return self.consts[ast.id]
+            params, body = self.defs[ast.id]
+            if params:
+                raise TypeError(f"{ast.id} needs arguments")
+            return ev(body, env)
+        if isinstance(ast, E.Apply):
+            params, body = self.defs[ast.op]
+            sub = dict(env)
+            sub.update(zip(params, (ev(a, env) for a in ast.args)))
+            return ev(body, sub)
+        if isinstance(ast, E.Let):
+            sub = dict(env)
+            for name, params, expr in ast.binds:
+                if params:
+                    raise NotImplementedError("parameterized LET")
+                sub[name] = ev(expr, sub)
+            return ev(ast.body, sub)
+        if isinstance(ast, E.Unop):
+            a = ev(ast.a, env)
+            return (not a) if ast.op == "not" else -a
+        if isinstance(ast, E.Binop):
+            op = ast.op
+            if op == "and":
+                return bool(ev(ast.a, env)) and bool(ev(ast.b, env))
+            if op == "or":
+                return bool(ev(ast.a, env)) or bool(ev(ast.b, env))
+            a = ev(ast.a, env)
+            if op == "\\in":
+                return self._member(a, ev(ast.b, env))
+            if op == "\\notin":
+                return not self._member(a, ev(ast.b, env))
+            b = ev(ast.b, env)
+            if op == "..":
+                return frozenset(range(a, b + 1))
+            if op == "\\union":
+                return frozenset(a) | frozenset(b)
+            if op == "\\":
+                return frozenset(a) - frozenset(b)
+            if op == "=":
+                return _freeze(a) == _freeze(b)
+            if op == "#":
+                return _freeze(a) != _freeze(b)
+            return {
+                "+": lambda: a + b,
+                "-": lambda: a - b,
+                "*": lambda: a * b,
+                "<": lambda: a < b,
+                ">": lambda: a > b,
+                "<=": lambda: a <= b,
+                ">=": lambda: a >= b,
+            }[op]()
+        if isinstance(ast, E.Index):
+            return ev(ast.base, env)[ev(ast.idx, env)]
+        if isinstance(ast, E.FieldAcc):
+            return ev(ast.base, env)[ast.name]
+        if isinstance(ast, E.IfThenElse):
+            return (
+                ev(ast.then, env) if ev(ast.cond, env) else ev(ast.other, env)
+            )
+        if isinstance(ast, E.Quant):
+            def q(binds, env):
+                if not binds:
+                    return bool(ev(ast.body, env))
+                (var, dom), rest = binds[0], binds[1:]
+                elems = ev(dom, env)
+                if ast.kind == "A":
+                    return all(q(rest, {**env, var: e}) for e in elems)
+                return any(q(rest, {**env, var: e}) for e in elems)
+
+            return q(list(ast.binds), env)
+        if isinstance(ast, E.Choose):
+            dom = ev(ast.domain, env)
+            for e in sorted(dom, key=_freeze):
+                if ev(ast.body, {**env, ast.var: e}):
+                    return e
+            raise ValueError("CHOOSE: no witness")
+        if isinstance(ast, E.FunCons):
+            dom = ev(ast.domain, env)
+            return {e: ev(ast.body, {**env, ast.var: e}) for e in dom}
+        if isinstance(ast, E.RecordCons):
+            return {n: ev(x, env) for n, x in ast.fields}
+        if isinstance(ast, E.RecordType):
+            return ("__rectype__", {n: ev(x, env) for n, x in ast.fields})
+        if isinstance(ast, E.FunType):
+            return ("__funtype__", ev(ast.dom, env), ev(ast.rng, env))
+        if isinstance(ast, E.SetLit):
+            return frozenset(_freeze(ev(x, env)) for x in ast.elems)
+        if isinstance(ast, E.SetMap):
+            dom = ev(ast.domain, env)
+            return frozenset(
+                _freeze(ev(ast.body, {**env, ast.var: e})) for e in dom
+            )
+        if isinstance(ast, E.Domain):
+            return frozenset(ev(ast.fn, env).keys())
+        if isinstance(ast, E.Except):
+            # [f EXCEPT !p1 = e1, !p2 = e2] is nested single updates
+            # ([[f EXCEPT !p1 = e1] EXCEPT !p2 = e2]), so each update's @
+            # (and old value) sees the result of the previous one
+            out = _deep_copy(ev(ast.base, env))
+            for path, expr in ast.updates:
+                orig = out
+                steps = []
+                for kind, x in path:
+                    key = x if kind == "f" else ev(x, env)
+                    steps.append(key)
+                    orig = orig[key]
+                tgt = out
+                for key in steps[:-1]:
+                    tgt = tgt[key]
+                tgt[steps[-1]] = ev(expr, {**env, "@": orig})
+            return out
+        raise NotImplementedError(type(ast).__name__)
+
+    def _member(self, v, s) -> bool:
+        if isinstance(s, tuple) and s and s[0] == "__rectype__":
+            return isinstance(v, dict) and all(
+                self._member(v[n], fs) for n, fs in s[1].items()
+            )
+        if isinstance(s, tuple) and s and s[0] == "__funtype__":
+            return (
+                isinstance(v, dict)
+                and frozenset(v.keys()) == frozenset(s[1])
+                and all(self._member(x, s[2]) for x in v.values())
+            )
+        return _freeze(v) in frozenset(_freeze(x) for x in s)
+
+    # ------------------------------------------------ successor enumeration
+    def successors(self, action_ast, env: dict) -> Iterator[dict]:
+        """All {var: value} primed assignments for which the action body can
+        hold, one per existential-witness combination that satisfies it."""
+        yield from self._sat(action_ast, env, {})
+
+    def _sat(self, ast, env, primes) -> Iterator[dict]:
+        if isinstance(ast, E.Binop) and ast.op == "and":
+            for p1 in self._sat(ast.a, env, primes):
+                yield from self._sat(ast.b, env, p1)
+            return
+        if isinstance(ast, E.Binop) and ast.op == "or":
+            yield from self._sat(ast.a, env, primes)
+            yield from self._sat(ast.b, env, primes)
+            return
+        if isinstance(ast, E.Quant) and ast.kind == "E":
+            def q(binds, env):
+                if not binds:
+                    yield from self._sat(ast.body, env, primes)
+                    return
+                (var, dom), rest = binds[0], binds[1:]
+                for e in sorted(self.eval(dom, env), key=_freeze):
+                    yield from q(rest, {**env, var: e})
+
+            yield from q(list(ast.binds), env)
+            return
+        if (
+            isinstance(ast, E.Binop)
+            and ast.op == "="
+            and isinstance(ast.a, E.Prime)
+            and isinstance(ast.a.base, E.Name)
+        ):
+            var = ast.a.base.id
+            val = self.eval(ast.b, env)
+            if var in primes:
+                if _freeze(primes[var]) == _freeze(val):
+                    yield primes
+                return
+            yield {**primes, var: val}
+            return
+        if isinstance(ast, E.Apply):
+            params, body = self.defs[ast.op]
+            sub = dict(env)
+            sub.update(zip(params, (self.eval(a, env) for a in ast.args)))
+            yield from self._sat(body, sub, primes)
+            return
+        if isinstance(ast, E.Let):
+            sub = dict(env)
+            for name, params, expr in ast.binds:
+                sub[name] = self.eval(expr, sub)
+            yield from self._sat(ast.body, sub, primes)
+            return
+        # plain boolean conjunct
+        if self.eval(ast, env):
+            yield primes
+
+
+def _deep_copy(v):
+    if isinstance(v, dict):
+        return {k: _deep_copy(x) for k, x in v.items()}
+    return v

@@ -1,0 +1,880 @@
+"""Mechanical kernel emission from the TLA+ expression IR.
+
+Closes the loop utils/tla_expr.py opens: given a parsed module, constant
+valuations, and a tensor-encoding schema for each VARIABLE, this module
+
+  1. extracts the action list from `Next` (quantifier prefixes become the
+     static choice lattice; each disjunct becomes one action),
+  2. normalizes each action body (inline operator applications and LET
+     bindings, hoist update-dominating \\E quantifiers into the choice
+     space, split conjuncts into guards vs primed assignments),
+  3. evaluates guards/updates SYMBOLICALLY over jnp state tensors —
+     producing exactly the `(state, choice) -> (enabled, next_state)`
+     kernels the engine vmaps (models/base.Action), and
+  4. evaluates the same IR CONCRETELY over Python values — an independent
+     successor enumerator used to cross-check both the emitted kernels and
+     the hand-written models.
+
+Integer values carry static interval bounds (IVal) so quantifiers over
+data-dependent ranges (e.g. `0 .. logs[r].endOffset - 1` in TypeOk,
+FiniteReplicatedLog.tla:95) unroll to masked reductions with a static trip
+count — the jit-compatibility requirement.
+
+Scope: the full expression surface of Util/IdSequence/FiniteReplicatedLog
+(SURVEY.md §2.5 row 1 "hand-written kernels acceptable for v0 if
+cross-validated" — this module begins retiring that caveat).  CHOOSE is
+evaluated concretely (Util's Min/Max come out of their CHOOSE definitions
+mechanically); symbolic CHOOSE emission is deferred with the L3 modules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import tla_expr as E
+from .tla_frontend import TlaModule
+
+
+# ------------------------------------------------------------------ schemas
+@dataclass(frozen=True)
+class SInt:
+    """Integer leaf stored in state[field][<enclosing function indices>]."""
+
+    field: str
+    lo: int
+    hi: int
+
+
+@dataclass(frozen=True)
+class SFun:
+    """Function over 0..size-1."""
+
+    size: int
+    elem: Any
+
+
+@dataclass(frozen=True)
+class SRec:
+    fields: dict  # name -> schema
+
+
+# ------------------------------------------------------- symbolic int value
+class IVal:
+    """Symbolic integer with static interval bounds [lo, hi]."""
+
+    __slots__ = ("val", "lo", "hi")
+
+    def __init__(self, val, lo: int, hi: int):
+        self.val = val
+        self.lo = int(lo)
+        self.hi = int(hi)
+
+    @staticmethod
+    def of(x) -> "IVal":
+        if isinstance(x, IVal):
+            return x
+        if isinstance(x, (int, np.integer)):
+            return IVal(int(x), int(x), int(x))
+        raise TypeError(f"not an integer value: {x!r}")
+
+    def __add__(self, o):
+        o = IVal.of(o)
+        return IVal(self.val + o.val, self.lo + o.lo, self.hi + o.hi)
+
+    def __sub__(self, o):
+        o = IVal.of(o)
+        return IVal(self.val - o.val, self.lo - o.hi, self.hi - o.lo)
+
+    def __mul__(self, o):
+        o = IVal.of(o)
+        cs = [self.lo * o.lo, self.lo * o.hi, self.hi * o.lo, self.hi * o.hi]
+        return IVal(self.val * o.val, min(cs), max(cs))
+
+    def __neg__(self):
+        return IVal(-self.val, -self.hi, -self.lo)
+
+    def __repr__(self):
+        return f"IVal({self.val!r}, [{self.lo},{self.hi}])"
+
+
+def _where_ival(cond, a: IVal, b: IVal) -> IVal:
+    return IVal(jnp.where(cond, a.val, b.val), min(a.lo, b.lo), max(a.hi, b.hi))
+
+
+# ------------------------------------------------------ symbolic set values
+@dataclass
+class SetRange:
+    lo: IVal
+    hi: IVal  # inclusive; may be symbolic (bounds give the static trip count)
+
+
+@dataclass
+class SetLitV:
+    elems: list  # of IVal
+
+
+@dataclass
+class SetUnion:
+    parts: list
+
+
+@dataclass
+class SetDiffV:
+    base: Any
+    excl: list  # of IVal
+
+
+@dataclass
+class SetCondV:  # IF cond THEN s1 ELSE s2 (data-dependent set)
+    cond: Any
+    a: Any
+    b: Any
+
+
+@dataclass
+class FunTypeV:
+    dom: Any  # set value
+    rng: Any  # set value
+
+
+@dataclass
+class RecTypeV:
+    fields: dict  # name -> set value
+
+
+def _set_member(x: IVal, s) -> Any:
+    if isinstance(s, SetRange):
+        return (x.val >= s.lo.val) & (x.val <= s.hi.val)
+    if isinstance(s, SetLitV):
+        r = False
+        for e in s.elems:
+            r = r | (x.val == e.val) if r is not False else (x.val == e.val)
+        return r if r is not False else jnp.bool_(False)
+    if isinstance(s, SetUnion):
+        r = jnp.bool_(False)
+        for p in s.parts:
+            r = r | _set_member(x, p)
+        return r
+    if isinstance(s, SetDiffV):
+        r = _set_member(x, s.base)
+        for e in s.excl:
+            r = r & (x.val != e.val)
+        return r
+    if isinstance(s, SetCondV):
+        c = _as_bool(s.cond)
+        return (c & _set_member(x, s.a)) | (~c & _set_member(x, s.b))
+    raise NotImplementedError(f"membership in {type(s).__name__}")
+
+
+def _value_in_type(v, t) -> Any:
+    """`v \\in T` for function/record types and integer sets."""
+    if isinstance(t, RecTypeV):
+        r = jnp.bool_(True)
+        for name, fs in t.fields.items():
+            r = r & _value_in_type(v.field(name), fs)
+        return r
+    if isinstance(t, FunTypeV):
+        r = jnp.bool_(True)
+
+        def chk(i):
+            return _value_in_type(v.apply(IVal.of(i)), t.rng)
+
+        r_all = _set_forall(t.dom, chk)
+        return r & r_all
+    return _set_member(IVal.of(v), t)
+
+
+def _set_iter_static(s):
+    """Static unroll list [(concrete_or_IVal elem, present_cond)]."""
+    if isinstance(s, SetRange):
+        # unroll over the static hull [lo.lo, hi.hi]; mask each slot by the
+        # (possibly symbolic) actual bounds — the static-trip-count form of
+        # a data-dependent range
+        out = []
+        for i in range(s.lo.lo, s.hi.hi + 1):
+            cond = True
+            if i < s.lo.hi:  # may fall below the actual lower bound
+                cond = cond & (IVal.of(i).val >= s.lo.val)
+            if i > s.hi.lo:  # may exceed the actual upper bound
+                cond = cond & (IVal.of(i).val <= s.hi.val)
+            out.append((IVal.of(i), cond))
+        return out
+    if isinstance(s, SetLitV):
+        return [(e, True) for e in s.elems]
+    if isinstance(s, SetUnion):
+        out = []
+        for p in s.parts:
+            out.extend(_set_iter_static(p))
+        return out
+    if isinstance(s, SetDiffV):
+        out = []
+        for e, c in _set_iter_static(s.base):
+            for x in s.excl:
+                c = c & (e.val != x.val)
+            out.append((e, c))
+        return out
+    if isinstance(s, SetCondV):
+        c = _as_bool(s.cond)
+        out = [(e, p & c) for e, p in _set_iter_static(s.a)]
+        out += [(e, p & ~c) for e, p in _set_iter_static(s.b)]
+        return out
+    raise NotImplementedError(f"cannot unroll {type(s).__name__}")
+
+
+def _set_forall(s, pred: Callable) -> Any:
+    r = jnp.bool_(True)
+    for e, present in _set_iter_static(s):
+        p = pred(e)
+        r = r & (p | ~_as_bool(present))
+    return r
+
+
+def _set_exists(s, pred: Callable) -> Any:
+    r = jnp.bool_(False)
+    for e, present in _set_iter_static(s):
+        r = r | (pred(e) & _as_bool(present))
+    return r
+
+
+def _as_bool(x):
+    return jnp.bool_(x) if isinstance(x, bool) else x
+
+
+# ----------------------------------------------- function / record values
+class RecV:
+    """Record value protocol: .field(name) -> value."""
+
+    def __init__(self, fields: dict):
+        self._f = fields
+
+    def field(self, name):
+        v = self._f[name]
+        return v() if callable(v) else v
+
+
+class FunV:
+    """Function value protocol: .apply(IVal) -> value; .size for unrolls."""
+
+    def __init__(self, size: int, fn: Callable):
+        self.size = size
+        self._fn = fn
+
+    def apply(self, i):
+        return self._fn(IVal.of(i))
+
+
+def _state_value(schema, state: dict, idx: tuple):
+    """Wrap live state tensors in the value protocol per the schema."""
+    if isinstance(schema, SInt):
+        v = state[schema.field]
+        for k in idx:
+            v = v[k.val if isinstance(k, IVal) else k]
+        return IVal(v, schema.lo, schema.hi)
+    if isinstance(schema, SRec):
+        return RecV(
+            {
+                n: (lambda s=s: _state_value(s, state, idx))
+                for n, s in schema.fields.items()
+            }
+        )
+    if isinstance(schema, SFun):
+        return FunV(schema.size, lambda i: _state_value(schema.elem, state, idx + (i,)))
+    raise TypeError(schema)
+
+
+class CondV:
+    """IF-merged structured value."""
+
+    def __init__(self, cond, a, b):
+        self.cond, self.a, self.b = cond, a, b
+        self.size = getattr(a, "size", None)
+
+    def field(self, name):
+        return _merge(self.cond, self.a.field(name), self.b.field(name))
+
+    def apply(self, i):
+        return _merge(self.cond, self.a.apply(i), self.b.apply(i))
+
+
+_SET_TYPES = (SetRange, SetLitV, SetUnion, SetDiffV, SetCondV)
+
+
+def _merge(cond, a, b):
+    if isinstance(a, IVal) or isinstance(b, IVal):
+        return _where_ival(cond, IVal.of(a), IVal.of(b))
+    if isinstance(a, _SET_TYPES) or isinstance(b, _SET_TYPES):
+        return SetCondV(cond, a, b)
+    return CondV(cond, a, b)
+
+
+class PatchFunV:
+    """base with index `at` replaced by sub-value `val`."""
+
+    def __init__(self, base, at: IVal, val):
+        self.base, self.at, self.val = base, at, val
+        self.size = getattr(base, "size", None)
+
+    def apply(self, i):
+        i = IVal.of(i)
+        return _merge(i.val == self.at.val, self.val, self.base.apply(i))
+
+
+class PatchRecV:
+    def __init__(self, base, name: str, val):
+        self.base, self.name, self.val = base, name, val
+
+    def field(self, name):
+        return self.val if name == self.name else self.base.field(name)
+
+
+# ------------------------------------------------------- symbolic evaluator
+class Emitter:
+    """Evaluates IR symbolically over jnp state tensors.
+
+    env value kinds: IVal | bool-ish | RecV/FunV/... | set values.
+    """
+
+    def __init__(self, defs: dict, consts: dict, var_schemas: dict):
+        self.defs = defs  # name -> (params, ast)
+        self.consts = consts  # name -> IVal | set value
+        self.var_schemas = var_schemas  # TLA variable -> schema
+
+    def eval(self, ast, env: dict):
+        ev = self.eval
+        if isinstance(ast, E.Num):
+            return IVal.of(ast.v)
+        if isinstance(ast, E.At):
+            return env["@"]
+        if isinstance(ast, E.Name):
+            if ast.id in env:
+                return env[ast.id]
+            if ast.id in self.consts:
+                return self.consts[ast.id]
+            if ast.id in self.var_schemas:
+                return _state_value(
+                    self.var_schemas[ast.id], env["__state__"], ()
+                )
+            if ast.id in self.defs:
+                params, body = self.defs[ast.id]
+                if params:
+                    raise TypeError(f"{ast.id} needs arguments")
+                return ev(body, env)
+            raise NameError(ast.id)
+        if isinstance(ast, E.Apply):
+            params, body = self.defs[ast.op]
+            args = [ev(a, env) for a in ast.args]
+            sub = dict(env)
+            sub.update(zip(params, args))
+            return ev(body, sub)
+        if isinstance(ast, E.Let):
+            sub = dict(env)
+            for name, params, expr in ast.binds:
+                if params:
+                    raise NotImplementedError("parameterized LET")
+                sub[name] = ev(expr, sub)
+            return ev(ast.body, sub)
+        if isinstance(ast, E.Unop):
+            if ast.op == "not":
+                return ~_as_bool(ev(ast.a, env))
+            if ast.op == "neg":
+                return -ev(ast.a, env)
+        if isinstance(ast, E.Binop):
+            op = ast.op
+            if op == "and":
+                return _as_bool(ev(ast.a, env)) & _as_bool(ev(ast.b, env))
+            if op == "or":
+                return _as_bool(ev(ast.a, env)) | _as_bool(ev(ast.b, env))
+            if op == "\\in":
+                return _value_in_type(ev(ast.a, env), ev(ast.b, env))
+            if op == "\\notin":
+                return ~_value_in_type(ev(ast.a, env), ev(ast.b, env))
+            if op == "..":
+                return SetRange(IVal.of(ev(ast.a, env)), IVal.of(ev(ast.b, env)))
+            if op == "\\union":
+                return SetUnion([ev(ast.a, env), ev(ast.b, env)])
+            if op == "\\":
+                b = ev(ast.b, env)
+                excl = (
+                    b.elems if isinstance(b, SetLitV) else [IVal.of(b)]
+                )
+                return SetDiffV(ev(ast.a, env), excl)
+            a, b = ev(ast.a, env), ev(ast.b, env)
+            if op in ("+", "-", "*"):
+                a, b = IVal.of(a), IVal.of(b)
+                return {"+": a + b, "-": a - b, "*": a * b}[op]
+            av = a.val if isinstance(a, IVal) else a
+            bv = b.val if isinstance(b, IVal) else b
+            if op == "=":
+                return av == bv
+            if op == "#":
+                return av != bv
+            return {"<": av < bv, ">": av > bv, "<=": av <= bv, ">=": av >= bv}[op]
+        if isinstance(ast, E.Index):
+            return ev(ast.base, env).apply(IVal.of(ev(ast.idx, env)))
+        if isinstance(ast, E.FieldAcc):
+            return ev(ast.base, env).field(ast.name)
+        if isinstance(ast, E.IfThenElse):
+            c = _as_bool(ev(ast.cond, env))
+            return _merge(c, ev(ast.then, env), ev(ast.other, env))
+        if isinstance(ast, E.Quant):
+            def q(binds, body, env):
+                if not binds:
+                    return _as_bool(ev(body, env))
+                (var, dom), rest = binds[0], binds[1:]
+                s = ev(dom, env)
+                red = _set_forall if ast.kind == "A" else _set_exists
+                return red(
+                    s, lambda e: q(rest, body, {**env, var: e})
+                )
+            return q(list(ast.binds), ast.body, env)
+        if isinstance(ast, E.FunCons):
+            dom = ev(ast.domain, env)
+            if not isinstance(dom, SetRange) or dom.lo.lo != dom.lo.hi or dom.hi.lo != dom.hi.hi:
+                raise NotImplementedError("function domain must be a static range")
+            size = dom.hi.hi - dom.lo.lo + 1
+            return FunV(
+                size,
+                lambda i: self.eval(ast.body, {**env, ast.var: i}),
+            )
+        if isinstance(ast, E.RecordCons):
+            return RecV({n: ev(x, env) for n, x in ast.fields})
+        if isinstance(ast, E.RecordType):
+            return RecTypeV({n: ev(x, env) for n, x in ast.fields})
+        if isinstance(ast, E.FunType):
+            return FunTypeV(ev(ast.dom, env), ev(ast.rng, env))
+        if isinstance(ast, E.SetLit):
+            return SetLitV([IVal.of(ev(x, env)) for x in ast.elems])
+        if isinstance(ast, E.Except):
+            # nested-update semantics: each update's @ sees the result of
+            # the previous one ([[f EXCEPT !p1=e1] EXCEPT !p2=e2])
+            out = ev(ast.base, env)
+            for path, expr in ast.updates:
+                out = self._apply_patch(out, out, list(path), expr, env)
+            return out
+        raise NotImplementedError(type(ast).__name__)
+
+    def _apply_patch(self, cur, orig_base, path, expr, env):
+        """One EXCEPT update; @ in expr = original value at the full path."""
+
+        def orig_at(base, p):
+            if not p:
+                return base
+            kind, x = p[0]
+            if kind == "f":
+                return orig_at(base.field(x), p[1:])
+            return orig_at(base.apply(IVal.of(self.eval(x, env))), p[1:])
+
+        def patch(cur_v, base_v, p):
+            if not p:
+                return self.eval(expr, {**env, "@": base_v})
+            kind, x = p[0]
+            if kind == "f":
+                return PatchRecV(
+                    cur_v, x, patch(cur_v.field(x), base_v.field(x), p[1:])
+                )
+            i = IVal.of(self.eval(x, env))
+            return PatchFunV(
+                cur_v, i, patch(cur_v.apply(i), base_v.apply(i), p[1:])
+            )
+
+        return patch(cur, orig_base, path)
+
+
+# ----------------------------------------------------------- normalization
+def inline(ast, defs: dict, keep: set):
+    """Inline applications/names of defined operators (call-by-name) and LET
+    bindings, so the action body becomes a pure expression tree over state
+    variables, constants and bound vars.  `keep` = names NOT to inline
+    (constants, variables, bound vars are resolved by the evaluator).
+
+    Every binder (\\E/\\A/CHOOSE/function-constructor/set-map) is α-renamed
+    to a fresh name on the way down, so substituted argument expressions can
+    never be captured (e.g. TruncateTo's `newEndOffset` argument named
+    `offset` meeting the records constructor's own `offset` binder,
+    FiniteReplicatedLog.tla:105-109)."""
+    counter = [0]
+
+    def fresh(var):
+        counter[0] += 1
+        return f"{var}__{counter[0]}"
+
+    def subst(a, env):
+        if isinstance(a, E.Name):
+            if a.id in env:
+                return env[a.id]
+            if a.id in defs and a.id not in keep:
+                params, body = defs[a.id]
+                if not params:
+                    return subst(body, {})
+            return a
+        if isinstance(a, E.Apply):
+            if a.op in defs and a.op not in keep:
+                params, body = defs[a.op]
+                args = [subst(x, env) for x in a.args]
+                return subst(body, dict(zip(params, args)))
+            return E.Apply(a.op, tuple(subst(x, env) for x in a.args))
+        if isinstance(a, E.Let):
+            sub = dict(env)
+            for name, params, expr in a.binds:
+                sub[name] = subst(expr, sub)
+            return subst(a.body, sub)
+        if isinstance(a, E.Quant):
+            binds, inner = [], dict(env)
+            for v, d in a.binds:
+                nv = fresh(v)
+                binds.append((nv, subst(d, inner)))
+                inner[v] = E.Name(nv)
+            return E.Quant(a.kind, tuple(binds), subst(a.body, inner))
+        if isinstance(a, E.FunCons):
+            nv = fresh(a.var)
+            return E.FunCons(
+                nv,
+                subst(a.domain, env),
+                subst(a.body, {**env, a.var: E.Name(nv)}),
+            )
+        if isinstance(a, E.Choose):
+            nv = fresh(a.var)
+            return E.Choose(
+                nv,
+                subst(a.domain, env),
+                subst(a.body, {**env, a.var: E.Name(nv)}),
+            )
+        if isinstance(a, E.SetMap):
+            nv = fresh(a.var)
+            return E.SetMap(
+                subst(a.body, {**env, a.var: E.Name(nv)}),
+                nv,
+                subst(a.domain, env),
+            )
+        if isinstance(a, E.Binop):
+            return E.Binop(a.op, subst(a.a, env), subst(a.b, env))
+        if isinstance(a, E.Unop):
+            return E.Unop(a.op, subst(a.a, env))
+        if isinstance(a, E.Index):
+            return E.Index(subst(a.base, env), subst(a.idx, env))
+        if isinstance(a, E.FieldAcc):
+            return E.FieldAcc(subst(a.base, env), a.name)
+        if isinstance(a, E.IfThenElse):
+            return E.IfThenElse(
+                subst(a.cond, env), subst(a.then, env), subst(a.other, env)
+            )
+        if isinstance(a, E.RecordCons):
+            return E.RecordCons(tuple((n, subst(x, env)) for n, x in a.fields))
+        if isinstance(a, E.RecordType):
+            return E.RecordType(tuple((n, subst(x, env)) for n, x in a.fields))
+        if isinstance(a, E.FunType):
+            return E.FunType(subst(a.dom, env), subst(a.rng, env))
+        if isinstance(a, E.SetLit):
+            return E.SetLit(tuple(subst(x, env) for x in a.elems))
+        if isinstance(a, E.Except):
+            ups = tuple(
+                (
+                    tuple(
+                        (k, x if k == "f" else subst(x, env)) for k, x in path
+                    ),
+                    subst(expr, env),
+                )
+                for path, expr in a.updates
+            )
+            return E.Except(subst(a.base, env), ups)
+        if isinstance(a, E.Prime):
+            return E.Prime(subst(a.base, env))
+        if isinstance(a, E.Domain):
+            return E.Domain(subst(a.fn, env))
+        return a  # Num, At
+
+    return subst(ast, {})
+
+
+def contains_prime(ast) -> bool:
+    if isinstance(ast, E.Prime):
+        return True
+
+    def walk(v) -> bool:
+        if hasattr(v, "__dataclass_fields__"):
+            if isinstance(v, E.Prime):
+                return True
+            return any(
+                walk(getattr(v, f)) for f in v.__dataclass_fields__
+            )
+        if isinstance(v, tuple):
+            return any(walk(x) for x in v)
+        return False
+
+    return walk(ast)
+
+
+def flatten_and(ast) -> list:
+    if isinstance(ast, E.Binop) and ast.op == "and":
+        return flatten_and(ast.a) + flatten_and(ast.b)
+    return [ast]
+
+
+@dataclass
+class ActionIR:
+    name: str
+    binds: list  # [(var, domain_ast)] — the choice space
+    guards: list  # boolean ASTs
+    updates: dict  # TLA var -> rhs AST
+
+
+def extract_actions(mod: TlaModule, defs: dict, keep: set) -> list[ActionIR]:
+    """Next -> per-disjunct ActionIR with hoisted quantifier binds."""
+    params, next_ast = defs["Next"]
+    assert not params
+
+    out = []
+
+    def walk(ast, binds):
+        if isinstance(ast, E.Quant) and ast.kind == "E":
+            walk(ast.body, binds + list(ast.binds))
+            return
+        if isinstance(ast, E.Binop) and ast.op == "or":
+            walk(ast.a, binds)
+            walk(ast.b, binds)
+            return
+        # leaf: named action application (or bare name)
+        if isinstance(ast, E.Apply):
+            name = ast.op
+            body = inline(ast, defs, keep)
+        elif isinstance(ast, E.Name):
+            name = ast.id
+            body = inline(ast, defs, keep)
+        else:
+            raise NotImplementedError(f"unsupported Next leaf: {ast}")
+        b = list(binds)
+        while isinstance(body, E.Quant) and body.kind == "E" and contains_prime(body.body):
+            b += list(body.binds)
+            body = body.body
+        guards, updates = [], {}
+        for cj in flatten_and(body):
+            if (
+                isinstance(cj, E.Binop)
+                and cj.op == "="
+                and isinstance(cj.a, E.Prime)
+                and isinstance(cj.a.base, E.Name)
+            ):
+                var = cj.a.base.id
+                if var in updates:
+                    raise ValueError(f"{name}: duplicate update of {var}")
+                updates[var] = cj.b
+            elif contains_prime(cj):
+                raise NotImplementedError(f"{name}: prime in non-assignment conjunct")
+            else:
+                guards.append(cj)
+        out.append(ActionIR(name, b, guards, updates))
+
+    walk(next_ast, [])
+    return out
+
+
+# ------------------------------------------------------------ model builder
+def _domain_space(emitter: Emitter, binds, env_builder):
+    """Static choice decomposition for the bind list.
+
+    Returns (sizes, mapper) where mapper(choice_digits, state_env) -> dict
+    var -> IVal.  Supported domains: static ranges / constant sets and
+    `<static set> \\ {<earlier bind var>}` (index remap, the corpus's
+    `Replicas \\ {replica}` case)."""
+    sizes = []
+    specs = []
+    for var, dom_ast in binds:
+        dom_ast = dom_ast
+        specs.append((var, dom_ast))
+    # sizes must be static: evaluate domains with dummy env for earlier vars
+    def static_size(dom_ast):
+        # evaluate with every prior var bound to its range minimum — sizes
+        # of the supported domain forms don't depend on the binding
+        env = {"__state__": {}}
+        dummy = {}
+        for v, _ in specs:
+            dummy[v] = IVal(0, 0, 0)
+        s = emitter.eval(dom_ast, {**env, **dummy})
+        if isinstance(s, SetRange):
+            if s.lo.lo != s.lo.hi or s.hi.lo != s.hi.hi:
+                raise NotImplementedError("choice domain must be static")
+            return s.hi.hi - s.lo.lo + 1, ("range", s.lo.lo)
+        if isinstance(s, SetDiffV):
+            base = s.base
+            if not isinstance(base, SetRange) or len(s.excl) != 1:
+                raise NotImplementedError("unsupported choice domain difference")
+            return base.hi.hi - base.lo.lo + 1 - 1, ("diff", base.lo.lo)
+        raise NotImplementedError(f"choice domain {type(s).__name__}")
+
+    kinds = []
+    for var, dom_ast in specs:
+        n, kind = static_size(dom_ast)
+        sizes.append(n)
+        kinds.append(kind)
+
+    def mapper(digits, env):
+        vals = {}
+        for (var, dom_ast), d, (kind, lo) in zip(specs, digits, kinds):
+            if kind == "range":
+                vals[var] = d + IVal.of(lo)
+            else:  # diff: re-evaluate the excluded element with current binds
+                s = emitter.eval(dom_ast, {**env, **vals})
+                excl = s.excl[0]
+                base_lo = s.base.lo
+                cand = d + base_lo
+                vals[var] = IVal(
+                    jnp.where(cand.val >= excl.val, cand.val + 1, cand.val),
+                    cand.lo,
+                    cand.hi + 1,
+                )
+        return vals
+
+    return sizes, mapper
+
+
+def build_model(
+    mod: TlaModule,
+    consts: dict,
+    var_schemas: dict,
+    spec,
+    invariant_names=("TypeOk",),
+    name: Optional[str] = None,
+):
+    """Emit a models.base.Model mechanically from a parsed TLA+ module.
+
+    consts: name -> int or (lo, hi) range tuple (model-value sets map to
+    0..n-1 ints).  var_schemas: TLA VARIABLE -> SInt/SFun/SRec schema whose
+    leaf fields name entries of `spec` (an ops.packing.StateSpec).
+    """
+    from ..models.base import Action, Invariant, Model
+
+    defs = {}
+    for dname, body in mod.definitions.items():
+        if dname in ("Spec",):
+            continue
+        txt = "\n".join(
+            ln
+            for ln in body.splitlines()
+            if not ln.strip().startswith(("THEOREM", "ASSUME"))
+        )
+        n, params, ast = E.parse_definition(txt)
+        defs[n] = (params, ast)
+
+    cvals = {}
+    for k, v in consts.items():
+        cvals[k] = (
+            SetRange(IVal.of(v[0]), IVal.of(v[1]))
+            if isinstance(v, tuple)
+            else IVal.of(v)
+        )
+    emitter = Emitter(defs, cvals, var_schemas)
+    keep = set(consts) | set(var_schemas)
+
+    actions_ir = extract_actions(mod, defs, keep)
+
+    def make_kernel(air: ActionIR):
+        sizes, mapper = _domain_space(emitter, air.binds, None)
+        n_choices = int(np.prod(sizes)) if sizes else 1
+
+        def kernel(state, choice):
+            env = {"__state__": state}
+            digits = []
+            c = choice
+            for n in reversed(sizes):
+                digits.append(IVal(c % n, 0, n - 1))
+                c = c // n
+            digits.reverse()
+            env.update(mapper(digits, env))
+            ok = jnp.bool_(True)
+            for g in air.guards:
+                ok = ok & _as_bool(emitter.eval(g, env))
+            new_state = dict(state)
+            for var, rhs in air.updates.items():
+                val = emitter.eval(rhs, env)
+                _materialize(var_schemas[var], val, new_state, ())
+            # guard-failed slots keep the (arbitrary) computed tensors; the
+            # engine masks them via `ok`, but clamp indices already guarded
+            return ok, new_state
+
+        return Action(air.name, n_choices, kernel)
+
+    def _materialize(schema, val, out, idx):
+        if isinstance(schema, SInt):
+            arr = out[schema.field]
+            v = IVal.of(val).val
+            out[schema.field] = (
+                arr.at[idx].set(v) if idx else jnp.asarray(v, arr.dtype)
+                if hasattr(arr, "dtype")
+                else v
+            )
+            return
+        if isinstance(schema, SRec):
+            for n, s in schema.fields.items():
+                _materialize(s, val.field(n), out, idx)
+            return
+        if isinstance(schema, SFun):
+            for i in range(schema.size):
+                _materialize(schema.elem, val.apply(IVal.of(i)), out, idx + (i,))
+            return
+        raise TypeError(schema)
+
+    # Init: conjuncts `var = expr`, evaluated concretely
+    from .tla_concrete import ConcreteEval
+
+    conc = ConcreteEval(defs, _concrete_consts(consts))
+
+    def _conc_encode(schema, val, out, idx):
+        if isinstance(schema, SInt):
+            out.setdefault(schema.field, {})[idx] = int(val)
+            return
+        if isinstance(schema, SRec):
+            for n, s in schema.fields.items():
+                _conc_encode(s, val[n], out, idx)
+            return
+        if isinstance(schema, SFun):
+            for i in range(schema.size):
+                _conc_encode(schema.elem, val[i], out, idx + (i,))
+            return
+
+    def init_states_wrapped():
+        _, init_ast = defs["Init"]
+        assigns = {}
+        for cj in flatten_and(init_ast):
+            assigns[cj.a.id] = conc.eval(cj.b, {})
+        pos = {}
+        for var, schema in var_schemas.items():
+            _conc_encode(schema, assigns[var], pos, ())
+        state = {}
+        for f in spec.fields:
+            arr = np.zeros(f.shape, np.int32)
+            for idx, v in pos.get(f.name, {}).items():
+                arr[idx if idx else ()] = v
+            state[f.name] = arr
+        return [state]
+
+    invariants = []
+    for iname in invariant_names:
+        params, ast = defs[iname]
+        body = inline(
+            E.Name(iname) if not params else E.Apply(iname, ()), defs, keep
+        )
+
+        def pred(state, body=body):
+            return _as_bool(emitter.eval(body, {"__state__": state}))
+
+        invariants.append(Invariant(iname, pred))
+
+    return Model(
+        name=name or f"{mod.name}(emitted)",
+        spec=spec,
+        init_states=init_states_wrapped,
+        actions=[make_kernel(a) for a in actions_ir],
+        invariants=invariants,
+        decode=None,
+    )
+
+
+def _concrete_consts(consts: dict) -> dict:
+    out = {}
+    for k, v in consts.items():
+        out[k] = frozenset(range(v[0], v[1] + 1)) if isinstance(v, tuple) else v
+    return out

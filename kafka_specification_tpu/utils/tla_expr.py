@@ -1,0 +1,547 @@
+"""TLA+ expression parser -> typed IR (the expression-level front-end).
+
+This is the second half of the front-end (utils/tla_frontend.py parses
+module *structure*): a tokenizer and Pratt parser for the TLA+ expression
+subset the reference corpus actually uses, producing a small dataclass AST
+that utils/tla_emit.py evaluates — concretely (an independent successor
+enumerator) and symbolically over jnp arrays (mechanical kernel emission).
+
+Subset covered (everything in Util.tla / IdSequence.tla /
+FiniteReplicatedLog.tla, which is also the bulk of the upper layers'
+syntax):
+
+  /\\ \\/ ~  = # < > <= >= \\leq \\geq  + - * ..  \\in \\notin \\union \\ (diff)
+  \\E \\A CHOOSE  IF/THEN/ELSE  LET..IN  DOMAIN
+  f[x]  r.field  x'  Op(args)
+  [x \\in S |-> e]  [f1 |-> e1, ...]  [f1 : S1, ...]  [S -> T]
+  [f EXCEPT ![i].g[j] = e, ...] with @
+  {} {e, ...} {e : x \\in S}  tuples are not used by the corpus
+
+Bullet lists (conjunction/disjunction lists) are indentation-sensitive in
+full TLA+; this parser uses the corpus-sufficient rule: a quantifier/LET/IF
+body that *starts* with a bullet token absorbs the whole following
+/\\-or-\\/ chain, otherwise the body is a single junct (terminated by the
+next /\\ or \\/).  Every module in /root/reference parses correctly under
+this rule (validated by tests/test_tla_expr.py round-trips).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------- AST nodes
+@dataclass(frozen=True)
+class Num:
+    v: int
+
+
+@dataclass(frozen=True)
+class Name:
+    id: str
+
+
+@dataclass(frozen=True)
+class Prime:
+    base: Any  # Name
+
+
+@dataclass(frozen=True)
+class At:  # EXCEPT's @
+    pass
+
+
+@dataclass(frozen=True)
+class Apply:
+    op: str
+    args: tuple
+
+
+@dataclass(frozen=True)
+class Binop:
+    op: str
+    a: Any
+    b: Any
+
+
+@dataclass(frozen=True)
+class Unop:
+    op: str
+    a: Any
+
+
+@dataclass(frozen=True)
+class Index:  # f[x]
+    base: Any
+    idx: Any
+
+
+@dataclass(frozen=True)
+class FieldAcc:  # r.field
+    base: Any
+    name: str
+
+
+@dataclass(frozen=True)
+class Quant:  # \E / \A  [(var, domain), ...] : body
+    kind: str  # "E" | "A"
+    binds: tuple
+    body: Any
+
+
+@dataclass(frozen=True)
+class Choose:
+    var: str
+    domain: Any
+    body: Any
+
+
+@dataclass(frozen=True)
+class IfThenElse:
+    cond: Any
+    then: Any
+    other: Any
+
+
+@dataclass(frozen=True)
+class Let:  # LET name == e  name2(p) == e2 IN body
+    binds: tuple  # ((name, params, expr), ...)
+    body: Any
+
+
+@dataclass(frozen=True)
+class FunCons:  # [x \in S |-> e]
+    var: str
+    domain: Any
+    body: Any
+
+
+@dataclass(frozen=True)
+class RecordCons:  # [f |-> e, ...]
+    fields: tuple  # ((name, expr), ...)
+
+
+@dataclass(frozen=True)
+class RecordType:  # [f : S, ...]
+    fields: tuple
+
+
+@dataclass(frozen=True)
+class FunType:  # [S -> T]
+    dom: Any
+    rng: Any
+
+
+@dataclass(frozen=True)
+class SetLit:  # {e, ...} ({} = empty)
+    elems: tuple
+
+
+@dataclass(frozen=True)
+class SetMap:  # {e : x \in S}
+    body: Any
+    var: str
+    domain: Any
+
+
+@dataclass(frozen=True)
+class Except:  # [f EXCEPT !path = e, ...]
+    base: Any
+    updates: tuple  # ((path, expr), ...); path = (('f', name)|('i', expr), ...)
+
+
+@dataclass(frozen=True)
+class Domain:  # DOMAIN f
+    fn: Any
+
+
+# ---------------------------------------------------------------- tokenizer
+_TOKEN = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<num>\d+)
+  | (?P<landop>/\\)
+  | (?P<lorop>\\/)
+  | (?P<sym>\\leq|\\geq|\\in\b|\\notin\b|\\union\b|\\E\b|\\A\b)
+  | (?P<setdiff>\\(?![a-zA-Z]))
+  | (?P<dots>\.\.)
+  | (?P<arrow>\|->)
+  | (?P<funarrow>->)
+  | (?P<op><=|>=|\#|=|<|>|\+|-|\*|~|')
+  | (?P<punct>[\[\]\(\)\{\},:\.!@])
+  | (?P<name>[A-Za-z_]\w*)
+    """,
+    re.X,
+)
+
+_KEYWORDS = {
+    "IF",
+    "THEN",
+    "ELSE",
+    "LET",
+    "IN",
+    "CHOOSE",
+    "EXCEPT",
+    "DOMAIN",
+    "UNCHANGED",
+    "TRUE",
+    "FALSE",
+}
+
+
+def tokenize(text: str) -> list[tuple[str, str]]:
+    """-> [(kind, lexeme)]; kind in num/name/kw or the lexeme itself."""
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            raise SyntaxError(f"cannot tokenize at: {text[pos:pos+40]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        lex = m.group()
+        if kind == "ws":
+            continue
+        if kind == "num":
+            out.append(("num", lex))
+        elif kind == "name":
+            out.append(("kw" if lex in _KEYWORDS else "name", lex))
+        elif kind == "landop":
+            out.append(("/\\", lex))
+        elif kind == "lorop":
+            out.append(("\\/", lex))
+        elif kind == "setdiff":
+            out.append(("\\", lex))
+        elif kind == "sym":
+            out.append((lex, lex))
+        elif kind == "dots":
+            out.append(("..", lex))
+        elif kind == "arrow":
+            out.append(("|->", lex))
+        elif kind == "funarrow":
+            out.append(("->", lex))
+        else:
+            out.append((lex, lex))
+    return out
+
+
+# ------------------------------------------------------------------- parser
+# binding powers (higher binds tighter)
+_BP = {
+    "\\/": 10,
+    "/\\": 20,
+    "=": 30,
+    "#": 30,
+    "<": 30,
+    ">": 30,
+    "<=": 30,
+    ">=": 30,
+    "\\leq": 30,
+    "\\geq": 30,
+    "\\in": 30,
+    "\\notin": 30,
+    "\\union": 40,
+    "\\": 40,
+    "..": 50,
+    "+": 60,
+    "-": 60,
+    "*": 70,
+}
+_CANON = {"\\leq": "<=", "\\geq": ">=", "#": "#"}
+# a quantifier/LET/IF body that does NOT start with a bullet is a single
+# junct: parse it just above /\ so the enclosing list terminates it
+_JUNCT_BP = 25
+
+
+class _Parser:
+    def __init__(self, toks: list[tuple[str, str]]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self, k=0) -> tuple[str, str]:
+        j = self.i + k
+        return self.toks[j] if j < len(self.toks) else ("<eof>", "")
+
+    def next(self) -> tuple[str, str]:
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, kind: str) -> tuple[str, str]:
+        t = self.next()
+        if t[0] != kind:
+            raise SyntaxError(f"expected {kind!r}, got {t} at {self.i}")
+        return t
+
+    # -- entry: full expression (handles leading bullet chains)
+    def parse(self, min_bp: int = 0):
+        if self.peek()[0] in ("/\\", "\\/"):
+            op = self.peek()[0]
+            self.next()
+            # bullet list: items at just-above-this-op precedence, folded
+            items = [self.parse(_BP[op] + 1)]
+            while self.peek()[0] == op:
+                self.next()
+                items.append(self.parse(_BP[op] + 1))
+            lhs = items[0]
+            for it in items[1:]:
+                lhs = Binop("and" if op == "/\\" else "or", lhs, it)
+            # the folded list may itself be an operand (e.g. of an outer \/)
+            return self._climb(lhs, min_bp)
+        lhs = self.parse_unary()
+        return self._climb(lhs, min_bp)
+
+    def _climb(self, lhs, min_bp: int):
+        while True:
+            kind = self.peek()[0]
+            bp = _BP.get(kind)
+            if bp is None or bp < min_bp:
+                return lhs
+            self.next()
+            rhs = self.parse(bp + 1)
+            op = {"/\\": "and", "\\/": "or"}.get(kind, _CANON.get(kind, kind))
+            lhs = Binop(op, lhs, rhs)
+
+    # body of a quantifier / CHOOSE / LET / IF-arm: bullet -> absorb chain,
+    # else single junct
+    def parse_body(self):
+        if self.peek()[0] in ("/\\", "\\/"):
+            return self.parse(0)
+        return self.parse(_JUNCT_BP)
+
+    def parse_unary(self):
+        kind, lex = self.peek()
+        if kind == "~":
+            self.next()
+            return Unop("not", self.parse_unary_postfix())
+        if kind == "-":
+            self.next()
+            return Unop("neg", self.parse_unary_postfix())
+        if kind in ("\\E", "\\A"):
+            self.next()
+            binds = self._parse_binds()
+            self.expect(":")
+            return Quant(kind[-1], tuple(binds), self.parse_body())
+        if kind == "kw" and lex == "CHOOSE":
+            self.next()
+            var = self.expect("name")[1]
+            self.expect("\\in")
+            dom = self.parse(_JUNCT_BP)
+            self.expect(":")
+            return Choose(var, dom, self.parse_body())
+        if kind == "kw" and lex == "IF":
+            self.next()
+            cond = self.parse_body()
+            if self.peek() == ("kw", "THEN"):
+                self.next()
+            then = self.parse_body()
+            if self.peek() == ("kw", "ELSE"):
+                self.next()
+            other = self.parse_body()
+            return IfThenElse(cond, then, other)
+        if kind == "kw" and lex == "LET":
+            self.next()
+            binds = []
+            while True:
+                nm = self.expect("name")[1]
+                params = ()
+                if self.peek()[0] == "(":
+                    self.next()
+                    ps = [self.expect("name")[1]]
+                    while self.peek()[0] == ",":
+                        self.next()
+                        ps.append(self.expect("name")[1])
+                    self.expect(")")
+                    params = tuple(ps)
+                self.expect("=")
+                self.expect("=")
+                binds.append((nm, params, self.parse(_JUNCT_BP)))
+                nxt = self.peek()
+                if nxt == ("kw", "IN"):
+                    self.next()
+                    break
+                if nxt[0] != "name" or self.peek(1)[0] not in ("=", "("):
+                    # robustness: treat anything else as the IN body start
+                    break
+            return Let(tuple(binds), self.parse_body())
+        return self.parse_unary_postfix()
+
+    def _parse_binds(self):
+        binds = []
+        while True:
+            var = self.expect("name")[1]
+            self.expect("\\in")
+            dom = self.parse(_JUNCT_BP)
+            binds.append((var, dom))
+            if self.peek()[0] == ",":
+                self.next()
+                continue
+            return binds
+
+    def parse_unary_postfix(self):
+        return self._postfix(self.parse_primary())
+
+    def _postfix(self, e):
+        while True:
+            kind = self.peek()[0]
+            if kind == ".":
+                # field access — but `..` is tokenized separately already
+                self.next()
+                e = FieldAcc(e, self.expect("name")[1])
+            elif kind == "[":
+                self.next()
+                idx = self.parse(0)
+                # f[i, j] — not used by the corpus, keep single index
+                self.expect("]")
+                e = Index(e, idx)
+            elif kind == "'":
+                self.next()
+                e = Prime(e)
+            else:
+                return e
+
+    def parse_primary(self):
+        kind, lex = self.next()
+        if kind == "num":
+            return Num(int(lex))
+        if kind == "@":
+            return At()
+        if kind == "kw" and lex in ("TRUE", "FALSE"):
+            return Num(1 if lex == "TRUE" else 0)
+        if kind == "kw" and lex == "DOMAIN":
+            return Domain(self.parse_unary_postfix())
+        if kind == "kw" and lex == "UNCHANGED":
+            return Apply("UNCHANGED", (self.parse_unary_postfix(),))
+        if kind == "name":
+            if self.peek()[0] == "(":
+                self.next()
+                args = [self.parse(0)]
+                while self.peek()[0] == ",":
+                    self.next()
+                    args.append(self.parse(0))
+                self.expect(")")
+                return Apply(lex, tuple(args))
+            return Name(lex)
+        if kind == "(":
+            e = self.parse(0)
+            self.expect(")")
+            return e
+        if kind == "{":
+            if self.peek()[0] == "}":
+                self.next()
+                return SetLit(())
+            first = self.parse(0)
+            if self.peek()[0] == ":":
+                # {body : x \in S}
+                self.next()
+                var = self.expect("name")[1]
+                self.expect("\\in")
+                dom = self.parse(0)
+                self.expect("}")
+                return SetMap(first, var, dom)
+            elems = [first]
+            while self.peek()[0] == ",":
+                self.next()
+                elems.append(self.parse(0))
+            self.expect("}")
+            return SetLit(tuple(elems))
+        if kind == "[":
+            return self._parse_bracket()
+        raise SyntaxError(f"unexpected token {kind!r} {lex!r}")
+
+    def _parse_bracket(self):
+        # disambiguate [x \in S |-> e] / [f |-> e, ...] / [f : S, ...]
+        # / [S -> T] / [f EXCEPT !... = e]
+        if self.peek()[0] == "name":
+            nxt = self.peek(1)[0]
+            if nxt == "\\in":
+                var = self.next()[1]
+                self.next()
+                dom = self.parse(0)
+                self.expect("|->")
+                body = self.parse(0)
+                self.expect("]")
+                return FunCons(var, dom, body)
+            if nxt == "|->":
+                fields = []
+                while True:
+                    nm = self.expect("name")[1]
+                    self.expect("|->")
+                    fields.append((nm, self.parse(0)))
+                    if self.peek()[0] == ",":
+                        self.next()
+                        continue
+                    break
+                self.expect("]")
+                return RecordCons(tuple(fields))
+            if nxt == ":":
+                fields = []
+                while True:
+                    nm = self.expect("name")[1]
+                    self.expect(":")
+                    fields.append((nm, self.parse(0)))
+                    if self.peek()[0] == ",":
+                        self.next()
+                        continue
+                    break
+                self.expect("]")
+                return RecordType(tuple(fields))
+        # general expression, then EXCEPT or ->
+        e = self.parse(0)
+        if self.peek() == ("kw", "EXCEPT"):
+            self.next()
+            updates = []
+            while True:
+                self.expect("!")
+                path = []
+                while True:
+                    k = self.peek()[0]
+                    if k == ".":
+                        self.next()
+                        path.append(("f", self.expect("name")[1]))
+                    elif k == "[":
+                        self.next()
+                        path.append(("i", self.parse(0)))
+                        self.expect("]")
+                    else:
+                        break
+                self.expect("=")
+                updates.append((tuple(path), self.parse(0)))
+                if self.peek()[0] == ",":
+                    self.next()
+                    continue
+                break
+            self.expect("]")
+            return Except(e, tuple(updates))
+        if self.peek()[0] == "->":
+            self.next()
+            rng = self.parse(0)
+            self.expect("]")
+            return FunType(e, rng)
+        self.expect("]")
+        raise SyntaxError("unsupported bracket expression")
+
+
+def parse_expr(text: str):
+    """Parse one TLA+ expression into the IR."""
+    p = _Parser(tokenize(text))
+    e = p.parse(0)
+    if p.peek()[0] != "<eof>":
+        raise SyntaxError(f"trailing tokens from {p.peek()!r}")
+    return e
+
+
+def parse_definition(body: str):
+    """Parse a `Name(params) == expr` definition body (as captured by
+    utils/tla_frontend.parse_tla) -> (name, params, ast)."""
+    head, expr = body.split("==", 1)
+    m = re.match(r"\s*(?:LOCAL\s+)?(\w+)\s*(?:\((.*?)\))?\s*$", head, re.S)
+    if not m:
+        raise SyntaxError(f"bad definition head: {head!r}")
+    name = m.group(1)
+    params = tuple(
+        x.strip() for x in (m.group(2) or "").split(",") if x.strip()
+    )
+    return name, params, parse_expr(expr)

@@ -6,6 +6,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from kafka_specification_tpu.parallel.sharded import check_sharded
+from kafka_specification_tpu.models import async_isr
 from kafka_specification_tpu.models import finite_replicated_log as frl
 from kafka_specification_tpu.models import id_sequence, kip320, variants
 from kafka_specification_tpu.models.kafka_replication import Config
@@ -101,6 +102,58 @@ def test_sharded_checkpoint_rejects_other_mesh_or_model(tmp_path):
     mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
     with _pytest.raises(ValueError, match="different"):
         check_sharded(frl.make_model(2, 2, 2), mesh=mesh4, min_bucket=32, checkpoint_dir=ckdir)
+
+
+def test_sharded_exchange_modes_agree():
+    """all_to_all (bucket-by-owner routing) and all_gather (broadcast +
+    ownership filter) must produce identical exact counts; chunking forces
+    multiple exchanges per level."""
+    m = kip320.make_model(Config(2, 2, 1, 1))
+    for exchange in ("all_to_all", "all_gather"):
+        res = check_sharded(m, min_bucket=32, chunk_size=64, exchange=exchange)
+        assert res.ok, exchange
+        assert res.total == 277, (exchange, res.total)
+        assert res.stats["exchange"] == exchange
+
+
+def test_sharded_host_fpset_backend_exact_count():
+    """Per-shard host FpSet spill (the >HBM mode): counts must match the
+    device-resident visited sets, and the per-shard set sizes must sum to
+    the distinct-state total."""
+    res = check_sharded(
+        frl.make_model(3, 4, 2),
+        min_bucket=8,
+        chunk_size=128,
+        store_trace=False,
+        visited_backend="host",
+    )
+    assert res.ok
+    assert res.total == 29791
+    assert sum(res.stats["host_fpset_sizes"]) == 29791
+
+
+def test_sharded_host_backend_violation_trace():
+    m = variants.make_model(
+        "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("TypeOk", "WeakIsr")
+    )
+    res = check_sharded(m, min_bucket=8, chunk_size=8, visited_backend="host")
+    assert res.violation is not None
+    assert res.violation.invariant == "WeakIsr"
+    assert res.violation.depth == 8
+    assert len(res.violation.trace) == 9
+
+
+def test_sharded_async_isr_constraint_model():
+    """AsyncIsr carries the corpus's only state CONSTRAINT
+    (AsyncIsr.tla:117-119 is unguarded); the sharded engine must apply it
+    identically to engine.check — 4,088 states at (3r, M2, V2)."""
+    cfg = async_isr.AsyncIsrConfig(n_replicas=3, max_offset=2, max_version=2)
+    res = check_sharded(
+        async_isr.make_model(cfg), min_bucket=64, chunk_size=512, store_trace=False
+    )
+    assert res.ok
+    assert res.total == 4088
+    assert res.diameter == 16
 
 
 def test_sharded_deadlock_detection():
