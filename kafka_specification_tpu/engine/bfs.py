@@ -280,11 +280,16 @@ class _Step:
             dl_idx = jnp.argmax(deadlocked)
 
             sent = jnp.uint32(dedup.SENT)
-            if self.use_pallas and not shift:
+            if self.use_pallas:
                 from ..ops.pallas_fingerprint import fingerprint_pallas
 
                 interp = jax.default_backend() == "cpu"
-                block = C * min(bucket, 256)
+                # block_rows must divide T: the compacted buffer is a
+                # concatenation of per-action widths, each a multiple of
+                # bucket>>shift; the full lattice is bucket*C
+                block = (
+                    max(1, bucket >> shift) if shift else C * min(bucket, 256)
+                )
                 hi, lo = fingerprint_pallas(
                     cand, valid, block_rows=block, interpret=interp
                 )
@@ -653,10 +658,13 @@ def check(
             # enabled width (a few % of M) instead of the padded-lattice
             # width.  On overflow (an action enabled more pairs than its
             # compact buffer holds) the visited set returned by the step is
-            # discarded and the chunk re-runs at double the width — exact
-            # results either way, the shift is purely a performance knob.
+            # discarded and THIS chunk re-runs at double the width (the
+            # retry is chunk-local: one dense chunk must not degrade
+            # compaction for the rest of a long run) — exact results either
+            # way, the shift is purely a performance knob.
+            sh_try = compact_shift
             while True:
-                sh = compact_shift if (compact_shift > 0 and bucket >= 4096) else 0
+                sh = sh_try if (sh_try > 0 and bucket >= 4096) else 0
                 step = step_builder.get(
                     bucket,
                     vcap,
@@ -690,7 +698,7 @@ def check(
                 if sh == 0 or not bool(overflow):
                     vhi, vlo, vn = vhi_n, vlo_n, vn_n
                     break
-                compact_shift -= 1
+                sh_try -= 1
             # frontier-level verdicts (states being expanded = level `depth`)
             if check_invariants:
                 viol_any_np = np.asarray(viol_any)

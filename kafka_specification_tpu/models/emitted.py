@@ -1,0 +1,129 @@
+"""Mechanically emitted models for the full corpus (L3/L4).
+
+Builds checker models for KafkaReplication's variants straight from the
+reference TLA+ text (/root/reference/<Module>.tla) via the expression
+front-end (utils/tla_expr -> utils/tla_emit): module structure and EXTENDS /
+INSTANCE WITH substitution from utils/tla_frontend, guards and updates
+evaluated symbolically over the SAME tensor encoding the hand-written
+models use (kafka_replication.make_spec, SURVEY.md §2.2) — so emitted and
+hand-written models are comparable as exact packed state sets per BFS level
+(tests/test_emitted_l3.py).
+
+This is SANY's role (SURVEY.md §2.5 row 1) done end to end: no
+hand-translated guard or update anywhere in this path.
+
+Value conventions match the hand models: `None == "NONE"` is pinned to -1
+via the consts table (KafkaReplication.tla:38); Nil == -1 inlines from its
+own definition (:39); ISRs are bitmasks (SBitset); `leaderAndIsrRequests`
+is the epoch-keyed slot array (SKeyedSet) — sound because every request
+carries a fresh leaderEpoch (ControllerUpdateIsr, :138-145).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ..utils.tla_emit import (
+    SBitset,
+    SFun,
+    SInt,
+    SKeyedSet,
+    SRec,
+    build_model,
+    load_defs,
+)
+from ..utils.tla_frontend import parse_tla
+from .kafka_replication import ABSENT, NIL, NONE, Config, make_spec
+
+REF = Path("/root/reference")
+
+#: the five L4 variant modules (SURVEY.md §2.1) in historical order
+VARIANTS = (
+    "KafkaTruncateToHighWatermark",
+    "Kip101",
+    "Kip279",
+    "Kip320FirstTry",
+    "Kip320",
+)
+
+
+def l3_schemas(cfg: Config) -> dict:
+    """TLA VARIABLE -> tensor schema over the hand spec's lanes
+    (KafkaReplication.tla:45-75 -> make_spec's fields)."""
+    N, L, R, E = cfg.n, cfg.l, cfg.r, cfg.e
+    record = SRec(
+        {"id": SInt("rid", NIL, R - 1), "epoch": SInt("repoch", NIL, E)}
+    )
+    return {
+        "replicaLog": SFun(
+            N,
+            SRec(
+                {
+                    "endOffset": SInt("end", 0, L),
+                    "records": SFun(L, record),
+                }
+            ),
+        ),
+        "replicaState": SFun(
+            N,
+            SRec(
+                {
+                    "hw": SInt("hw", 0, L),
+                    "leaderEpoch": SInt("ep", NIL, E),
+                    "leader": SInt("ldr", NONE, N - 1),
+                    "isr": SBitset("isr", N),
+                }
+            ),
+        ),
+        "nextRecordId": SInt("nrid", 0, R),
+        "nextLeaderEpoch": SInt("nep", 0, E + 1),
+        "quorumState": SRec(
+            {
+                "leaderEpoch": SInt("qep", NIL, E),
+                "leader": SInt("qldr", NONE, N - 1),
+                "isr": SBitset("qisr", N),
+            }
+        ),
+        "leaderAndIsrRequests": SKeyedSet(
+            size=E + 1,
+            key="leaderEpoch",
+            fields={
+                "leader": SInt("req_ldr", ABSENT, N - 1),
+                "isr": SBitset("req_isr", N),
+            },
+            absent_field="leader",
+            absent=ABSENT,
+        ),
+    }
+
+
+def make_emitted_model(
+    module: str,
+    cfg: Config,
+    invariants=("TypeOk",),
+):
+    """Emit the checker model for one variant module from reference text.
+
+    invariants: names resolved in the module's definition namespace
+    (TypeOk / WeakIsr / StrongIsr / LeaderInIsr).  NB LeaderInIsr is the
+    literal reading (quorumState.leader \\in quorumState.isr), which is
+    False at Init — see PARITY.md.
+    """
+    defs = load_defs(REF, module)
+    mod = parse_tla(REF / f"{module}.tla")
+    consts = {
+        "Replicas": (0, cfg.n - 1),
+        "LogSize": cfg.l,
+        "MaxRecords": cfg.r,
+        "MaxLeaderEpoch": cfg.e,
+        "None": NONE,  # model value "NONE" (KafkaReplication.tla:38)
+    }
+    return build_model(
+        mod,
+        consts,
+        l3_schemas(cfg),
+        make_spec(cfg),
+        invariant_names=invariants,
+        name=f"{module}(emitted,{cfg.n}r)",
+        defs=defs,
+    )

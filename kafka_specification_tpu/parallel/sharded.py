@@ -501,13 +501,17 @@ def check_sharded(
             # overflow-retry loop: expansion-compaction overflow halves the
             # shift, destination-bucket overflow doubles the per-dest width;
             # a failed attempt's visited arrays are simply discarded (the
-            # step is functional), so results stay exact at every width
+            # step is functional), so results stay exact at every width.
+            # Both retries are CHUNK-LOCAL: one dense or skew-routed chunk
+            # must not pin the whole remaining run to a wider shape (the
+            # compiled steps stay cached either way).
+            sh_try, w_try = compact_shift, w_extra
             while True:
                 sh = _norm_shift(
-                    bucket, compact_shift if (compact_shift > 0 and bucket >= 1024) else 0
+                    bucket, sh_try if (sh_try > 0 and bucket >= 1024) else 0
                 )
                 T = expander.expand_width(bucket, sh)
-                W = min(T, _default_dest_w(T, D) << w_extra)
+                W = min(T, _default_dest_w(T, D) << w_try)
                 R = D * W if exchange == "all_to_all" else D * T
                 if host_sets is None:
                     # grow per-shard visited capacity for the worst-case merge
@@ -561,10 +565,10 @@ def check_sharded(
                     dev_vn,
                 )
                 if sh and np.asarray(ovf_expand).any():
-                    compact_shift = sh - 1
+                    sh_try = sh - 1
                     continue
                 if exchange == "all_to_all" and W < T and np.asarray(ovf_dest).any():
-                    w_extra += 1
+                    w_try += 1
                     continue
                 dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
                 break

@@ -28,6 +28,21 @@ def _freeze(v):
     return v
 
 
+def _thaw(v):
+    """Undo _freeze on record values pulled back out of sets (frozen records
+    are (name, value) pair tuples; field access needs dicts again)."""
+    if (
+        isinstance(v, tuple)
+        and v
+        and all(
+            isinstance(x, tuple) and len(x) == 2 and isinstance(x[0], str)
+            for x in v
+        )
+    ):
+        return {k: _thaw(x) for k, x in v}
+    return v
+
+
 class ConcreteEval:
     def __init__(self, defs: dict, consts: dict):
         self.defs = defs  # name -> (params, ast)
@@ -37,6 +52,10 @@ class ConcreteEval:
         ev = self.eval
         if isinstance(ast, E.Num):
             return ast.v
+        if isinstance(ast, E.Str):
+            return ast.v
+        if isinstance(ast, E.TupleCons):
+            return tuple(ev(x, env) for x in ast.elems)
         if isinstance(ast, E.At):
             return env["@"]
         if isinstance(ast, E.Name):
@@ -78,9 +97,15 @@ class ConcreteEval:
             if op == "..":
                 return frozenset(range(a, b + 1))
             if op == "\\union":
-                return frozenset(a) | frozenset(b)
+                return frozenset(_freeze(x) for x in a) | frozenset(
+                    _freeze(x) for x in b
+                )
             if op == "\\":
-                return frozenset(a) - frozenset(b)
+                return frozenset(_freeze(x) for x in a) - frozenset(
+                    _freeze(x) for x in b
+                )
+            if op == "\\subseteq":
+                return all(self._member(x, b) for x in a)
             if op == "=":
                 return _freeze(a) == _freeze(b)
             if op == "#":
@@ -109,15 +134,15 @@ class ConcreteEval:
                 (var, dom), rest = binds[0], binds[1:]
                 elems = ev(dom, env)
                 if ast.kind == "A":
-                    return all(q(rest, {**env, var: e}) for e in elems)
-                return any(q(rest, {**env, var: e}) for e in elems)
+                    return all(q(rest, {**env, var: _thaw(e)}) for e in elems)
+                return any(q(rest, {**env, var: _thaw(e)}) for e in elems)
 
             return q(list(ast.binds), env)
         if isinstance(ast, E.Choose):
             dom = ev(ast.domain, env)
             for e in sorted(dom, key=_freeze):
-                if ev(ast.body, {**env, ast.var: e}):
-                    return e
+                if ev(ast.body, {**env, ast.var: _thaw(e)}):
+                    return _thaw(e)
             raise ValueError("CHOOSE: no witness")
         if isinstance(ast, E.FunCons):
             dom = ev(ast.domain, env)
@@ -133,7 +158,23 @@ class ConcreteEval:
         if isinstance(ast, E.SetMap):
             dom = ev(ast.domain, env)
             return frozenset(
-                _freeze(ev(ast.body, {**env, ast.var: e})) for e in dom
+                _freeze(ev(ast.body, {**env, ast.var: _thaw(e)})) for e in dom
+            )
+        if isinstance(ast, E.SetFilter):
+            dom = ev(ast.domain, env)
+            return frozenset(
+                _freeze(e)
+                for e in dom
+                if ev(ast.pred, {**env, ast.var: _thaw(e)})
+            )
+        if isinstance(ast, E.PowerSet):
+            from itertools import combinations
+
+            base = [_freeze(x) for x in ev(ast.base, env)]
+            return frozenset(
+                frozenset(c)
+                for k in range(len(base) + 1)
+                for c in combinations(base, k)
             )
         if isinstance(ast, E.Domain):
             return frozenset(ev(ast.fn, env).keys())
@@ -191,7 +232,7 @@ class ConcreteEval:
                     return
                 (var, dom), rest = binds[0], binds[1:]
                 for e in sorted(self.eval(dom, env), key=_freeze):
-                    yield from q(rest, {**env, var: e})
+                    yield from q(rest, {**env, var: _thaw(e)})
 
             yield from q(list(ast.binds), env)
             return

@@ -20,15 +20,23 @@ data-dependent ranges (e.g. `0 .. logs[r].endOffset - 1` in TypeOk,
 FiniteReplicatedLog.tla:95) unroll to masked reductions with a static trip
 count — the jit-compatibility requirement.
 
-Scope: the full expression surface of Util/IdSequence/FiniteReplicatedLog
-(SURVEY.md §2.5 row 1 "hand-written kernels acceptable for v0 if
-cross-validated" — this module begins retiring that caveat).  CHOOSE is
-evaluated concretely (Util's Min/Max come out of their CHOOSE definitions
-mechanically); symbolic CHOOSE emission is deferred with the L3 modules.
+Scope: the full expression surface of the corpus — L1/L2
+(Util/IdSequence/FiniteReplicatedLog) and L3/L4 (KafkaReplication and its
+variants): INSTANCE ... WITH substitution (KafkaReplication.tla:77-84),
+bitmask-encoded `SUBSET Replicas` state fields, the epoch-keyed
+`leaderAndIsrRequests` message-set encoding (SURVEY.md §2.2), symbolic
+CHOOSE (Util's Min/Max), set comprehensions (Kip101/Kip279 truncation
+math), data-dependent existential domains (`\\E newLeader \\in
+quorumState.isr`), and disjunctive action bodies (ControllerShrinkIsr's
+three cases) via DNF splitting.  This retires SURVEY.md §2.5 row 1's
+"hand-written kernels acceptable for v0" caveat: the kernels are emitted
+mechanically from the reference text and cross-checked against the
+hand-written models by exact per-level state-set equality (tests/).
 """
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -60,6 +68,30 @@ class SFun:
 @dataclass(frozen=True)
 class SRec:
     fields: dict  # name -> schema
+
+
+@dataclass(frozen=True)
+class SBitset:
+    """Set over 0..size-1 stored as a bitmask in one int lane (the canonical
+    ISR encoding, SURVEY.md §2.2)."""
+
+    field: str
+    size: int
+
+
+@dataclass(frozen=True)
+class SKeyedSet:
+    """Grow-only set of records uniquely keyed by an int field, stored as
+    key-indexed per-field arrays (the `leaderAndIsrRequests` encoding: every
+    request carries a fresh leaderEpoch, KafkaReplication.tla:138-145, so
+    the epoch IS the slot index; a slot is absent while `absent_field` holds
+    `absent`)."""
+
+    size: int
+    key: str  # record field whose value equals the slot index
+    fields: dict  # record field name -> leaf schema (SInt / SBitset)
+    absent_field: str
+    absent: int
 
 
 # ------------------------------------------------------- symbolic int value
@@ -114,7 +146,7 @@ class SetRange:
 
 @dataclass
 class SetLitV:
-    elems: list  # of IVal
+    elems: list  # of IVal (or record values)
 
 
 @dataclass
@@ -125,7 +157,7 @@ class SetUnion:
 @dataclass
 class SetDiffV:
     base: Any
-    excl: list  # of IVal
+    excl: Any  # ANY set value (membership decides exclusion)
 
 
 @dataclass
@@ -133,6 +165,35 @@ class SetCondV:  # IF cond THEN s1 ELSE s2 (data-dependent set)
     cond: Any
     a: Any
     b: Any
+
+
+@dataclass
+class LazySet:
+    """Materialized static unroll: [(elem, present_cond)] — the result form
+    of set comprehensions ({x \\in S : p} / {e : x \\in S})."""
+
+    items: list
+
+
+@dataclass
+class BitsetV:
+    """Set over 0..size-1 as a (possibly traced) bitmask."""
+
+    mask: Any
+    size: int
+
+
+@dataclass
+class PowerSetV:  # SUBSET S — type positions only
+    base: Any
+
+
+@dataclass
+class KeyedSetInsertV:
+    """`keyedset \\union {rec, ...}` — an update RHS for SKeyedSet vars."""
+
+    base: Any  # KeyedSetV
+    recs: list
 
 
 @dataclass
@@ -146,13 +207,87 @@ class RecTypeV:
     fields: dict  # name -> set value
 
 
+_SETV = (SetRange, SetLitV, SetUnion, SetDiffV, SetCondV, LazySet, BitsetV)
+
+
+def _rec_keys(v):
+    """Record field names of a record-ish value, else None."""
+    if isinstance(v, RecV):
+        return list(v._f)
+    if isinstance(v, PatchRecV):
+        base = _rec_keys(v.base)
+        if base is not None and v.name not in base:
+            base = base + [v.name]
+        return base
+    if isinstance(v, CondV):
+        return _rec_keys(v.a) or _rec_keys(v.b)
+    return None
+
+
+def _rec_field(v, k):
+    """Field access with scalar promotion: a scalar standing in a record
+    position (the canonical Nil = all-lanes -1 convention) yields itself for
+    every field."""
+    if _rec_keys(v) is not None:
+        return v.field(k)
+    return IVal.of(v)
+
+
+def _eq(a, b):
+    """TLA `=` over the symbolic value domain (ints, records, sets)."""
+    if isinstance(a, BitsetV) or isinstance(b, BitsetV):
+        sz = a.size if isinstance(a, BitsetV) else b.size
+        return _mask_of(a, sz) == _mask_of(b, sz)
+    if isinstance(a, _SETV) or isinstance(b, _SETV) or isinstance(a, KeyedSetV) or isinstance(b, KeyedSetV):
+        ia, ib = _set_iter_static(a), _set_iter_static(b)
+
+        def incl(xs, ys):
+            r = jnp.bool_(True)
+            for e, c in xs:
+                hit = jnp.bool_(False)
+                for f, d in ys:
+                    hit = hit | (_eq(e, f) & _as_bool(d))
+                r = r & (hit | ~_as_bool(c))
+            return r
+
+        return incl(ia, ib) & incl(ib, ia)
+    ka, kb = _rec_keys(a), _rec_keys(b)
+    if ka is not None or kb is not None:
+        keys = ka if ka is not None else kb
+        r = jnp.bool_(True)
+        for k in keys:
+            r = r & _eq(_rec_field(a, k), _rec_field(b, k))
+        return r
+    return IVal.of(a).val == IVal.of(b).val
+
+
+def _mask_of(s, size: int):
+    """Bitmask form of a set-over-0..size-1 value."""
+    if isinstance(s, BitsetV):
+        return s.mask
+    if isinstance(s, SetLitV):
+        m = jnp.int32(0) if s.elems else 0
+        for e in s.elems:
+            m = m | (jnp.int32(1) << IVal.of(e).val)
+        return m
+    if isinstance(s, SetCondV):
+        return jnp.where(
+            _as_bool(s.cond), _mask_of(s.a, size), _mask_of(s.b, size)
+        )
+    m = jnp.int32(0)
+    for e, c in _set_iter_static(s):
+        m = m | jnp.where(_as_bool(c), jnp.int32(1) << IVal.of(e).val, 0)
+    return m
+
+
 def _set_member(x: IVal, s) -> Any:
     if isinstance(s, SetRange):
         return (x.val >= s.lo.val) & (x.val <= s.hi.val)
     if isinstance(s, SetLitV):
         r = False
         for e in s.elems:
-            r = r | (x.val == e.val) if r is not False else (x.val == e.val)
+            t = _eq(x, e)
+            r = r | t if r is not False else t
         return r if r is not False else jnp.bool_(False)
     if isinstance(s, SetUnion):
         r = jnp.bool_(False)
@@ -160,36 +295,61 @@ def _set_member(x: IVal, s) -> Any:
             r = r | _set_member(x, p)
         return r
     if isinstance(s, SetDiffV):
-        r = _set_member(x, s.base)
-        for e in s.excl:
-            r = r & (x.val != e.val)
-        return r
+        return _set_member(x, s.base) & ~_set_member(x, s.excl)
     if isinstance(s, SetCondV):
         c = _as_bool(s.cond)
         return (c & _set_member(x, s.a)) | (~c & _set_member(x, s.b))
+    if isinstance(s, BitsetV):
+        return ((s.mask >> x.val) & 1) == 1
+    if isinstance(s, (LazySet, KeyedSetV)):
+        r = jnp.bool_(False)
+        for e, c in _set_iter_static(s):
+            r = r | (_eq(x, e) & _as_bool(c))
+        return r
     raise NotImplementedError(f"membership in {type(s).__name__}")
 
 
+def _member_generic(x, s) -> Any:
+    """`x \\in s` for any element kind (records use equality search)."""
+    if _rec_keys(x) is not None:
+        r = jnp.bool_(False)
+        for e, c in _set_iter_static(s):
+            r = r | (_eq(x, e) & _as_bool(c))
+        return r
+    return _set_member(IVal.of(x), s)
+
+
 def _value_in_type(v, t) -> Any:
-    """`v \\in T` for function/record types and integer sets."""
+    """`v \\in T` for function/record types, powersets and integer sets."""
     if isinstance(t, RecTypeV):
         r = jnp.bool_(True)
         for name, fs in t.fields.items():
-            r = r & _value_in_type(v.field(name), fs)
+            r = r & _value_in_type(_rec_field(v, name), fs)
         return r
     if isinstance(t, FunTypeV):
-        r = jnp.bool_(True)
-
         def chk(i):
             return _value_in_type(v.apply(IVal.of(i)), t.rng)
 
-        r_all = _set_forall(t.dom, chk)
-        return r & r_all
-    return _set_member(IVal.of(v), t)
+        return _set_forall(t.dom, chk)
+    if isinstance(t, PowerSetV):
+        if not isinstance(v, BitsetV):
+            raise NotImplementedError("SUBSET membership needs a bitset value")
+        r = jnp.bool_(True)
+        for i in range(v.size):
+            has = ((v.mask >> i) & 1) == 1
+            r = r & (~has | _set_member(IVal.of(i), t.base))
+        return r
+    if isinstance(t, SetUnion):
+        r = jnp.bool_(False)
+        for p in t.parts:
+            r = r | _value_in_type(v, p)
+        return r
+    return _member_generic(v, t)
 
 
 def _set_iter_static(s):
-    """Static unroll list [(concrete_or_IVal elem, present_cond)]."""
+    """Static unroll list [(elem, present_cond)]; elems are IVals or record
+    views.  The unroll length is state-independent (the jit requirement)."""
     if isinstance(s, SetRange):
         # unroll over the static hull [lo.lo, hi.hi]; mask each slot by the
         # (possibly symbolic) actual bounds — the static-trip-count form of
@@ -211,17 +371,37 @@ def _set_iter_static(s):
             out.extend(_set_iter_static(p))
         return out
     if isinstance(s, SetDiffV):
-        out = []
-        for e, c in _set_iter_static(s.base):
-            for x in s.excl:
-                c = c & (e.val != x.val)
-            out.append((e, c))
-        return out
+        return [
+            (e, _as_bool(c) & ~_as_bool(_member_generic(e, s.excl)))
+            for e, c in _set_iter_static(s.base)
+        ]
     if isinstance(s, SetCondV):
         c = _as_bool(s.cond)
         out = [(e, p & c) for e, p in _set_iter_static(s.a)]
         out += [(e, p & ~c) for e, p in _set_iter_static(s.b)]
         return out
+    if isinstance(s, LazySet):
+        return s.items
+    if isinstance(s, BitsetV):
+        return [
+            (IVal.of(i), ((s.mask >> i) & 1) == 1) for i in range(s.size)
+        ]
+    if isinstance(s, KeyedSetV):
+        return [
+            (s.slot(IVal.of(i)), s.present(i)) for i in range(s.size)
+        ]
+    if isinstance(s, RecTypeV):
+        # cartesian product of the field domains -> record elements
+        items = [(RecV({}), jnp.bool_(True))]
+        for name, fs in s.fields.items():
+            nxt = []
+            for base, c in items:
+                for e, ec in _set_iter_static(fs):
+                    nxt.append(
+                        (RecV({**base._f, name: e}), c & _as_bool(ec))
+                    )
+            items = nxt
+        return items
     raise NotImplementedError(f"cannot unroll {type(s).__name__}")
 
 
@@ -267,13 +447,19 @@ class FunV:
         return self._fn(IVal.of(i))
 
 
+def _leaf_tensor(field: str, state: dict, idx: tuple):
+    v = state[field]
+    for k in idx:
+        v = v[k.val if isinstance(k, IVal) else k]
+    return v
+
+
 def _state_value(schema, state: dict, idx: tuple):
     """Wrap live state tensors in the value protocol per the schema."""
     if isinstance(schema, SInt):
-        v = state[schema.field]
-        for k in idx:
-            v = v[k.val if isinstance(k, IVal) else k]
-        return IVal(v, schema.lo, schema.hi)
+        return IVal(_leaf_tensor(schema.field, state, idx), schema.lo, schema.hi)
+    if isinstance(schema, SBitset):
+        return BitsetV(_leaf_tensor(schema.field, state, idx), schema.size)
     if isinstance(schema, SRec):
         return RecV(
             {
@@ -283,7 +469,33 @@ def _state_value(schema, state: dict, idx: tuple):
         )
     if isinstance(schema, SFun):
         return FunV(schema.size, lambda i: _state_value(schema.elem, state, idx + (i,)))
+    if isinstance(schema, SKeyedSet):
+        return KeyedSetV(schema, state, idx)
     raise TypeError(schema)
+
+
+class KeyedSetV:
+    """State-backed keyed record set (see SKeyedSet).  Slot i is the record
+    whose key field equals i; `present(i)` reads the absence marker."""
+
+    def __init__(self, schema: SKeyedSet, state: dict, idx: tuple):
+        self.schema, self._state, self._idx = schema, state, idx
+        self.size = schema.size
+
+    def slot(self, i) -> "RecV":
+        i = IVal.of(i)
+        fields = {
+            n: (lambda s=s, i=i: _state_value(s, self._state, self._idx + (i,)))
+            for n, s in self.schema.fields.items()
+        }
+        fields[self.schema.key] = i
+        return RecV(fields)
+
+    def present(self, i):
+        sch = self.schema.fields[self.schema.absent_field]
+        v = _state_value(sch, self._state, self._idx + (IVal.of(i),))
+        marker = v.val if isinstance(v, IVal) else v.mask
+        return marker != self.schema.absent
 
 
 class CondV:
@@ -300,14 +512,27 @@ class CondV:
         return _merge(self.cond, self.a.apply(i), self.b.apply(i))
 
 
-_SET_TYPES = (SetRange, SetLitV, SetUnion, SetDiffV, SetCondV)
-
-
 def _merge(cond, a, b):
+    cond = _as_bool(cond)
+    if isinstance(a, BitsetV) or isinstance(b, BitsetV):
+        sz = a.size if isinstance(a, BitsetV) else b.size
+        return BitsetV(jnp.where(cond, _mask_of(a, sz), _mask_of(b, sz)), sz)
+    if isinstance(a, _SETV) or isinstance(b, _SETV):
+        return SetCondV(cond, a, b)
+    ka, kb = _rec_keys(a), _rec_keys(b)
+    if ka is not None or kb is not None:
+        # scalar-vs-record merge (GetLatestRecord's `IF empty THEN Nil
+        # ELSE record`, FiniteReplicatedLog.tla:59-62): promote the scalar
+        # over the record's fields — sound because Nil's canonical tensor
+        # encoding is all-fields -1
+        keys = ka if ka is not None else kb
+        if ka is None:
+            a = RecV({k: IVal.of(a) for k in keys})
+        if kb is None:
+            b = RecV({k: IVal.of(b) for k in keys})
+        return CondV(cond, a, b)
     if isinstance(a, IVal) or isinstance(b, IVal):
         return _where_ival(cond, IVal.of(a), IVal.of(b))
-    if isinstance(a, _SET_TYPES) or isinstance(b, _SET_TYPES):
-        return SetCondV(cond, a, b)
     return CondV(cond, a, b)
 
 
@@ -392,26 +617,39 @@ class Emitter:
                 return _value_in_type(ev(ast.a, env), ev(ast.b, env))
             if op == "\\notin":
                 return ~_value_in_type(ev(ast.a, env), ev(ast.b, env))
+            if op == "\\subseteq":
+                t = ev(ast.b, env)
+                return _set_forall(ev(ast.a, env), lambda e: _value_in_type(e, t))
             if op == "..":
                 return SetRange(IVal.of(ev(ast.a, env)), IVal.of(ev(ast.b, env)))
             if op == "\\union":
-                return SetUnion([ev(ast.a, env), ev(ast.b, env)])
+                a, b = ev(ast.a, env), ev(ast.b, env)
+                if isinstance(a, BitsetV):
+                    return BitsetV(a.mask | _mask_of(b, a.size), a.size)
+                if isinstance(b, BitsetV):
+                    return BitsetV(_mask_of(a, b.size) | b.mask, b.size)
+                if isinstance(a, KeyedSetV):
+                    if not isinstance(b, SetLitV):
+                        raise NotImplementedError("keyed-set union needs literal records")
+                    return KeyedSetInsertV(a, list(b.elems))
+                return SetUnion([a, b])
             if op == "\\":
-                b = ev(ast.b, env)
-                excl = (
-                    b.elems if isinstance(b, SetLitV) else [IVal.of(b)]
-                )
-                return SetDiffV(ev(ast.a, env), excl)
+                a, b = ev(ast.a, env), ev(ast.b, env)
+                if not isinstance(b, _SETV) and not isinstance(b, KeyedSetV):
+                    b = SetLitV([IVal.of(b)])
+                if isinstance(a, BitsetV):
+                    return BitsetV(a.mask & ~_mask_of(b, a.size), a.size)
+                return SetDiffV(a, b)
             a, b = ev(ast.a, env), ev(ast.b, env)
             if op in ("+", "-", "*"):
                 a, b = IVal.of(a), IVal.of(b)
                 return {"+": a + b, "-": a - b, "*": a * b}[op]
+            if op == "=":
+                return _eq(a, b)
+            if op == "#":
+                return ~_eq(a, b)
             av = a.val if isinstance(a, IVal) else a
             bv = b.val if isinstance(b, IVal) else b
-            if op == "=":
-                return av == bv
-            if op == "#":
-                return av != bv
             return {"<": av < bv, ">": av > bv, "<=": av <= bv, ">=": av >= bv}[op]
         if isinstance(ast, E.Index):
             return ev(ast.base, env).apply(IVal.of(ev(ast.idx, env)))
@@ -447,7 +685,51 @@ class Emitter:
         if isinstance(ast, E.FunType):
             return FunTypeV(ev(ast.dom, env), ev(ast.rng, env))
         if isinstance(ast, E.SetLit):
-            return SetLitV([IVal.of(ev(x, env)) for x in ast.elems])
+            return SetLitV([ev(x, env) for x in ast.elems])
+        if isinstance(ast, E.SetMap):
+            dom = ev(ast.domain, env)
+            return LazySet(
+                [
+                    (ev(ast.body, {**env, ast.var: e}), c)
+                    for e, c in _set_iter_static(dom)
+                ]
+            )
+        if isinstance(ast, E.SetFilter):
+            dom = ev(ast.domain, env)
+            return LazySet(
+                [
+                    (
+                        e,
+                        _as_bool(c)
+                        & _as_bool(ev(ast.pred, {**env, ast.var: e})),
+                    )
+                    for e, c in _set_iter_static(dom)
+                ]
+            )
+        if isinstance(ast, E.PowerSet):
+            return PowerSetV(ev(ast.base, env))
+        if isinstance(ast, E.Choose):
+            # static-unrolled deterministic CHOOSE: the first element (in
+            # unroll order) satisfying the body.  The corpus only uses
+            # CHOOSE with a unique witness (Util's Min/Max, Util.tla:22-23),
+            # so unroll order never changes the result.
+            s = ev(ast.domain, env)
+            items = _set_iter_static(s)
+            if not items:
+                raise NotImplementedError("CHOOSE over statically empty set")
+            val = None
+            found = jnp.bool_(False)
+            for e, c in items:
+                ok = _as_bool(ev(ast.body, {**env, ast.var: e})) & _as_bool(c)
+                take = ok & ~found
+                val = e if val is None else _merge(take, e, val)
+                found = found | ok
+            return val
+        if isinstance(ast, E.Str):
+            raise NotImplementedError(
+                f"model-value string {ast.v!r}: bind its defining operator "
+                "via consts (e.g. None -> -1)"
+            )
         if isinstance(ast, E.Except):
             # nested-update semantics: each update's @ sees the result of
             # the previous one ([[f EXCEPT !p1=e1] EXCEPT !p2=e2])
@@ -550,6 +832,17 @@ def inline(ast, defs: dict, keep: set):
                 nv,
                 subst(a.domain, env),
             )
+        if isinstance(a, E.SetFilter):
+            nv = fresh(a.var)
+            return E.SetFilter(
+                nv,
+                subst(a.domain, env),
+                subst(a.pred, {**env, a.var: E.Name(nv)}),
+            )
+        if isinstance(a, E.TupleCons):
+            return E.TupleCons(tuple(subst(x, env) for x in a.elems))
+        if isinstance(a, E.PowerSet):
+            return E.PowerSet(subst(a.base, env))
         if isinstance(a, E.Binop):
             return E.Binop(a.op, subst(a.a, env), subst(a.b, env))
         if isinstance(a, E.Unop):
@@ -622,8 +915,48 @@ class ActionIR:
     updates: dict  # TLA var -> rhs AST
 
 
+def _is_unchanged(cj) -> Optional[list]:
+    """UNCHANGED <<a, b>> / UNCHANGED a -> the variable names, else None."""
+    if isinstance(cj, E.Apply) and cj.op == "UNCHANGED":
+        arg = cj.args[0]
+        elems = arg.elems if isinstance(arg, E.TupleCons) else (arg,)
+        names = []
+        for e in elems:
+            if not isinstance(e, E.Name):
+                raise NotImplementedError("UNCHANGED of a non-variable")
+            names.append(e.id)
+        return names
+    return None
+
+
+def _dnf_branches(binds, pending, done):
+    """Normalize an inlined action body to disjunctive-normal-form branches.
+
+    Hoists prime-dominating \\E binds into the choice space and splits
+    prime-carrying \\/ alternatives (ControllerShrinkIsr's three cases,
+    KafkaReplication.tla:158-168) into separate branches; prime-free
+    subtrees stay as ordinary guards.  Returns [(binds, conjuncts)].
+    """
+    pending = list(pending)
+    done = list(done)
+    while pending:
+        cj = pending.pop(0)
+        if isinstance(cj, E.Binop) and cj.op == "and":
+            pending[:0] = [cj.a, cj.b]
+        elif isinstance(cj, E.Quant) and cj.kind == "E" and contains_prime(cj):
+            binds = list(binds) + list(cj.binds)
+            pending.insert(0, cj.body)
+        elif isinstance(cj, E.Binop) and cj.op == "or" and contains_prime(cj):
+            return _dnf_branches(
+                binds, [cj.a] + pending, done
+            ) + _dnf_branches(binds, [cj.b] + pending, done)
+        else:
+            done.append(cj)
+    return [(binds, done)]
+
+
 def extract_actions(mod: TlaModule, defs: dict, keep: set) -> list[ActionIR]:
-    """Next -> per-disjunct ActionIR with hoisted quantifier binds."""
+    """Next -> per-disjunct (and per DNF branch) ActionIR."""
     params, next_ast = defs["Next"]
     assert not params
 
@@ -638,95 +971,263 @@ def extract_actions(mod: TlaModule, defs: dict, keep: set) -> list[ActionIR]:
             walk(ast.b, binds)
             return
         # leaf: named action application (or bare name)
-        if isinstance(ast, E.Apply):
-            name = ast.op
-            body = inline(ast, defs, keep)
-        elif isinstance(ast, E.Name):
-            name = ast.id
+        if isinstance(ast, (E.Apply, E.Name)):
+            name = ast.op if isinstance(ast, E.Apply) else ast.id
             body = inline(ast, defs, keep)
         else:
             raise NotImplementedError(f"unsupported Next leaf: {ast}")
-        b = list(binds)
-        while isinstance(body, E.Quant) and body.kind == "E" and contains_prime(body.body):
-            b += list(body.binds)
-            body = body.body
-        guards, updates = [], {}
-        for cj in flatten_and(body):
-            if (
-                isinstance(cj, E.Binop)
-                and cj.op == "="
-                and isinstance(cj.a, E.Prime)
-                and isinstance(cj.a.base, E.Name)
-            ):
-                var = cj.a.base.id
-                if var in updates:
-                    raise ValueError(f"{name}: duplicate update of {var}")
-                updates[var] = cj.b
-            elif contains_prime(cj):
-                raise NotImplementedError(f"{name}: prime in non-assignment conjunct")
-            else:
-                guards.append(cj)
-        out.append(ActionIR(name, b, guards, updates))
+        branches = _dnf_branches(list(binds), [body], [])
+        for k, (b, conjs) in enumerate(branches):
+            guards, updates = [], {}
+            for cj in conjs:
+                unch = _is_unchanged(cj)
+                if unch is not None:
+                    continue  # vars not in `updates` are carried through
+                if (
+                    isinstance(cj, E.Binop)
+                    and cj.op == "="
+                    and isinstance(cj.a, E.Prime)
+                    and isinstance(cj.a.base, E.Name)
+                ):
+                    var = cj.a.base.id
+                    if var in updates:
+                        raise ValueError(f"{name}: duplicate update of {var}")
+                    updates[var] = cj.b
+                elif contains_prime(cj):
+                    raise NotImplementedError(
+                        f"{name}: prime in non-assignment conjunct"
+                    )
+                else:
+                    guards.append(cj)
+            bname = name if len(branches) == 1 else f"{name}~{k}"
+            out.append(ActionIR(bname, b, guards, updates))
 
     walk(next_ast, [])
     return out
 
 
+# ----------------------------------------------------------- module loading
+def _rename_ast(ast, mapping: dict, bound: frozenset):
+    """Substitute free Name/Apply references per `mapping` (name -> AST for
+    plain names, name -> new operator name for applications), respecting
+    binder shadowing.  Used for INSTANCE ... WITH substitution."""
+    E_ = E
+
+    def sub(a, bound):
+        if isinstance(a, E_.Name):
+            if a.id not in bound and a.id in mapping:
+                m = mapping[a.id]
+                return E_.Name(m) if isinstance(m, str) else m
+            return a
+        if isinstance(a, E_.Apply):
+            op = a.op
+            if op in mapping and isinstance(mapping[op], str):
+                op = mapping[op]
+            return E_.Apply(op, tuple(sub(x, bound) for x in a.args))
+        if isinstance(a, E_.Quant):
+            inner = bound | {v for v, _ in a.binds}
+            return E_.Quant(
+                a.kind,
+                tuple((v, sub(d, bound)) for v, d in a.binds),
+                sub(a.body, inner),
+            )
+        if isinstance(a, E_.Choose):
+            return E_.Choose(
+                a.var, sub(a.domain, bound), sub(a.body, bound | {a.var})
+            )
+        if isinstance(a, E_.FunCons):
+            return E_.FunCons(
+                a.var, sub(a.domain, bound), sub(a.body, bound | {a.var})
+            )
+        if isinstance(a, E_.SetMap):
+            return E_.SetMap(
+                sub(a.body, bound | {a.var}), a.var, sub(a.domain, bound)
+            )
+        if isinstance(a, E_.SetFilter):
+            return E_.SetFilter(
+                a.var, sub(a.domain, bound), sub(a.pred, bound | {a.var})
+            )
+        if isinstance(a, E_.Let):
+            binds = []
+            inner = bound
+            for name, params, expr in a.binds:
+                binds.append((name, params, sub(expr, inner | set(params))))
+                inner = inner | {name}
+            return E_.Let(tuple(binds), sub(a.body, inner))
+        if isinstance(a, E_.Binop):
+            return E_.Binop(a.op, sub(a.a, bound), sub(a.b, bound))
+        if isinstance(a, E_.Unop):
+            return E_.Unop(a.op, sub(a.a, bound))
+        if isinstance(a, E_.Index):
+            return E_.Index(sub(a.base, bound), sub(a.idx, bound))
+        if isinstance(a, E_.FieldAcc):
+            return E_.FieldAcc(sub(a.base, bound), a.name)
+        if isinstance(a, E_.Prime):
+            return E_.Prime(sub(a.base, bound))
+        if isinstance(a, E_.IfThenElse):
+            return E_.IfThenElse(
+                sub(a.cond, bound), sub(a.then, bound), sub(a.other, bound)
+            )
+        if isinstance(a, E_.RecordCons):
+            return E_.RecordCons(tuple((n, sub(x, bound)) for n, x in a.fields))
+        if isinstance(a, E_.RecordType):
+            return E_.RecordType(tuple((n, sub(x, bound)) for n, x in a.fields))
+        if isinstance(a, E_.FunType):
+            return E_.FunType(sub(a.dom, bound), sub(a.rng, bound))
+        if isinstance(a, E_.SetLit):
+            return E_.SetLit(tuple(sub(x, bound) for x in a.elems))
+        if isinstance(a, E_.TupleCons):
+            return E_.TupleCons(tuple(sub(x, bound) for x in a.elems))
+        if isinstance(a, E_.PowerSet):
+            return E_.PowerSet(sub(a.base, bound))
+        if isinstance(a, E_.Domain):
+            return E_.Domain(sub(a.fn, bound))
+        if isinstance(a, E_.Except):
+            ups = tuple(
+                (
+                    tuple((k, x if k == "f" else sub(x, bound)) for k, x in path),
+                    sub(expr, bound),
+                )
+                for path, expr in a.updates
+            )
+            return E_.Except(sub(a.base, bound), ups)
+        return a  # Num, Str, At
+
+    return sub(ast, bound)
+
+
+_TEMPORAL = re.compile(r"\[\]\[|\bSF_|\bWF_|<>|~>")
+
+
+def _parse_module_defs(mod: TlaModule) -> dict:
+    """name -> (params, ast) for every definition of one module.
+
+    Temporal definitions (Spec-like bodies with [][Next]_vars / SF_ / WF_)
+    are skipped by CONTENT, not by swallowing parse errors: a non-temporal
+    definition that fails to parse raises, so an unsupported construct can
+    never silently fall back to an ancestor module's same-named definition.
+    """
+    out = {}
+    for dname, body in mod.definitions.items():
+        if dname == "Spec" or _TEMPORAL.search(body):
+            continue
+        txt = "\n".join(
+            ln
+            for ln in body.splitlines()
+            if not ln.strip().startswith(("THEOREM", "ASSUME"))
+        )
+        n, params, ast = E.parse_definition(txt)
+        out[n] = (params, ast)
+    return out
+
+
+def load_defs(ref_dir, module: str) -> dict:
+    """Parse `module` plus its EXTENDS chain and INSTANCE targets into one
+    definition namespace.
+
+    - ancestor modules contribute their non-LOCAL definitions (Kip279's
+      LOCAL Next must not shadow Kip320's own Next, Kip279.tla:53);
+    - `Alias == INSTANCE M WITH x <- e` (KafkaReplication.tla:77-84)
+      registers every definition D of M as `Alias!D`, with M's constants
+      and variables substituted per the WITH list and M-internal references
+      rewritten to the aliased names.
+    """
+    from pathlib import Path
+
+    from .tla_frontend import load_chain, parse_tla
+
+    ref_dir = Path(ref_dir)
+    chain = load_chain(ref_dir, module)
+    if module not in chain:
+        raise FileNotFoundError(f"{module}.tla not found under {ref_dir}")
+
+    order: list[str] = []
+
+    def visit(name):
+        m = chain.get(name)
+        if m is None or name in order:
+            return
+        for e in m.extends:
+            visit(e)
+        if name in chain:
+            order.append(name)
+
+    visit(module)
+
+    defs: dict = {}
+    instances: dict = {}
+    for name in order:
+        m = chain[name]
+        parsed = _parse_module_defs(m)
+        for dname, entry in parsed.items():
+            if name != module and dname in m.local_defs:
+                continue  # LOCAL: not visible to extending modules
+            defs[dname] = entry
+        instances.update(m.instances)
+
+    for alias, (target, subs) in instances.items():
+        tmod = parse_tla(ref_dir / f"{target}.tla")
+        tdefs = _parse_module_defs(tmod)
+        mapping: dict = {n: f"{alias}!{n}" for n in tdefs}
+        for cname, expr_txt in subs.items():
+            mapping[cname] = E.parse_expr(expr_txt)
+        for n, (params, ast) in tdefs.items():
+            defs[f"{alias}!{n}"] = (
+                params,
+                _rename_ast(ast, mapping, frozenset(params)),
+            )
+    return defs
+
+
 # ------------------------------------------------------------ model builder
-def _domain_space(emitter: Emitter, binds, env_builder):
+def _domain_space(emitter: Emitter, binds, spec):
     """Static choice decomposition for the bind list.
 
-    Returns (sizes, mapper) where mapper(choice_digits, state_env) -> dict
-    var -> IVal.  Supported domains: static ranges / constant sets and
-    `<static set> \\ {<earlier bind var>}` (index remap, the corpus's
-    `Replicas \\ {replica}` case)."""
-    sizes = []
-    specs = []
-    for var, dom_ast in binds:
-        dom_ast = dom_ast
-        specs.append((var, dom_ast))
-    # sizes must be static: evaluate domains with dummy env for earlier vars
-    def static_size(dom_ast):
-        # evaluate with every prior var bound to its range minimum — sizes
-        # of the supported domain forms don't depend on the binding
-        env = {"__state__": {}}
-        dummy = {}
-        for v, _ in specs:
-            dummy[v] = IVal(0, 0, 0)
-        s = emitter.eval(dom_ast, {**env, **dummy})
-        if isinstance(s, SetRange):
-            if s.lo.lo != s.lo.hi or s.hi.lo != s.hi.hi:
-                raise NotImplementedError("choice domain must be static")
-            return s.hi.hi - s.lo.lo + 1, ("range", s.lo.lo)
-        if isinstance(s, SetDiffV):
-            base = s.base
-            if not isinstance(base, SetRange) or len(s.excl) != 1:
-                raise NotImplementedError("unsupported choice domain difference")
-            return base.hi.hi - base.lo.lo + 1 - 1, ("diff", base.lo.lo)
-        raise NotImplementedError(f"choice domain {type(s).__name__}")
+    Each existential bind becomes one mixed-radix choice digit whose radix is
+    the domain's static hull size (state-independent by construction: ranges
+    unroll to schema-bound hulls, ISR bitsets to their universe, the keyed
+    request set to its slot count).  Returns (sizes, mapper) where
+    mapper(choice_digits, env) -> ({var: value}, enabled_guard): the guard
+    masks hull slots not actually in the (state-dependent) domain — TLC's
+    "branch on every witness, most disabled" semantics, vectorized.
+    """
+    dummy_state = {f.name: np.zeros(f.shape, np.int32) for f in spec.fields}
 
-    kinds = []
-    for var, dom_ast in specs:
-        n, kind = static_size(dom_ast)
-        sizes.append(n)
-        kinds.append(kind)
+    sizes = []
+    for i, (var, dom_ast) in enumerate(binds):
+        env = {"__state__": dummy_state}
+        for v, _d in binds[:i]:
+            env[v] = IVal(0, 0, 0)
+        sizes.append(len(_set_iter_static(emitter.eval(dom_ast, env))))
 
     def mapper(digits, env):
         vals = {}
-        for (var, dom_ast), d, (kind, lo) in zip(specs, digits, kinds):
-            if kind == "range":
-                vals[var] = d + IVal.of(lo)
-            else:  # diff: re-evaluate the excluded element with current binds
-                s = emitter.eval(dom_ast, {**env, **vals})
-                excl = s.excl[0]
-                base_lo = s.base.lo
-                cand = d + base_lo
-                vals[var] = IVal(
-                    jnp.where(cand.val >= excl.val, cand.val + 1, cand.val),
-                    cand.lo,
-                    cand.hi + 1,
-                )
-        return vals
+        guard = jnp.bool_(True)
+        for (var, dom_ast), d, n in zip(binds, digits, sizes):
+            s = emitter.eval(dom_ast, {**env, **vals})
+            # fast paths: direct indexing instead of a select chain
+            if isinstance(s, SetRange) and s.lo.lo == s.lo.hi and s.hi.lo == s.hi.hi:
+                vals[var] = d + IVal.of(s.lo.lo)
+                continue
+            if isinstance(s, BitsetV):
+                vals[var] = IVal(d.val, 0, s.size - 1)
+                guard = guard & (((s.mask >> d.val) & 1) == 1)
+                continue
+            if isinstance(s, KeyedSetV):
+                i = IVal(d.val, 0, s.size - 1)
+                vals[var] = s.slot(i)
+                guard = guard & s.present(i)
+                continue
+            items = _set_iter_static(s)
+            assert len(items) == n, (var, len(items), n)
+            elem = items[0][0]
+            pres = _as_bool(items[0][1]) & (d.val == 0)
+            for j in range(1, n):
+                elem = _merge(d.val == j, items[j][0], elem)
+                pres = pres | (_as_bool(items[j][1]) & (d.val == j))
+            vals[var] = elem
+            guard = guard & pres
+        return vals, guard
 
     return sizes, mapper
 
@@ -738,26 +1239,22 @@ def build_model(
     spec,
     invariant_names=("TypeOk",),
     name: Optional[str] = None,
+    defs: Optional[dict] = None,
 ):
     """Emit a models.base.Model mechanically from a parsed TLA+ module.
 
     consts: name -> int or (lo, hi) range tuple (model-value sets map to
-    0..n-1 ints).  var_schemas: TLA VARIABLE -> SInt/SFun/SRec schema whose
-    leaf fields name entries of `spec` (an ops.packing.StateSpec).
+    0..n-1 ints; overriding a defined operator name, e.g. None -> -1, pins
+    its model value and blocks inlining of the definition).  var_schemas:
+    TLA VARIABLE -> SInt/SBitset/SFun/SRec/SKeyedSet schema whose leaf
+    fields name entries of `spec` (an ops.packing.StateSpec).  defs: a
+    prebuilt definition namespace (load_defs) for modules with EXTENDS
+    chains / INSTANCE substitutions; defaults to `mod`'s own definitions.
     """
     from ..models.base import Action, Invariant, Model
 
-    defs = {}
-    for dname, body in mod.definitions.items():
-        if dname in ("Spec",):
-            continue
-        txt = "\n".join(
-            ln
-            for ln in body.splitlines()
-            if not ln.strip().startswith(("THEOREM", "ASSUME"))
-        )
-        n, params, ast = E.parse_definition(txt)
-        defs[n] = (params, ast)
+    if defs is None:
+        defs = _parse_module_defs(mod)
 
     cvals = {}
     for k, v in consts.items():
@@ -772,7 +1269,7 @@ def build_model(
     actions_ir = extract_actions(mod, defs, keep)
 
     def make_kernel(air: ActionIR):
-        sizes, mapper = _domain_space(emitter, air.binds, None)
+        sizes, mapper = _domain_space(emitter, air.binds, spec)
         n_choices = int(np.prod(sizes)) if sizes else 1
 
         def kernel(state, choice):
@@ -783,8 +1280,8 @@ def build_model(
                 digits.append(IVal(c % n, 0, n - 1))
                 c = c // n
             digits.reverse()
-            env.update(mapper(digits, env))
-            ok = jnp.bool_(True)
+            vals, ok = mapper(digits, env)
+            env.update(vals)
             for g in air.guards:
                 ok = ok & _as_bool(emitter.eval(g, env))
             new_state = dict(state)
@@ -807,13 +1304,41 @@ def build_model(
                 else v
             )
             return
+        if isinstance(schema, SBitset):
+            arr = out[schema.field]
+            m = _mask_of(val, schema.size)
+            out[schema.field] = (
+                arr.at[idx].set(m) if idx else jnp.asarray(m, arr.dtype)
+                if hasattr(arr, "dtype")
+                else m
+            )
+            return
         if isinstance(schema, SRec):
             for n, s in schema.fields.items():
-                _materialize(s, val.field(n), out, idx)
+                _materialize(s, _rec_field(val, n), out, idx)
             return
         if isinstance(schema, SFun):
             for i in range(schema.size):
                 _materialize(schema.elem, val.apply(IVal.of(i)), out, idx + (i,))
+            return
+        if isinstance(schema, SKeyedSet):
+            if isinstance(val, KeyedSetV):
+                return  # assigned unchanged (same backing arrays)
+            if not isinstance(val, KeyedSetInsertV):
+                raise NotImplementedError(
+                    "keyed-set update must be `base \\union {records}`"
+                )
+            for rec in val.recs:
+                key = IVal.of(_rec_field(rec, schema.key))
+                for n, leaf in schema.fields.items():
+                    fv = _rec_field(rec, n)
+                    arr = out[leaf.field]
+                    v = (
+                        _mask_of(fv, leaf.size)
+                        if isinstance(leaf, SBitset)
+                        else IVal.of(fv).val
+                    )
+                    out[leaf.field] = arr.at[idx + (key.val,)].set(v)
             return
         raise TypeError(schema)
 
@@ -826,19 +1351,49 @@ def build_model(
         if isinstance(schema, SInt):
             out.setdefault(schema.field, {})[idx] = int(val)
             return
+        if isinstance(schema, SBitset):
+            mask = 0
+            for e in val:
+                mask |= 1 << int(e)
+            out.setdefault(schema.field, {})[idx] = mask
+            return
         if isinstance(schema, SRec):
+            from .tla_concrete import _thaw
+
+            val = _thaw(val)
             for n, s in schema.fields.items():
-                _conc_encode(s, val[n], out, idx)
+                # scalar in record position = canonical Nil (all fields -1)
+                _conc_encode(s, val[n] if isinstance(val, dict) else val, out, idx)
             return
         if isinstance(schema, SFun):
             for i in range(schema.size):
                 _conc_encode(schema.elem, val[i], out, idx + (i,))
             return
+        if isinstance(schema, SKeyedSet):
+            recs = {}
+            for r in val:
+                r = dict(r) if not isinstance(r, dict) else r
+                recs[int(r[schema.key])] = r
+            for j in range(schema.size):
+                r = recs.get(j)
+                for n, leaf in schema.fields.items():
+                    if r is None:
+                        v = schema.absent if n == schema.absent_field else 0
+                        out.setdefault(leaf.field, {})[idx + (j,)] = v
+                    else:
+                        _conc_encode(leaf, r[n], out, idx + (j,))
+            return
+        raise TypeError(schema)
 
     def init_states_wrapped():
-        _, init_ast = defs["Init"]
+        init_ast = inline(E.Name("Init"), defs, keep)
         assigns = {}
         for cj in flatten_and(init_ast):
+            assert (
+                isinstance(cj, E.Binop)
+                and cj.op == "="
+                and isinstance(cj.a, E.Name)
+            ), f"unsupported Init conjunct: {cj}"
             assigns[cj.a.id] = conc.eval(cj.b, {})
         pos = {}
         for var, schema in var_schemas.items():

@@ -11,18 +11,23 @@ FiniteReplicatedLog.tla, which is also the bulk of the upper layers'
 syntax):
 
   /\\ \\/ ~  = # < > <= >= \\leq \\geq  + - * ..  \\in \\notin \\union \\ (diff)
-  \\E \\A CHOOSE  IF/THEN/ELSE  LET..IN  DOMAIN
-  f[x]  r.field  x'  Op(args)
+  \\subseteq  SUBSET S  \\E \\A CHOOSE  IF/THEN/ELSE  LET..IN  DOMAIN
+  f[x]  r.field  x'  Op(args)  Alias!Op / Alias!Op(args)  "string"
   [x \\in S |-> e]  [f1 |-> e1, ...]  [f1 : S1, ...]  [S -> T]
   [f EXCEPT ![i].g[j] = e, ...] with @
-  {} {e, ...} {e : x \\in S}  tuples are not used by the corpus
+  {} {e, ...} {e : x \\in S}  {x \\in S : p}  <<e, ...>> (UNCHANGED/vars)
 
-Bullet lists (conjunction/disjunction lists) are indentation-sensitive in
-full TLA+; this parser uses the corpus-sufficient rule: a quantifier/LET/IF
-body that *starts* with a bullet token absorbs the whole following
-/\\-or-\\/ chain, otherwise the body is a single junct (terminated by the
-next /\\ or \\/).  Every module in /root/reference parses correctly under
-this rule (validated by tests/test_tla_expr.py round-trips).
+Junction lists (/\\ and \\/ bullet lists) follow the real TLA+
+column-fencing rule: a list is identified by the column of its bullets; a
+bullet at the same column continues the list, and every token of an item
+must sit strictly right of that column — a token at or left of the fence
+terminates the item (and the list).  This is what makes
+`/\\ \\A f \\in isr : /\\ P /\\ Q` followed by a sibling `/\\ state' = ...`
+parse correctly (LeaderIncHighWatermark, KafkaReplication.tla:264-271):
+the quantifier body's deeper-indented list cannot absorb the sibling
+conjunct.  Tokens carry their source column for this purpose
+(parse_definition pads the `Name ==` head with spaces so columns match the
+original module text).
 """
 
 from __future__ import annotations
@@ -36,6 +41,11 @@ from typing import Any, Optional
 @dataclass(frozen=True)
 class Num:
     v: int
+
+
+@dataclass(frozen=True)
+class Str:  # "NONE" — model-value strings
+    v: str
 
 
 @dataclass(frozen=True)
@@ -147,6 +157,23 @@ class SetMap:  # {e : x \in S}
 
 
 @dataclass(frozen=True)
+class SetFilter:  # {x \in S : p}
+    var: str
+    domain: Any
+    pred: Any
+
+
+@dataclass(frozen=True)
+class TupleCons:  # <<e, ...>> — used by UNCHANGED and vars lists
+    elems: tuple
+
+
+@dataclass(frozen=True)
+class PowerSet:  # SUBSET S (type positions only in the corpus)
+    base: Any
+
+
+@dataclass(frozen=True)
 class Except:  # [f EXCEPT !path = e, ...]
     base: Any
     updates: tuple  # ((path, expr), ...); path = (('f', name)|('i', expr), ...)
@@ -162,13 +189,15 @@ _TOKEN = re.compile(
     r"""
     (?P<ws>\s+)
   | (?P<num>\d+)
+  | (?P<str>"[^"]*")
   | (?P<landop>/\\)
   | (?P<lorop>\\/)
-  | (?P<sym>\\leq|\\geq|\\in\b|\\notin\b|\\union\b|\\E\b|\\A\b)
+  | (?P<sym>\\leq|\\geq|\\subseteq\b|\\in\b|\\notin\b|\\union\b|\\E\b|\\A\b)
   | (?P<setdiff>\\(?![a-zA-Z]))
   | (?P<dots>\.\.)
   | (?P<arrow>\|->)
   | (?P<funarrow>->)
+  | (?P<tup><<|>>)
   | (?P<op><=|>=|\#|=|<|>|\+|-|\*|~|')
   | (?P<punct>[\[\]\(\)\{\},:\.!@])
   | (?P<name>[A-Za-z_]\w*)
@@ -186,44 +215,56 @@ _KEYWORDS = {
     "EXCEPT",
     "DOMAIN",
     "UNCHANGED",
+    "SUBSET",
     "TRUE",
     "FALSE",
 }
 
 
-def tokenize(text: str) -> list[tuple[str, str]]:
-    """-> [(kind, lexeme)]; kind in num/name/kw or the lexeme itself."""
+def tokenize(text: str) -> list[tuple[str, str, int]]:
+    """-> [(kind, lexeme, column)]; kind in num/name/kw or the lexeme itself.
+    Columns are 0-based within the source line (junction-list fencing)."""
     out = []
     pos = 0
+    line_start = 0
     while pos < len(text):
         m = _TOKEN.match(text, pos)
         if not m:
             raise SyntaxError(f"cannot tokenize at: {text[pos:pos+40]!r}")
-        pos = m.end()
         kind = m.lastgroup
         lex = m.group()
         if kind == "ws":
+            nl = lex.rfind("\n")
+            if nl >= 0:
+                line_start = m.start() + nl + 1
+            pos = m.end()
             continue
+        col = m.start() - line_start
+        pos = m.end()
         if kind == "num":
-            out.append(("num", lex))
+            out.append(("num", lex, col))
+        elif kind == "str":
+            out.append(("str", lex[1:-1], col))
+        elif kind == "tup":
+            out.append((lex, lex, col))
         elif kind == "name":
-            out.append(("kw" if lex in _KEYWORDS else "name", lex))
+            out.append(("kw" if lex in _KEYWORDS else "name", lex, col))
         elif kind == "landop":
-            out.append(("/\\", lex))
+            out.append(("/\\", lex, col))
         elif kind == "lorop":
-            out.append(("\\/", lex))
+            out.append(("\\/", lex, col))
         elif kind == "setdiff":
-            out.append(("\\", lex))
+            out.append(("\\", lex, col))
         elif kind == "sym":
-            out.append((lex, lex))
+            out.append((lex, lex, col))
         elif kind == "dots":
-            out.append(("..", lex))
+            out.append(("..", lex, col))
         elif kind == "arrow":
-            out.append(("|->", lex))
+            out.append(("|->", lex, col))
         elif kind == "funarrow":
-            out.append(("->", lex))
+            out.append(("->", lex, col))
         else:
-            out.append((lex, lex))
+            out.append((lex, lex, col))
     return out
 
 
@@ -231,7 +272,7 @@ def tokenize(text: str) -> list[tuple[str, str]]:
 # binding powers (higher binds tighter)
 _BP = {
     "\\/": 10,
-    "/\\": 20,
+    "/\\": 20,  # (junction lists are handled by column fences, not BP)
     "=": 30,
     "#": 30,
     "<": 30,
@@ -242,6 +283,7 @@ _BP = {
     "\\geq": 30,
     "\\in": 30,
     "\\notin": 30,
+    "\\subseteq": 30,
     "\\union": 40,
     "\\": 40,
     "..": 50,
@@ -250,23 +292,30 @@ _BP = {
     "*": 70,
 }
 _CANON = {"\\leq": "<=", "\\geq": ">=", "#": "#"}
-# a quantifier/LET/IF body that does NOT start with a bullet is a single
-# junct: parse it just above /\ so the enclosing list terminates it
-_JUNCT_BP = 25
 
 
 class _Parser:
-    def __init__(self, toks: list[tuple[str, str]]):
+    def __init__(self, toks: list[tuple[str, str, int]]):
         self.toks = toks
         self.i = 0
+        # column fences of the enclosing junction lists: a token at column
+        # <= fence belongs to an enclosing list and is invisible here
+        self.fence = [-1]
+
+    def _raw(self, k=0) -> tuple[str, str, int]:
+        j = self.i + k
+        return self.toks[j] if j < len(self.toks) else ("<eof>", "", -1)
 
     def peek(self, k=0) -> tuple[str, str]:
-        j = self.i + k
-        return self.toks[j] if j < len(self.toks) else ("<eof>", "")
+        t = self._raw(k)
+        if t[2] >= 0 and t[2] <= self.fence[-1]:
+            return ("<eof>", "")
+        return (t[0], t[1])
 
     def next(self) -> tuple[str, str]:
         t = self.peek()
-        self.i += 1
+        if t[0] != "<eof>":
+            self.i += 1
         return t
 
     def expect(self, kind: str) -> tuple[str, str]:
@@ -275,16 +324,23 @@ class _Parser:
             raise SyntaxError(f"expected {kind!r}, got {t} at {self.i}")
         return t
 
-    # -- entry: full expression (handles leading bullet chains)
+    # -- entry: full expression (handles leading junction lists)
     def parse(self, min_bp: int = 0):
-        if self.peek()[0] in ("/\\", "\\/"):
-            op = self.peek()[0]
-            self.next()
-            # bullet list: items at just-above-this-op precedence, folded
-            items = [self.parse(_BP[op] + 1)]
-            while self.peek()[0] == op:
-                self.next()
-                items.append(self.parse(_BP[op] + 1))
+        t = self._raw()
+        if t[0] in ("/\\", "\\/") and self.peek()[0] == t[0]:
+            op, col = t[0], t[2]
+            items = []
+            while True:
+                t = self._raw()
+                if t[0] == op and t[2] == col and self.peek()[0] == op:
+                    self.i += 1
+                    self.fence.append(col)
+                    try:
+                        items.append(self.parse(0))
+                    finally:
+                        self.fence.pop()
+                else:
+                    break
             lhs = items[0]
             for it in items[1:]:
                 lhs = Binop("and" if op == "/\\" else "or", lhs, it)
@@ -304,12 +360,11 @@ class _Parser:
             op = {"/\\": "and", "\\/": "or"}.get(kind, _CANON.get(kind, kind))
             lhs = Binop(op, lhs, rhs)
 
-    # body of a quantifier / CHOOSE / LET / IF-arm: bullet -> absorb chain,
-    # else single junct
+    # body of a quantifier / CHOOSE / LET / IF-arm: the column fences make
+    # a plain full-expression parse correct (a deeper junction list is
+    # terminated by any token at or left of its own bullet column)
     def parse_body(self):
-        if self.peek()[0] in ("/\\", "\\/"):
-            return self.parse(0)
-        return self.parse(_JUNCT_BP)
+        return self.parse(0)
 
     def parse_unary(self):
         kind, lex = self.peek()
@@ -328,7 +383,7 @@ class _Parser:
             self.next()
             var = self.expect("name")[1]
             self.expect("\\in")
-            dom = self.parse(_JUNCT_BP)
+            dom = self.parse(0)
             self.expect(":")
             return Choose(var, dom, self.parse_body())
         if kind == "kw" and lex == "IF":
@@ -357,7 +412,7 @@ class _Parser:
                     params = tuple(ps)
                 self.expect("=")
                 self.expect("=")
-                binds.append((nm, params, self.parse(_JUNCT_BP)))
+                binds.append((nm, params, self.parse(0)))
                 nxt = self.peek()
                 if nxt == ("kw", "IN"):
                     self.next()
@@ -371,10 +426,14 @@ class _Parser:
     def _parse_binds(self):
         binds = []
         while True:
-            var = self.expect("name")[1]
+            # one group: `v1, v2, ... \in Domain` (vars share the domain)
+            names = [self.expect("name")[1]]
+            while self.peek()[0] == ",":
+                self.next()
+                names.append(self.expect("name")[1])
             self.expect("\\in")
-            dom = self.parse(_JUNCT_BP)
-            binds.append((var, dom))
+            dom = self.parse(0)
+            binds.extend((v, dom) for v in names)
             if self.peek()[0] == ",":
                 self.next()
                 continue
@@ -406,15 +465,23 @@ class _Parser:
         kind, lex = self.next()
         if kind == "num":
             return Num(int(lex))
+        if kind == "str":
+            return Str(lex)
         if kind == "@":
             return At()
         if kind == "kw" and lex in ("TRUE", "FALSE"):
             return Num(1 if lex == "TRUE" else 0)
         if kind == "kw" and lex == "DOMAIN":
             return Domain(self.parse_unary_postfix())
+        if kind == "kw" and lex == "SUBSET":
+            return PowerSet(self.parse_unary_postfix())
         if kind == "kw" and lex == "UNCHANGED":
             return Apply("UNCHANGED", (self.parse_unary_postfix(),))
         if kind == "name":
+            # instance-qualified operator: Alias!Op / Alias!Op(args)
+            if self.peek()[0] == "!" and self.peek(1)[0] in ("name", "kw"):
+                self.next()
+                lex = f"{lex}!{self.next()[1]}"
             if self.peek()[0] == "(":
                 self.next()
                 args = [self.parse(0)]
@@ -428,10 +495,29 @@ class _Parser:
             e = self.parse(0)
             self.expect(")")
             return e
+        if kind == "<<":
+            if self.peek()[0] == ">>":
+                self.next()
+                return TupleCons(())
+            elems = [self.parse(0)]
+            while self.peek()[0] == ",":
+                self.next()
+                elems.append(self.parse(0))
+            self.expect(">>")
+            return TupleCons(tuple(elems))
         if kind == "{":
             if self.peek()[0] == "}":
                 self.next()
                 return SetLit(())
+            # {x \in S : p} — filter form (x must be a bare variable)
+            if self.peek()[0] == "name" and self.peek(1)[0] == "\\in":
+                var = self.next()[1]
+                self.next()
+                dom = self.parse(0)
+                self.expect(":")
+                pred = self.parse(0)
+                self.expect("}")
+                return SetFilter(var, dom, pred)
             first = self.parse(0)
             if self.peek()[0] == ":":
                 # {body : x \in S}
@@ -544,4 +630,6 @@ def parse_definition(body: str):
     params = tuple(
         x.strip() for x in (m.group(2) or "").split(",") if x.strip()
     )
-    return name, params, parse_expr(expr)
+    # pad the head with spaces so first-line token columns match the module
+    # text (junction-list fencing is column-sensitive)
+    return name, params, parse_expr(" " * (len(head) + 2) + expr)

@@ -32,6 +32,7 @@ class TlaModule:
     variables: list = field(default_factory=list)
     definitions: dict = field(default_factory=dict)  # name -> body text
     instances: dict = field(default_factory=dict)  # alias -> (module, {subs})
+    local_defs: set = field(default_factory=set)  # LOCAL names (not inherited)
 
 
 _COMMENT_BLOCK = re.compile(r"\(\*.*?\*\)", re.S)
@@ -45,6 +46,42 @@ _INSTANCE = re.compile(
 )
 
 
+def _parse_withs(withs: str) -> dict:
+    """`x <- expr, y <- expr` -> {name: rhs_text}, splitting only at
+    top-level commas (an RHS like `{1, 2}` or `Max(a, b)` stays whole)."""
+    parts = []
+    depth = 0
+    cur = []
+    i = 0
+    while i < len(withs):
+        two = withs[i : i + 2]
+        if two in ("<<", ">>"):
+            depth += 1 if two == "<<" else -1
+            cur.append(two)
+            i += 2
+            continue
+        ch = withs[i]
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    if cur:
+        parts.append("".join(cur))
+    subs = {}
+    for p in parts:
+        m = re.match(r"\s*(\w+)\s*<-\s*(.+?)\s*$", p, re.S)
+        if not m:
+            raise ValueError(f"malformed WITH substitution: {p!r}")
+        subs[m.group(1)] = m.group(2)
+    return subs
+
+
 def parse_tla(path_or_text) -> TlaModule:
     text = (
         Path(path_or_text).read_text()
@@ -52,8 +89,11 @@ def parse_tla(path_or_text) -> TlaModule:
         or ("\n" not in str(path_or_text) and Path(str(path_or_text)).exists())
         else str(path_or_text)
     )
-    text = _COMMENT_BLOCK.sub("", text)
-    text = _COMMENT_LINE.sub("", text)
+    # blank comments to spaces (not empty) — expression parsing is
+    # column-sensitive (junction-list fencing, tla_expr) and definition
+    # bodies are sliced by character offset
+    text = _COMMENT_BLOCK.sub(lambda m: re.sub(r"[^\n]", " ", m.group(0)), text)
+    text = _COMMENT_LINE.sub(lambda m: " " * len(m.group(0)), text)
 
     m = _MODULE_HEAD.search(text)
     if not m:
@@ -73,15 +113,22 @@ def parse_tla(path_or_text) -> TlaModule:
             )
 
     # top-level definitions: find each `Name ==` at line start, body runs to
-    # the next definition head
+    # the next definition head, truncated at any declaration block (ASSUME /
+    # THEOREM / VARIABLES / CONSTANTS) that sits between two definitions
+    decl = re.compile(
+        r"^\s*(?:ASSUME|ASSUMPTION|AXIOM|THEOREM|VARIABLES?|CONSTANTS?)\b", re.M
+    )
     heads = [(m.start(), m.group(1)) for m in _DEF_HEAD.finditer(body)]
     for (start, name), nxt in zip(heads, heads[1:] + [(len(body), None)]):
-        mod.definitions[name] = body[start : nxt[0]]
+        text = body[start : nxt[0]]
+        dm = decl.search(text, re.match(r"\s*(?:LOCAL\s+)?\w+", text).end())
+        mod.definitions[name] = text[: dm.start()] if dm else text
+        if re.match(r"\s*LOCAL\b", text):
+            mod.local_defs.add(name)
 
     for im in _INSTANCE.finditer(body):
         alias, target, withs = im.group(1), im.group(2), im.group(3) or ""
-        subs = dict(re.findall(r"(\w+)\s*<-\s*(\w+)", withs))
-        mod.instances[alias] = (target, subs)
+        mod.instances[alias] = (target, _parse_withs(withs))
         mod.definitions.pop(alias, None)
 
     return mod
