@@ -1,13 +1,14 @@
-"""Mechanically emitted models for the full corpus (L3/L4).
+"""Mechanically emitted models for the full corpus (L3/L4 + AsyncIsr).
 
-Builds checker models for KafkaReplication's variants straight from the
-reference TLA+ text (/root/reference/<Module>.tla) via the expression
-front-end (utils/tla_expr -> utils/tla_emit): module structure and EXTENDS /
-INSTANCE WITH substitution from utils/tla_frontend, guards and updates
-evaluated symbolically over the SAME tensor encoding the hand-written
-models use (kafka_replication.make_spec, SURVEY.md §2.2) — so emitted and
-hand-written models are comparable as exact packed state sets per BFS level
-(tests/test_emitted_l3.py).
+Builds checker models for KafkaReplication's five variants and the
+standalone AsyncIsr straight from the reference TLA+ text
+(/root/reference/<Module>.tla) via the expression front-end (utils/tla_expr
+-> utils/tla_emit): module structure and EXTENDS / INSTANCE WITH
+substitution from utils/tla_frontend, guards and updates evaluated
+symbolically over the SAME tensor encoding the hand-written models use
+(kafka_replication.make_spec / async_isr.make_spec, SURVEY.md §2.2) — so
+emitted and hand-written models are comparable as exact packed state sets
+per BFS level (tests/test_emitted_l3.py).
 
 This is SANY's role (SURVEY.md §2.5 row 1) done end to end: no
 hand-translated guard or update anywhere in this path.
@@ -28,6 +29,7 @@ from ..utils.tla_emit import (
     SFun,
     SInt,
     SKeyedSet,
+    SPairSet,
     SRec,
     build_model,
     load_defs,
@@ -126,4 +128,72 @@ def make_emitted_model(
         invariant_names=invariants,
         name=f"{module}(emitted,{cfg.n}r)",
         defs=defs,
+    )
+
+
+#: the TLC CONSTRAINT bounding AsyncIsr's unbounded spec (authored — the
+#: reference declares MaxOffset but never guards LeaderWrite with it,
+#: AsyncIsr.tla:117-119; versions grow without bound).  Same bounds as the
+#: hand model's constraint pruning (models/async_isr.py).
+ASYNC_ISR_BOUNDED = (
+    "/\\ controllerState.version \\leq MaxVersion "
+    "/\\ leaderState.version \\leq MaxVersion "
+    "/\\ leaderState.offsets[Leader] \\leq MaxOffset"
+)
+
+
+def make_emitted_async_isr(
+    cfg,
+    invariants=("TypeOk", "ValidHighWatermark"),
+):
+    """Emit the standalone AsyncIsr model (AsyncIsr.tla) from reference
+    text onto the hand model's lanes (models/async_isr.make_spec).
+
+    cfg: models.async_isr.AsyncIsrConfig.  `updates` is version-keyed
+    (controller CAS makes versions unique, :68-70 -> SKeyedSet); `requests`
+    may repeat versions (the leader reuses its current version, :88-115) ->
+    the per-version subset-lattice bitset (SPairSet).
+    """
+    from .async_isr import LEADER, make_spec as make_async_spec
+
+    defs = load_defs(REF, "AsyncIsr")
+    mod = parse_tla(REF / "AsyncIsr.tla")
+    N, M, V = cfg.n, cfg.max_offset, cfg.max_version
+    schemas = {
+        "controllerState": SRec(
+            {"isr": SBitset("c_isr", N), "version": SInt("c_ver", 0, V)}
+        ),
+        "leaderState": SRec(
+            {
+                "isr": SBitset("l_isr", N),
+                "version": SInt("l_ver", 0, V),
+                "pendingIsr": SBitset("l_pend", N),
+                "pendingVersion": SInt("l_pver", NIL, V),
+                "offsets": SFun(N, SInt("offs", 0, M)),
+            }
+        ),
+        "updates": SKeyedSet(
+            size=V + 1,
+            key="version",
+            fields={"isr": SBitset("upd_isr", N)},
+            absent_field="isr",
+            absent=-1,
+        ),
+        "requests": SPairSet("req_bits", n_versions=V + 1, n_set=N),
+    }
+    consts = {
+        "Replicas": (0, N - 1),
+        "Leader": LEADER,
+        "MaxOffset": M,
+        "MaxVersion": V,
+    }
+    return build_model(
+        mod,
+        consts,
+        schemas,
+        make_async_spec(cfg),
+        invariant_names=invariants,
+        name=f"AsyncIsr(emitted,{N}r)",
+        defs=defs,
+        constraint_src=ASYNC_ISR_BOUNDED,
     )

@@ -43,6 +43,7 @@ from ..engine.bfs import (
 )
 from ..models.base import Model
 from ..ops import dedup
+from .multihost import fetch_global, is_coordinator, put_global
 from ..ops.fingerprint import fingerprint_lanes
 
 
@@ -416,9 +417,9 @@ def check_sharded(
             depth = int(snap["depth"])
 
     shard1 = NamedSharding(mesh, P("d"))
-    dev_vhi = jax.device_put(vhi, shard1)
-    dev_vlo = jax.device_put(vlo, shard1)
-    dev_vn = jax.device_put(vn, shard1)
+    dev_vhi = put_global(vhi, shard1)
+    dev_vlo = put_global(vlo, shard1)
+    dev_vn = put_global(vn, shard1)
 
     def _save_checkpoint():
         if host_sets is not None:
@@ -431,11 +432,14 @@ def check_sharded(
             }
         else:
             # trim the common sentinel tail (rebuilt on resume from vcap)
+            vn_np = fetch_global(dev_vn)
             extra = {
-                "vhi": np.asarray(dev_vhi)[:, : int(np.asarray(dev_vn).max())],
-                "vlo": np.asarray(dev_vlo)[:, : int(np.asarray(dev_vn).max())],
-                "vn": np.asarray(dev_vn),
+                "vhi": fetch_global(dev_vhi)[:, : int(vn_np.max())],
+                "vlo": fetch_global(dev_vlo)[:, : int(vn_np.max())],
+                "vn": vn_np,
             }
+        if not is_coordinator():
+            return  # one writer per job; all processes hold identical state
         atomic_savez(
             ckpt_path,
             ident=ckpt_ident,
@@ -515,18 +519,36 @@ def check_sharded(
                 R = D * W if exchange == "all_to_all" else D * T
                 if host_sets is None:
                     # grow per-shard visited capacity for the worst-case merge
-                    need = int(np.asarray(dev_vn).max()) + R
+                    need = int(fetch_global(dev_vn).max()) + R
                     if need > vcap:
                         vcap = _next_pow2(need)
-                        pad = jnp.full(
-                            (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
-                        )
-                        dev_vhi = jax.device_put(
-                            jnp.concatenate([dev_vhi, pad], axis=1), shard1
-                        )
-                        dev_vlo = jax.device_put(
-                            jnp.concatenate([dev_vlo, pad], axis=1), shard1
-                        )
+                        from .multihost import is_multiprocess
+
+                        if is_multiprocess():
+                            # host round-trip: every process needs the full
+                            # global array to contribute its shards
+                            grown_hi = fetch_global(dev_vhi)
+                            grown_lo = fetch_global(dev_vlo)
+                            pad = np.full(
+                                (D, vcap - grown_hi.shape[1]), 0xFFFFFFFF, np.uint32
+                            )
+                            dev_vhi = put_global(
+                                np.concatenate([grown_hi, pad], axis=1), shard1
+                            )
+                            dev_vlo = put_global(
+                                np.concatenate([grown_lo, pad], axis=1), shard1
+                            )
+                        else:
+                            # single-process: grow on device, no host copy
+                            pad = jnp.full(
+                                (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
+                            )
+                            dev_vhi = jax.device_put(
+                                jnp.concatenate([dev_vhi, pad], axis=1), shard1
+                            )
+                            dev_vlo = jax.device_put(
+                                jnp.concatenate([dev_vlo, pad], axis=1), shard1
+                            )
 
                 key = (bucket, vcap, sh, exchange, W)
                 if key not in steps:
@@ -558,47 +580,47 @@ def check_sharded(
                     out_hi,
                     out_lo,
                 ) = steps[key](
-                    jax.device_put(frontier.reshape(D * bucket, K), shard1),
-                    jax.device_put(fvalid.reshape(D * bucket), shard1),
+                    put_global(frontier.reshape(D * bucket, K), shard1),
+                    put_global(fvalid.reshape(D * bucket), shard1),
                     dev_vhi,
                     dev_vlo,
                     dev_vn,
                 )
-                if sh and np.asarray(ovf_expand).any():
+                if sh and fetch_global(ovf_expand).any():
                     sh_try = sh - 1
                     continue
-                if exchange == "all_to_all" and W < T and np.asarray(ovf_dest).any():
+                if exchange == "all_to_all" and W < T and fetch_global(ovf_dest).any():
                     w_try += 1
                     continue
                 dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
                 break
             # frontier-level verdicts (states being expanded = level `depth`)
-            viol_any_np = np.asarray(viol_any)  # [D, n_inv]
+            viol_any_np = fetch_global(viol_any)  # [D, n_inv]
             if viol_any_np.any():
                 inv_i = int(np.argmax(viol_any_np.any(axis=0)))
                 d = int(np.argmax(viol_any_np[:, inv_i]))
-                idx = int(np.asarray(viol_idx)[d, inv_i])
+                idx = int(fetch_global(viol_idx)[d, inv_i])
                 gidx = int(prev_base[d] + chunk_off[d] + idx)
                 verdict = (model.invariants[inv_i].name, frontier[d, idx], gidx)
                 break
-            if check_deadlock and np.asarray(dl_any).any():
-                d = int(np.argmax(np.asarray(dl_any)))
-                idx = int(np.asarray(dl_idx)[d])
+            if check_deadlock and fetch_global(dl_any).any():
+                d = int(np.argmax(fetch_global(dl_any)))
+                idx = int(fetch_global(dl_idx)[d])
                 gidx = int(prev_base[d] + chunk_off[d] + idx)
                 verdict = ("Deadlock", frontier[d, idx], gidx)
                 break
-            counts = np.asarray(new_n)
+            counts = fetch_global(new_n)
             M_per = out.shape[0] // D
             # device-side slice to the widest shard before the host copy —
             # the padded buffer is mostly empty
             cmax = int(counts.max())
-            out3 = np.asarray(out.reshape(D, M_per, K)[:, :cmax])
+            out3 = fetch_global(out.reshape(D, M_per, K)[:, :cmax])
             if store_trace:
-                parent_np = np.asarray(out_parent.reshape(D, M_per)[:, :cmax])
-                act_np = np.asarray(out_act.reshape(D, M_per)[:, :cmax])
+                parent_np = fetch_global(out_parent.reshape(D, M_per)[:, :cmax])
+                act_np = fetch_global(out_act.reshape(D, M_per)[:, :cmax])
             if host_sets is not None and cmax:
-                hi3 = np.asarray(out_hi.reshape(D, M_per)[:, :cmax])
-                lo3 = np.asarray(out_lo.reshape(D, M_per)[:, :cmax])
+                hi3 = fetch_global(out_hi.reshape(D, M_per)[:, :cmax])
+                lo3 = fetch_global(out_lo.reshape(D, M_per)[:, :cmax])
             newc = np.zeros(D, np.int64)
             for d in range(D):
                 c = int(counts[d])
@@ -631,7 +653,7 @@ def check_sharded(
                 newc[d] = c
             lvl_new_per_shard += newc
             if stats_path is not None:
-                lvl_act_en += np.asarray(act_en, np.int64).sum(axis=0)
+                lvl_act_en += fetch_global(act_en).astype(np.int64).sum(axis=0)
 
         if verdict is not None:
             inv_name, row, gidx = verdict
@@ -651,7 +673,7 @@ def check_sharded(
         if n_new:
             levels.append(n_new)
             total += n_new
-        if stats_path is not None:
+        if stats_path is not None and is_coordinator():
             import json
 
             enabled_total = int(lvl_act_en.sum())

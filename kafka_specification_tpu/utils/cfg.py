@@ -114,13 +114,23 @@ def _setlen(v) -> int:
     return len(v) if isinstance(v, list) else int(v)
 
 
-def build_model(module: str, cfg: TlcConfig, oracle: bool = False):
+def build_model(
+    module: str, cfg: TlcConfig, oracle: bool = False, emitted: bool = False
+):
     """Instantiate the tensor model (or its oracle twin) for a TLA+ module
     name under a parsed TLC config.
 
     CONSTRAINT is only meaningful for AsyncIsr in this corpus (its bound is
     driven by the MaxOffset/MaxVersion constants); naming one for any other
-    module is rejected rather than silently ignored."""
+    module is rejected rather than silently ignored.
+
+    emitted=True builds the model mechanically from the reference TLA+ text
+    (models/emitted — no hand-translated kernels).  Note emitted invariants
+    are the LITERAL reference predicates: LeaderInIsr and AsyncIsr's TypeOk
+    are False at Init under the literal reading (PARITY.md)."""
+    if emitted and oracle:
+        raise ValueError("emitted models have no oracle twin (the oracle IS "
+                         "an independent path; use oracle=False)")
     if cfg.constraints and module != "AsyncIsr":
         raise ValueError(
             f"CONSTRAINT {cfg.constraints} is not supported for module "
@@ -128,10 +138,16 @@ def build_model(module: str, cfg: TlcConfig, oracle: bool = False):
         )
     c = cfg.constants
     if module == "IdSequence":
+        if emitted:
+            return _emitted_id_sequence(int(c["MaxId"]))
         from ..models import id_sequence as m
 
         return (m.make_oracle if oracle else m.make_model)(int(c["MaxId"]))
     if module == "FiniteReplicatedLog":
+        if emitted:
+            return _emitted_frl(
+                _setlen(c["Replicas"]), int(c["LogSize"]), _setlen(c["LogRecords"])
+            )
         from ..models import finite_replicated_log as m
 
         return (m.make_oracle if oracle else m.make_model)(
@@ -147,7 +163,11 @@ def build_model(module: str, cfg: TlcConfig, oracle: bool = False):
             max_leader_epoch=int(c["MaxLeaderEpoch"]),
         )
         invs = tuple(cfg.invariants) or ("TypeOk",)
-        if module in KAFKA_VARIANTS:
+        if emitted:
+            from ..models.emitted import make_emitted_model
+
+            built = make_emitted_model(module, kcfg, invariants=invs)
+        elif module in KAFKA_VARIANTS:
             from ..models import variants as m
 
             built = (m.make_oracle if oracle else m.make_model)(module, kcfg, invs)
@@ -178,5 +198,63 @@ def build_model(module: str, cfg: TlcConfig, oracle: bool = False):
             max_version=int(c.get("MaxVersion", c["MaxOffset"])),
         )
         invs = tuple(cfg.invariants) or ("TypeOk", "ValidHighWatermark")
+        if emitted:
+            from ..models.emitted import make_emitted_async_isr
+
+            return make_emitted_async_isr(acfg, invariants=invs)
         return (m.make_oracle if oracle else m.make_model)(acfg, invs)
     raise KeyError(f"unknown module {module!r}")
+
+
+def _emitted_id_sequence(max_id: int):
+    from pathlib import Path
+
+    from ..models.emitted import REF
+    from ..ops.packing import Field, StateSpec
+    from .tla_emit import SInt, build_model as emit
+    from .tla_frontend import parse_tla
+
+    mod = parse_tla(Path(REF) / "IdSequence.tla")
+    spec = StateSpec([Field("nextId", (), 0, max_id + 1)])
+    return emit(
+        mod,
+        {"MaxId": max_id},
+        {"nextId": SInt("nextId", 0, max_id + 1)},
+        spec,
+        name=f"IdSequence(emitted,{max_id})",
+    )
+
+
+def _emitted_frl(n: int, log_size: int, n_records: int):
+    from pathlib import Path
+
+    from ..models.emitted import REF
+    from ..ops.packing import Field, StateSpec
+    from .tla_emit import SFun, SInt, SRec, build_model as emit
+    from .tla_frontend import parse_tla
+
+    mod = parse_tla(Path(REF) / "FiniteReplicatedLog.tla")
+    spec = StateSpec(
+        [Field("end", (n,), 0, log_size), Field("rec", (n, log_size), -1, n_records - 1)]
+    )
+    schema = SFun(
+        n,
+        SRec(
+            {
+                "endOffset": SInt("end", 0, log_size),
+                "records": SFun(log_size, SInt("rec", -1, n_records - 1)),
+            }
+        ),
+    )
+    return emit(
+        mod,
+        {
+            "Replicas": (0, n - 1),
+            "LogRecords": (0, n_records - 1),
+            "Nil": -1,
+            "LogSize": log_size,
+        },
+        {"logs": schema},
+        spec,
+        name=f"FiniteReplicatedLog(emitted,{n}x{log_size})",
+    )

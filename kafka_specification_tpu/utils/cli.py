@@ -108,6 +108,14 @@ def main(argv=None):
         help="wrap the run in a jax.profiler trace (TensorBoard format)",
     )
     pc.add_argument("--cpu", action="store_true", help="force the CPU platform")
+    pc.add_argument(
+        "--emitted",
+        action="store_true",
+        help="build the model mechanically from the reference TLA+ text "
+        "(utils/tla_emit — no hand-translated kernels); invariants are the "
+        "LITERAL reference predicates (see PARITY.md on LeaderInIsr and "
+        "AsyncIsr's TypeOk at Init)",
+    )
 
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
     po.add_argument("cfg")
@@ -218,7 +226,7 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    model = _build_or_fail(module, tlc_cfg)
+    model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
     progress = None
     if args.progress:
         def progress(depth, new_n, total):
@@ -239,9 +247,9 @@ def main(argv=None):
 
 
 
-def _build_or_fail(module, tlc_cfg, oracle=False):
+def _build_or_fail(module, tlc_cfg, oracle=False, emitted=False):
     try:
-        return build_model(module, tlc_cfg, oracle=oracle)
+        return build_model(module, tlc_cfg, oracle=oracle, emitted=emitted)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         raise SystemExit(2)

@@ -94,6 +94,21 @@ class SKeyedSet:
     absent: int
 
 
+@dataclass(frozen=True)
+class SPairSet:
+    """Grow-only set of [isr: SUBSET Replicas, version: 0..n_versions-1]
+    records where versions may REPEAT with different isr values (AsyncIsr's
+    `requests`: the leader reuses its current version, AsyncIsr.tla:88-115).
+    Stored as a per-version bitset over the 2^n_set subset lattice: bit s of
+    lane[v] says record [isr with mask s, version v] is present."""
+
+    field: str
+    n_versions: int
+    n_set: int  # |Replicas|; subset lattice has 2^n_set points
+    isr_field: str = "isr"
+    version_field: str = "version"
+
+
 # ------------------------------------------------------- symbolic int value
 class IVal:
     """Symbolic integer with static interval bounds [lo, hi]."""
@@ -194,6 +209,19 @@ class KeyedSetInsertV:
 
     base: Any  # KeyedSetV
     recs: list
+
+
+@dataclass
+class PairSetInsertV:
+    """`pairset \\union {rec, ...}` — an update RHS for SPairSet vars."""
+
+    base: Any  # PairSetV
+    recs: list
+
+
+@dataclass
+class NatV:
+    """The builtin Nat — membership-only (x >= 0), never enumerable."""
 
 
 @dataclass
@@ -301,11 +329,13 @@ def _set_member(x: IVal, s) -> Any:
         return (c & _set_member(x, s.a)) | (~c & _set_member(x, s.b))
     if isinstance(s, BitsetV):
         return ((s.mask >> x.val) & 1) == 1
-    if isinstance(s, (LazySet, KeyedSetV)):
+    if isinstance(s, (LazySet, KeyedSetV, PairSetV)):
         r = jnp.bool_(False)
         for e, c in _set_iter_static(s):
             r = r | (_eq(x, e) & _as_bool(c))
         return r
+    if isinstance(s, NatV):
+        return x.val >= 0
     raise NotImplementedError(f"membership in {type(s).__name__}")
 
 
@@ -336,7 +366,7 @@ def _value_in_type(v, t) -> Any:
             raise NotImplementedError("SUBSET membership needs a bitset value")
         r = jnp.bool_(True)
         for i in range(v.size):
-            has = ((v.mask >> i) & 1) == 1
+            has = _as_bool(((v.mask >> i) & 1) == 1)
             r = r & (~has | _set_member(IVal.of(i), t.base))
         return r
     if isinstance(t, SetUnion):
@@ -390,6 +420,8 @@ def _set_iter_static(s):
         return [
             (s.slot(IVal.of(i)), s.present(i)) for i in range(s.size)
         ]
+    if isinstance(s, PairSetV):
+        return s.items()
     if isinstance(s, RecTypeV):
         # cartesian product of the field domains -> record elements
         items = [(RecV({}), jnp.bool_(True))]
@@ -471,6 +503,8 @@ def _state_value(schema, state: dict, idx: tuple):
         return FunV(schema.size, lambda i: _state_value(schema.elem, state, idx + (i,)))
     if isinstance(schema, SKeyedSet):
         return KeyedSetV(schema, state, idx)
+    if isinstance(schema, SPairSet):
+        return PairSetV(schema, state, idx)
     raise TypeError(schema)
 
 
@@ -496,6 +530,29 @@ class KeyedSetV:
         v = _state_value(sch, self._state, self._idx + (IVal.of(i),))
         marker = v.val if isinstance(v, IVal) else v.mask
         return marker != self.schema.absent
+
+
+class PairSetV:
+    """State-backed (isr-subset, version) pair set (see SPairSet)."""
+
+    def __init__(self, schema: SPairSet, state: dict, idx: tuple):
+        self.schema, self._state, self._idx = schema, state, idx
+
+    def items(self):
+        """[(record, present)] over the full (version x subset) lattice."""
+        sch = self.schema
+        out = []
+        for v in range(sch.n_versions):
+            lane = _leaf_tensor(sch.field, self._state, self._idx + (v,))
+            for s in range(1 << sch.n_set):
+                rec = RecV(
+                    {
+                        sch.isr_field: BitsetV(s, sch.n_set),
+                        sch.version_field: IVal.of(v),
+                    }
+                )
+                out.append((rec, ((lane >> s) & 1) == 1))
+        return out
 
 
 class CondV:
@@ -579,6 +636,8 @@ class Emitter:
                 return env[ast.id]
             if ast.id in self.consts:
                 return self.consts[ast.id]
+            if ast.id == "Nat":
+                return NatV()
             if ast.id in self.var_schemas:
                 return _state_value(
                     self.var_schemas[ast.id], env["__state__"], ()
@@ -632,6 +691,10 @@ class Emitter:
                     if not isinstance(b, SetLitV):
                         raise NotImplementedError("keyed-set union needs literal records")
                     return KeyedSetInsertV(a, list(b.elems))
+                if isinstance(a, PairSetV):
+                    if not isinstance(b, SetLitV):
+                        raise NotImplementedError("pair-set union needs literal records")
+                    return PairSetInsertV(a, list(b.elems))
                 return SetUnion([a, b])
             if op == "\\":
                 a, b = ev(ast.a, env), ev(ast.b, env)
@@ -1240,16 +1303,20 @@ def build_model(
     invariant_names=("TypeOk",),
     name: Optional[str] = None,
     defs: Optional[dict] = None,
+    constraint_src: Optional[str] = None,
 ):
     """Emit a models.base.Model mechanically from a parsed TLA+ module.
 
     consts: name -> int or (lo, hi) range tuple (model-value sets map to
     0..n-1 ints; overriding a defined operator name, e.g. None -> -1, pins
     its model value and blocks inlining of the definition).  var_schemas:
-    TLA VARIABLE -> SInt/SBitset/SFun/SRec/SKeyedSet schema whose leaf
-    fields name entries of `spec` (an ops.packing.StateSpec).  defs: a
+    TLA VARIABLE -> SInt/SBitset/SFun/SRec/SKeyedSet/SPairSet schema whose
+    leaf fields name entries of `spec` (an ops.packing.StateSpec).  defs: a
     prebuilt definition namespace (load_defs) for modules with EXTENDS
     chains / INSTANCE substitutions; defaults to `mod`'s own definitions.
+    constraint_src: a TLA boolean expression over the variables (TLC's
+    CONSTRAINT — e.g. the authored `Bounded` for AsyncIsr's unbounded
+    spec); emitted as Model.constraint so violating successors are pruned.
     """
     from ..models.base import Action, Invariant, Model
 
@@ -1340,6 +1407,22 @@ def build_model(
                     )
                     out[leaf.field] = arr.at[idx + (key.val,)].set(v)
             return
+        if isinstance(schema, SPairSet):
+            if isinstance(val, PairSetV):
+                return  # assigned unchanged
+            if not isinstance(val, PairSetInsertV):
+                raise NotImplementedError(
+                    "pair-set update must be `base \\union {records}`"
+                )
+            for rec in val.recs:
+                ver = IVal.of(_rec_field(rec, schema.version_field)).val
+                isr = _mask_of(_rec_field(rec, schema.isr_field), schema.n_set)
+                arr = out[schema.field]
+                lane = arr[idx + (ver,)]
+                out[schema.field] = arr.at[idx + (ver,)].set(
+                    lane | (jnp.int32(1) << isr)
+                )
+            return
         raise TypeError(schema)
 
     # Init: conjuncts `var = expr`, evaluated concretely
@@ -1383,6 +1466,17 @@ def build_model(
                     else:
                         _conc_encode(leaf, r[n], out, idx + (j,))
             return
+        if isinstance(schema, SPairSet):
+            lanes = [0] * schema.n_versions
+            for r in val:
+                r = dict(r) if not isinstance(r, dict) else r
+                mask = 0
+                for e in r[schema.isr_field]:
+                    mask |= 1 << int(e)
+                lanes[int(r[schema.version_field])] |= 1 << mask
+            for v in range(schema.n_versions):
+                out.setdefault(schema.field, {})[idx + (v,)] = lanes[v]
+            return
         raise TypeError(schema)
 
     def init_states_wrapped():
@@ -1418,12 +1512,20 @@ def build_model(
 
         invariants.append(Invariant(iname, pred))
 
+    constraint = None
+    if constraint_src is not None:
+        c_body = inline(E.parse_expr(constraint_src), defs, keep)
+
+        def constraint(state, c_body=c_body):
+            return _as_bool(emitter.eval(c_body, {"__state__": state}))
+
     return Model(
         name=name or f"{mod.name}(emitted)",
         spec=spec,
         init_states=init_states_wrapped,
         actions=[make_kernel(a) for a in actions_ir],
         invariants=invariants,
+        constraint=constraint,
         decode=None,
     )
 

@@ -1,0 +1,83 @@
+"""Bounded Kip320 5-broker single-partition probe (BASELINE.json stretch).
+
+The stretch workload is Kip320 at 5 brokers x 3 partitions (> 1e9 product
+states).  This script measures the base factor on the available hardware:
+a wall-clock-bounded exploration of the single-partition 5-broker space
+(configs/Kip320Stretch.cfg constants minus Partitions) on the host-FpSet
+backend, recording states/sec, depth, frontier sizes and RSS so RESULTS.md
+can extrapolate to the product target honestly.
+
+Usage: python scripts/run_5broker_bounded.py [minutes] [--tpu]
+(defaults: 60 minutes, CPU pinned — the axon tunnel wedges; pass --tpu to
+try the chip first).
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+_pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+MINUTES = float(_pos[0]) if _pos else 60.0
+
+import jax
+
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+
+from kafka_specification_tpu.engine import check
+from kafka_specification_tpu.models import kip320
+from kafka_specification_tpu.models.kafka_replication import Config
+
+cfg = Config(n_replicas=5, log_size=2, max_records=2, max_leader_epoch=2)
+model = kip320.make_model(cfg)
+deadline = time.time() + MINUTES * 60.0
+t0 = time.time()
+last = {"t": t0}
+
+
+def progress(depth, new_n, total):
+    now = time.time()
+    rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    rec = {
+        "depth": depth,
+        "new": int(new_n),
+        "total": int(total),
+        "elapsed_s": round(now - t0, 1),
+        "states_per_sec": round(total / max(now - t0, 1e-9), 1),
+        "rss_gb": round(rss_gb, 2),
+    }
+    print(json.dumps(rec), flush=True)
+    last["t"] = now
+    if now > deadline:
+        raise KeyboardInterrupt  # wall-clock cut
+
+
+try:
+    res = check(
+        model,
+        store_trace=False,
+        visited_backend="host",
+        chunk_size=131072,
+        min_bucket=8192,
+        progress=progress,
+    )
+    print(
+        json.dumps(
+            {
+                "final": True,
+                "ok": res.ok,
+                "total": res.total,
+                "diameter": res.diameter,
+                "seconds": round(res.seconds, 1),
+                "states_per_sec": round(res.states_per_sec, 1),
+            }
+        )
+    )
+except KeyboardInterrupt:
+    print(json.dumps({"cut": True, "reason": f"wall clock {MINUTES} min"}))

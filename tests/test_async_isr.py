@@ -5,6 +5,8 @@ exploration; the bounds (max_offset/max_version) stand in for the TLC state
 CONSTRAINT the unbounded spec requires (LeaderWrite is unguarded,
 AsyncIsr.tla:117-119)."""
 
+import pytest
+
 from kafka_specification_tpu.engine import check
 from kafka_specification_tpu.models import async_isr
 
@@ -19,6 +21,7 @@ def test_async_isr_small_exact_match():
     assert res.diameter == 11
 
 
+@pytest.mark.slow  # ~12s: 4,088-state oracle match; 2-replica stays fast
 def test_async_isr_three_replicas_exact_match():
     cfg = async_isr.AsyncIsrConfig(n_replicas=3, max_offset=2, max_version=2)
     res, _ = assert_matches_oracle(async_isr.make_model(cfg), async_isr.make_oracle(cfg))

@@ -125,3 +125,41 @@ def test_variant_list_is_complete():
         "Kip320FirstTry",
         "Kip320",
     }
+
+
+@pytest.mark.slow  # ~15s: 4,088-state set comparison; the literal-TypeOk
+# test below keeps the emitted AsyncIsr path in the fast suite
+def test_emitted_async_isr_matches_hand():
+    """The standalone AsyncIsr emits end to end (SPairSet request encoding,
+    emitted CONSTRAINT) and reproduces the hand model's 4,088-state space
+    with ValidHighWatermark holding (AsyncIsr.tla:161-162)."""
+    from kafka_specification_tpu.models import async_isr
+    from kafka_specification_tpu.models.emitted import make_emitted_async_isr
+
+    cfg = async_isr.AsyncIsrConfig(3, 2, 2)
+    r = _assert_same_level_sets(
+        make_emitted_async_isr(cfg, invariants=()),
+        async_isr.make_model(cfg, invariants=()),
+    )
+    assert r.total == 4088 and r.diameter == 16
+    rv = check(
+        make_emitted_async_isr(cfg, invariants=("ValidHighWatermark",)),
+        store_trace=False,
+    )
+    assert rv.ok
+
+
+def test_emitted_async_isr_literal_type_ok_false_at_init():
+    """The reference's literal TypeOk is violated at Init: pendingVersion
+    is declared Nat (AsyncIsr.tla:45) but initialized to Nil (:145).  The
+    mechanical front-end surfaces this (PARITY.md); the hand model checks
+    the evident intent (Nat ∪ {Nil}) instead."""
+    from kafka_specification_tpu.models import async_isr
+    from kafka_specification_tpu.models.emitted import make_emitted_async_isr
+
+    cfg = async_isr.AsyncIsrConfig(3, 2, 2)
+    r = check(
+        make_emitted_async_isr(cfg, invariants=("TypeOk",)), store_trace=False
+    )
+    assert not r.ok
+    assert r.violation.invariant == "TypeOk" and r.violation.depth == 0

@@ -39,6 +39,7 @@ def test_kip320_first_try_tiny_exact_match():
     assert res.total == 337
 
 
+@pytest.mark.slow  # ~20s: 5,973-state THEOREM run; tiny (277) stays fast
 def test_kip320_small_exhaustive_pass():
     """All four invariants hold on the full 5973-state space (oracle-pinned)."""
     res, _ = assert_matches_oracle(

@@ -51,6 +51,7 @@ def test_truncate_to_hw_violates_weak_isr():
     assert res.violation.trace[0][0] == "<init>"
 
 
+@pytest.mark.slow  # ~15s: E2 known-answer; fast suite keeps the E1 matrix
 def test_kip101_fails_under_fast_leader_changes():
     """Kip101 holds at MaxLeaderEpoch=1 but fails WeakIsr at 2 — the
     'consecutive fast leader changes' hole that motivated KIP-279
@@ -69,6 +70,7 @@ def test_kip101_fails_under_fast_leader_changes():
     assert res2.violation.depth == 11
 
 
+@pytest.mark.slow  # ~21s: 9,027-state exhaustive; covered at tiny config fast
 def test_kip279_truncation_sound_at_small_config():
     """Kip279's tail-matching truncation fixes the Kip101 hole: the same
     config that breaks Kip101 passes WeakIsr and StrongIsr under Kip279
