@@ -13,15 +13,19 @@ outsource to the external TLC tool — rebuilt TPU-first on JAX/XLA:
   64-bit fingerprint dedup (`engine/`), sharded over a device mesh with
   `shard_map` + `all_to_all` fingerprint routing (`parallel/`),
 - a pure-Python oracle interpreter of the same TLA+ semantics (`oracle/`)
-  serving as the golden cross-check in place of stock TLC.
+  serving as the golden cross-check in place of stock TLC,
+- a TLA+ expression front-end (`utils/tla_expr` -> `utils/tla_emit`) that
+  emits the same kernels mechanically from the reference text — every
+  corpus module builds both ways, and the two paths agree on exact
+  per-level state sets (`models/emitted.py`).
 
 Layout:
     ops/       packing, fingerprinting, sorting/dedup primitives
     models/    tensor encodings + action/invariant kernels per TLA+ module
     engine/    BFS checker, trace reconstruction, checkpointing, stats
-    parallel/  mesh-sharded frontier (ICI collectives)
+    parallel/  mesh-sharded frontier (ICI collectives; multi-host via DCN)
     oracle/    slow set-semantics reference interpreter (golden source)
-    utils/     TLC-compatible .cfg parsing, CLI
+    utils/     TLC-compatible .cfg parsing, TLA+ front-end, CLI
 """
 
 __version__ = "0.1.0"

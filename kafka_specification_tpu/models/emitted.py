@@ -120,7 +120,7 @@ def make_emitted_model(
         "MaxLeaderEpoch": cfg.e,
         "None": NONE,  # model value "NONE" (KafkaReplication.tla:38)
     }
-    return build_model(
+    built = build_model(
         mod,
         consts,
         l3_schemas(cfg),
@@ -129,6 +129,21 @@ def make_emitted_model(
         name=f"{module}(emitted,{cfg.n}r)",
         defs=defs,
     )
+    # emitted and hand models share the same lanes, so the hand decoder and
+    # trace-rendering metadata apply verbatim (pretty counterexamples +
+    # direct state-set comparison against the oracle)
+    from . import kip320 as _kip320
+    from . import variants as _variants
+
+    if module == "Kip320":
+        hand = _kip320.make_model(cfg, invariants=())
+    elif module == "Kip320FirstTry":
+        hand = _kip320.make_first_try_model(cfg, invariants=())
+    else:
+        hand = _variants.make_model(module, cfg, invariants=())
+    built.decode = hand.decode
+    built.meta = hand.meta
+    return built
 
 
 #: the TLC CONSTRAINT bounding AsyncIsr's unbounded spec (authored — the

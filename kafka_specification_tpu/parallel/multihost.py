@@ -49,27 +49,27 @@ def init_distributed(
     """Initialize JAX's multi-host runtime if configured; no-op otherwise.
 
     Explicit args win; else the standard env vars drive it
-    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or a
-    cluster environment jax.distributed auto-detects).  Returns
-    {"process_id", "process_count", "local_devices", "global_devices"}.
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID); any
+    field left unset is passed as None so jax.distributed's own cluster
+    auto-detection (SLURM / TPU pod metadata) fills it in.  Also runs
+    initialize() with all-None args when KSPEC_MULTIHOST=1, for clusters
+    that are fully auto-detectable.  Returns {"process_id",
+    "process_count", "local_devices", "global_devices"}.
     """
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if addr is not None:
+    want = addr is not None or os.environ.get("KSPEC_MULTIHOST") == "1"
+    if want:
+        if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is None and "JAX_PROCESS_ID" in os.environ:
+            process_id = int(os.environ["JAX_PROCESS_ID"])
         # NB: must run before anything initializes the XLA backend (even
         # jax.process_count() would), so no jax queries happen first
         try:
             jax.distributed.initialize(
                 coordinator_address=addr,
-                num_processes=(
-                    num_processes
-                    if num_processes is not None
-                    else int(os.environ.get("JAX_NUM_PROCESSES", "1"))
-                ),
-                process_id=(
-                    process_id
-                    if process_id is not None
-                    else int(os.environ.get("JAX_PROCESS_ID", "0"))
-                ),
+                num_processes=num_processes,
+                process_id=process_id,
             )
         except RuntimeError as e:
             # idempotent re-entry (e.g. resume path): already initialized

@@ -21,6 +21,8 @@ import time
 _pos = [a for a in sys.argv[1:] if not a.startswith("-")]
 MINUTES = float(_pos[0]) if _pos else 60.0
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 
 if "--tpu" not in sys.argv:
@@ -38,7 +40,6 @@ cfg = Config(n_replicas=5, log_size=2, max_records=2, max_leader_epoch=2)
 model = kip320.make_model(cfg)
 deadline = time.time() + MINUTES * 60.0
 t0 = time.time()
-last = {"t": t0}
 
 
 def progress(depth, new_n, total):
@@ -53,9 +54,8 @@ def progress(depth, new_n, total):
         "rss_gb": round(rss_gb, 2),
     }
     print(json.dumps(rec), flush=True)
-    last["t"] = now
     if now > deadline:
-        raise KeyboardInterrupt  # wall-clock cut
+        raise KeyboardInterrupt  # wall-clock cut (fires at level boundaries)
 
 
 try:
