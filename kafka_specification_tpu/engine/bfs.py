@@ -267,8 +267,16 @@ class _Step:
         if shift and (bucket >> shift) < 1:
             shift = 0
         expand = self.make_expand(bucket, shift)
-        # total candidate width the sort/probe/outputs run at
-        T = self.expand_width(bucket, shift)
+        # Candidate width the sort/probe/outputs run at.  On the compact
+        # path a second-stage squeeze gathers the enabled candidates (the
+        # per-action buffers are ~4x oversized by design, so ~25% occupied)
+        # into a T/2 buffer before fingerprint/sort/probe — the sort is the
+        # single most expensive stage, and its cost is set by this width.
+        # Squeeze overflow reuses the existing retry: the host re-runs at a
+        # smaller compact shift, and the shift=0 full path never squeezes,
+        # so results stay exact at every density.
+        T_exp = self.expand_width(bucket, shift)
+        T = max(256, T_exp >> 1) if shift else T_exp
 
         def step(frontier, fvalid, vhi, vlo, vn):
             states = jax.vmap(spec.unpack)(frontier)
@@ -279,16 +287,26 @@ class _Step:
             dl_any = jnp.any(deadlocked)
             dl_idx = jnp.argmax(deadlocked)
 
+            if shift:
+                n_en = jnp.sum(valid, dtype=jnp.int32)
+                overflow = overflow | (n_en > T)
+                spos = jnp.where(valid, jnp.cumsum(valid) - 1, T)
+                cand = jnp.zeros((T, K), jnp.uint32).at[spos].set(cand)
+                parent = jnp.full((T,), -1, jnp.int32).at[spos].set(parent)
+                actid = jnp.full((T,), -1, jnp.int32).at[spos].set(actid)
+                valid = jnp.arange(T) < n_en
+
             sent = jnp.uint32(dedup.SENT)
             if self.use_pallas:
                 from ..ops.pallas_fingerprint import fingerprint_pallas
 
                 interp = jax.default_backend() == "cpu"
-                # block_rows must divide T: the compacted buffer is a
-                # concatenation of per-action widths, each a multiple of
-                # bucket>>shift; the full lattice is bucket*C
+                # block_rows must divide T: the squeezed compact buffer is
+                # (bucket>>(shift+1))*C rows; the full lattice is bucket*C
                 block = (
-                    max(1, bucket >> shift) if shift else C * min(bucket, 256)
+                    max(1, bucket >> (shift + 1))
+                    if shift
+                    else C * min(bucket, 256)
                 )
                 hi, lo = fingerprint_pallas(
                     cand, valid, block_rows=block, interpret=interp

@@ -66,11 +66,21 @@ def init_distributed(
         # NB: must run before anything initializes the XLA backend (even
         # jax.process_count() would), so no jax queries happen first
         try:
-            jax.distributed.initialize(
-                coordinator_address=addr,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=addr,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+            except ValueError:
+                if addr is None or num_processes is not None or process_id is not None:
+                    raise
+                # explicit coordinator, no topology given anywhere, and
+                # jax's cluster auto-detection found nothing -> the
+                # 1-process degenerate launch (the testable path here)
+                jax.distributed.initialize(
+                    coordinator_address=addr, num_processes=1, process_id=0
+                )
         except RuntimeError as e:
             # idempotent re-entry (e.g. resume path): already initialized
             if "already" not in str(e).lower():
