@@ -133,6 +133,11 @@ def main(argv=None):
     ps.add_argument("--seed", type=int, default=0)
     ps.add_argument("--cpu", action="store_true", help="force the CPU platform")
     ps.add_argument("--json", action="store_true")
+    ps.add_argument(
+        "--emitted",
+        action="store_true",
+        help="simulate the mechanically emitted model (see `check --emitted`)",
+    )
 
     pv = sub.add_parser(
         "validate",
@@ -180,7 +185,7 @@ def main(argv=None):
             jax.config.update("jax_platforms", "cpu")
         from ..engine.simulate import simulate
 
-        model = _build_or_fail(module, tlc_cfg)
+        model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
         res = simulate(
             model, num_walks=args.walks, max_depth=args.depth, seed=args.seed
         )

@@ -55,6 +55,18 @@ def test_cli_check_and_exit_codes(tmp_path, capsys):
     assert '"distinct_states": 12' in out
 
 
+def test_cli_simulate_emitted(capsys):
+    # random walks over the mechanically emitted IdSequence model; TypeOk
+    # holds on every walk -> exit 0
+    rc = cli_main(
+        ["simulate", "configs/IdSequence.cfg", "--emitted", "--walks", "4",
+         "--depth", "6", "--seed", "3"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no violations" in out
+
+
 def test_checkpoint_resume(tmp_path):
     from kafka_specification_tpu.models import finite_replicated_log as frl
 
