@@ -109,3 +109,20 @@ def test_host_backend_with_checkpoint_and_chunking(tmp_path):
     assert resumed.total == 29791
     assert resumed.diameter == 12  # level bookkeeping restored across resume
     assert resumed.stats["host_fpset_size"] == 29791
+
+
+def test_host_backend_compact_shift_path():
+    """The host-dedup fast path with two-phase compaction active (bucket >=
+    4096 enables compact_shift): the squeeze-to-T buffer, its overflow
+    wiring and the no-sort fingerprint handoff must reproduce the golden
+    count.  This is the profiled bench configuration on CPU (the other
+    host-backend tests use tiny buckets where shift stays 0)."""
+    res = check(
+        frl.make_model(3, 4, 2),
+        min_bucket=4096,
+        visited_backend="host",
+    )
+    assert res.ok
+    assert res.total == 29791  # 31^3 closed-form golden count (RESULTS.md)
+    assert res.diameter == 12
+    assert res.stats["host_fpset_size"] == 29791
