@@ -18,10 +18,132 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 from .cfg import build_model, parse_cfg
+
+# Platform-init watchdog (see _guarded_reexec): a wedged accelerator tunnel
+# (observed: axon TPU) can hang PJRT client creation indefinitely — or pass
+# a quick jax.devices() probe and wedge on the very next operation (both
+# modes observed round 2) — turning `cli check` on the *default* platform
+# into an unbounded hang.  The guard re-execs the command in a child that
+# writes a two-phase marker file: "init" once jax.devices() returns, then
+# "compute" once one tiny jitted computation has executed end-to-end.  The
+# parent bounds each phase separately (the checking run itself is never
+# time-limited) and on either timeout kills the child and retries pinned
+# to CPU with a warning.
+_CLI_CHILD_ENV = "KSPEC_CLI_CHILD"
+_CLI_MARKER_ENV = "KSPEC_CLI_PLATFORM_MARKER"
+# phase budgets: healthy tunnel init ~20s; first tiny compile through the
+# tunnel 20-40s (bench.py's budget for the same ops)
+_INIT_TIMEOUT = int(os.environ.get("KSPEC_CLI_PLATFORM_TIMEOUT", "45"))
+_COMPUTE_TIMEOUT = int(os.environ.get("KSPEC_CLI_COMPUTE_TIMEOUT", "90"))
+
+
+def _platform_is_pinned() -> bool:
+    """True when the platform choice can't hang: pinned to CPU via env.
+
+    Anything else — unset (default discovery), or pinned to an accelerator
+    ("tpu", "axon", a mixed list) — can wedge in PJRT client init and goes
+    through the guarded child instead.  (This environment exports
+    JAX_PLATFORMS=axon, the tunnel platform that motivated the guard.)
+    """
+    pinned = os.environ.get("JAX_PLATFORMS", "")
+    names = {p.strip().lower() for p in pinned.split(",") if p.strip()}
+    return names == {"cpu"}
+
+
+def _mark_platform_ready():
+    """Child half of the watchdog: force backend init + one end-to-end
+    computation, signalling the parent after each phase."""
+    from .platform_guard import platform_ready_probe
+
+    marker = os.environ.get(_CLI_MARKER_ENV)
+
+    def write(stage):
+        if marker:
+            with open(marker, "a") as fh:
+                fh.write(stage + "\n")
+
+    import jax
+
+    jax.devices()
+    write("init")
+    platform_ready_probe()
+    write("compute")
+
+
+def _guarded_reexec(argv) -> int:
+    """Parent half: run this CLI in a child, bounding only platform
+    init + first computation.
+
+    Returns the child's exit code; on a wedge in either phase, retries
+    with the CPU platform (and no accelerator plugin) pinned in the
+    child's environment.
+    """
+    import subprocess
+    import tempfile
+
+    from .platform_guard import cpu_env
+
+    def run(env):
+        """-> ("ok", rc) | ("initfail", rc) | ("timeout", None)."""
+        marker = tempfile.NamedTemporaryFile(delete=False, suffix=".ready")
+        marker.close()
+        os.unlink(marker.name)
+        env = dict(env)
+        env[_CLI_CHILD_ENV] = "1"
+        env[_CLI_MARKER_ENV] = marker.name
+        p = subprocess.Popen(
+            [sys.executable, "-m", "kafka_specification_tpu.utils.cli"]
+            + list(argv)
+        , env=env)
+
+        def marker_stages():
+            try:
+                with open(marker.name) as fh:
+                    return fh.read().split()
+            except OSError:
+                return []
+
+        deadline = time.monotonic() + _INIT_TIMEOUT
+        compute_deadline = None
+        while time.monotonic() < deadline:
+            stages = marker_stages()
+            if "compute" in stages:
+                os.unlink(marker.name)
+                return "ok", p.wait()  # platform live: no further limit
+            if "init" in stages and compute_deadline is None:
+                compute_deadline = time.monotonic() + _COMPUTE_TIMEOUT
+                deadline = compute_deadline
+            rc = p.poll()
+            if rc == 0:
+                return "ok", 0  # finished clean before marking
+            if rc is not None:
+                # nonzero before the marker: init (or pre-init) failure
+                return "initfail", rc
+            time.sleep(0.2)
+        p.kill()
+        p.wait()
+        return "timeout", None
+
+    kind, rc = run(os.environ)
+    if kind != "ok":
+        print(
+            f"warning: default platform failed to come up "
+            f"({'wedged — killed' if kind == 'timeout' else f'exited {rc}'}); "
+            f"retrying on CPU (pass --cpu to skip the probe)",
+            file=sys.stderr,
+        )
+        kind, rc = run(cpu_env())
+        if kind == "timeout":  # CPU init can't hang in practice, but be safe
+            print("error: CPU platform init timed out", file=sys.stderr)
+            return 3
+        # "initfail" on CPU = a real (non-platform) failure that reproduced
+        # there — propagate the child's actual exit code
+    return rc
 
 
 def _print_result(res, as_json: bool, model_meta=None):
@@ -158,6 +280,26 @@ def main(argv=None):
         print(f"error: cannot parse {args.cfg}: {e}", file=sys.stderr)
         return 2
 
+    if args.cmd in ("check", "simulate"):
+        if (
+            not args.cpu
+            and not _platform_is_pinned()
+            and not os.environ.get(_CLI_CHILD_ENV)
+        ):
+            # default platform may be a hang-prone accelerator tunnel:
+            # run guarded (init-bounded child, CPU fallback)
+            return _guarded_reexec(argv if argv is not None else sys.argv[1:])
+        from .platform_guard import pin_cpu_in_process, reassert_env_pin
+
+        if args.cpu:
+            pin_cpu_in_process()
+        elif _platform_is_pinned():
+            # sitecustomize may force jax_platforms (e.g. "axon,cpu") at
+            # interpreter start, overriding the env var — re-assert it
+            reassert_env_pin()
+        if os.environ.get(_CLI_CHILD_ENV):
+            _mark_platform_ready()
+
     if args.cmd == "validate":
         from .tla_frontend import validate_cfg_constants, validate_model
 
@@ -179,10 +321,6 @@ def main(argv=None):
         return 0
 
     if args.cmd == "simulate":
-        if args.cpu:
-            import jax
-
-            jax.config.update("jax_platforms", "cpu")
         from ..engine.simulate import simulate
 
         model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
@@ -227,10 +365,6 @@ def main(argv=None):
             print("No invariant violations. Exhaustive check complete.")
         return 0 if r.violation is None else 1
 
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
     model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
     progress = None
     if args.progress:

@@ -1,0 +1,67 @@
+"""Single source of truth for this environment's platform-selection quirks.
+
+Three facts every entry point (CLI, bench.py, scripts/) must know:
+
+1. sitecustomize force-registers the `axon` TPU plugin whenever
+   PALLAS_AXON_POOL_IPS is set, and force-sets jax_platforms="axon,cpu" at
+   the *config* level — so the JAX_PLATFORMS env var alone cannot pin CPU.
+2. The axon tunnel can wedge PJRT client init indefinitely, and (observed
+   round 2) can also pass a quick `jax.devices()` probe and then hang the
+   very next operation — so a guard must cover the first real computation,
+   not just backend init.
+3. Import of jax is safe (no backend init); `jax.devices()` / the first
+   dispatch is where a wedge bites.
+
+Keep every copy of this knowledge here; cli.py and bench.py both build
+their guarded children from these helpers.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def cpu_env(base_env=None) -> dict:
+    """A child-process environment pinned to CPU and kept off the tunnel.
+
+    Popping PALLAS_AXON_POOL_IPS makes sitecustomize skip axon plugin
+    registration entirely, at which point JAX_PLATFORMS=cpu is honored.
+    """
+    env = dict(os.environ if base_env is None else base_env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def pin_cpu_in_process() -> None:
+    """Pin the CURRENT process to CPU (for --cpu flags / scripts).
+
+    Must run before anything initializes an XLA backend; works even when
+    sitecustomize already forced jax_platforms="axon,cpu" (the config
+    update wins as long as no backend exists yet).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def reassert_env_pin() -> None:
+    """Re-assert a JAX_PLATFORMS env pin at the config level (fact 1)."""
+    pinned = os.environ.get("JAX_PLATFORMS")
+    if pinned:
+        import jax
+
+        jax.config.update("jax_platforms", pinned)
+
+
+def platform_ready_probe() -> str:
+    """Force backend init AND one tiny end-to-end computation; returns the
+    platform name.  A wedged tunnel hangs in here — callers run this in a
+    killable child (fact 2: `jax.devices()` alone is not a sufficient
+    probe; the first compile/execute must also survive)."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.zeros((8,), jnp.int32)))
+    return platform
