@@ -184,6 +184,30 @@ class Domain:  # DOMAIN f
     fn: Any
 
 
+# ------------------------------------------------- temporal formulas (Spec)
+# The corpus states no liveness *properties* (SURVEY.md §2.4): temporal
+# syntax appears only inside `Spec` definitions, as `Init /\ [][Next]_vars`
+# plus SF_/WF_ fairness conjuncts.  These nodes make that syntax parse (and
+# let spec_structure() extract/ignore it per TLC semantics for safety
+# checking); nothing evaluates them.
+@dataclass(frozen=True)
+class ActionSub:  # [A]_sub — action A or stuttering on sub
+    action: Any
+    sub: str  # subscript text ("vars", "nextId", "logs")
+
+
+@dataclass(frozen=True)
+class Box:  # []F — temporal always
+    body: Any
+
+
+@dataclass(frozen=True)
+class Fairness:  # SF_sub(A) / WF_sub(A)
+    kind: str  # "SF" | "WF"
+    sub: str
+    action: Any
+
+
 # ---------------------------------------------------------------- tokenizer
 _TOKEN = re.compile(
     r"""
@@ -489,6 +513,11 @@ class _Parser:
                     self.next()
                     args.append(self.parse(0))
                 self.expect(")")
+                # fairness conjuncts: SF_vars(A) / WF_nextId(A) lex as one
+                # name token ("SF_vars") applied to the action
+                m = re.match(r"(SF|WF)_(\w+)$", lex)
+                if m and len(args) == 1:
+                    return Fairness(m.group(1), m.group(2), args[0])
                 return Apply(lex, tuple(args))
             return Name(lex)
         if kind == "(":
@@ -534,6 +563,10 @@ class _Parser:
             self.expect("}")
             return SetLit(tuple(elems))
         if kind == "[":
+            # temporal always: [] F (in the corpus only as [][Next]_vars)
+            if self.peek()[0] == "]":
+                self.next()
+                return Box(self.parse_unary_postfix())
             return self._parse_bracket()
         raise SyntaxError(f"unexpected token {kind!r} {lex!r}")
 
@@ -607,7 +640,44 @@ class _Parser:
             self.expect("]")
             return FunType(e, rng)
         self.expect("]")
+        # action with stuttering subscript: [A]_vars (Spec bodies)
+        nk, nlex = self.peek()
+        if nk == "name" and nlex.startswith("_") and len(nlex) > 1:
+            self.next()
+            return ActionSub(e, nlex[1:])
         raise SyntaxError("unsupported bracket expression")
+
+
+def spec_structure(ast) -> dict:
+    """Decompose a parsed Spec body `Init /\\ [][Next]_sub /\\ SF_/WF_...`
+    into {"init": ast, "next": ast, "sub": str,
+    "fairness": [(kind, sub, action_ast), ...]}.
+
+    Raises ValueError on a conjunct that is neither the init predicate, the
+    boxed next-action, nor a fairness operator — the corpus has no such
+    Spec (and a new one should be looked at by a human)."""
+    conj = []
+
+    def flat(e):
+        if isinstance(e, Binop) and e.op == "and":
+            flat(e.a)
+            flat(e.b)
+        else:
+            conj.append(e)
+
+    flat(ast)
+    out = {"init": None, "next": None, "sub": None, "fairness": []}
+    for c in conj:
+        if isinstance(c, Box) and isinstance(c.body, ActionSub):
+            out["next"] = c.body.action
+            out["sub"] = c.body.sub
+        elif isinstance(c, Fairness):
+            out["fairness"].append((c.kind, c.sub, c.action))
+        elif out["init"] is None and not isinstance(c, (Box, ActionSub)):
+            out["init"] = c
+        else:
+            raise ValueError(f"unrecognized Spec conjunct: {c!r}")
+    return out
 
 
 def parse_expr(text: str):

@@ -33,6 +33,34 @@ class TlaModule:
     definitions: dict = field(default_factory=dict)  # name -> body text
     instances: dict = field(default_factory=dict)  # alias -> (module, {subs})
     local_defs: set = field(default_factory=set)  # LOCAL names (not inherited)
+    theorems: list = field(default_factory=list)  # THEOREM statement texts
+
+    def spec_structure(self, name: str = "Spec") -> dict | None:
+        """Parsed temporal structure of this module's Spec definition:
+        {"init", "next", "sub", "fairness": [(SF|WF, sub, action_ast)]}.
+
+        TLC ignores fairness for safety checking (SURVEY.md §2.4: every
+        Spec in the corpus carries SF/WF conjuncts but no liveness property
+        is ever stated) — this parses and records them so the front-end
+        reads the whole corpus; nothing evaluates them.
+        """
+        if name not in self.definitions:
+            return None
+        from .tla_expr import parse_definition, spec_structure
+
+        _, _, ast = parse_definition(self.definitions[name])
+        return spec_structure(ast)
+
+    def liveness_theorems(self) -> list[str]:
+        """THEOREM statements that assert anything beyond `Spec => []Inv` /
+        `Spec => Inv` (an invariant under the standard safety reading).
+        Empty for the whole reference corpus — asserted by tests, making
+        SURVEY.md §2.4's 'safety-only checker suffices' claim checkable."""
+        out = []
+        for t in self.theorems:
+            if not re.match(r"\s*Spec\s*=>\s*(\[\])?\w+\s*$", t):
+                out.append(t)
+        return out
 
 
 _COMMENT_BLOCK = re.compile(r"\(\*.*?\*\)", re.S)
@@ -130,6 +158,11 @@ def parse_tla(path_or_text) -> TlaModule:
         alias, target, withs = im.group(1), im.group(2), im.group(3) or ""
         mod.instances[alias] = (target, _parse_withs(withs))
         mod.definitions.pop(alias, None)
+
+    # THEOREM statements (single-line in the corpus): the module's stated
+    # correctness claims, e.g. `Spec => []StrongIsr` (Kip320.tla:168-171)
+    for tm in re.finditer(r"^\s*THEOREM\s+(.+?)\s*$", body, re.M):
+        mod.theorems.append(tm.group(1))
 
     return mod
 
