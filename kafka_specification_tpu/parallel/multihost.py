@@ -22,10 +22,11 @@ agree everywhere without a coordinator:
 
 Both helpers are in the check_sharded hot path already, so the engine is
 multi-host-shaped by construction; this module is the only place that
-distinguishes the two regimes.  The host-FpSet spill backend replicates
-inserts on every process (same fingerprints, same sets) — correct, with
-host memory duplicated per process; per-host shard ownership is the
-documented follow-up (docs/DISTRIBUTED.md).
+distinguishes the two regimes.  The host-FpSet spill backend is per-host
+owned: each process keeps FpSets only for the shards whose devices it
+hosts, computes their novelty masks locally, and the masks are OR-merged
+across processes (`or_across_processes`) so the replicated loop stays in
+lockstep — host memory and insert work both scale down 1/P.
 
 This environment has a single host (one tunnel-attached chip), so the
 multi-process regime is exercised only via the single-process degenerate
@@ -136,3 +137,19 @@ def is_coordinator() -> bool:
     """True on the process that performs singleton side effects
     (checkpoint writes, stats files)."""
     return jax.process_index() == 0
+
+
+def or_across_processes(arr: np.ndarray) -> np.ndarray:
+    """Element-wise OR of a boolean ndarray across all processes.
+
+    The host-FpSet novelty masks are computed only by each shard's owner
+    process (per-host set ownership); OR-merging them gives every process
+    the identical global mask the replicated host loop requires.
+    Single-process: identity.
+    """
+    if not is_multiprocess():
+        return arr
+    from jax.experimental import multihost_utils
+
+    g = multihost_utils.process_allgather(arr.astype(np.uint8))  # [P, ...]
+    return np.asarray(g).any(axis=0)

@@ -43,7 +43,13 @@ from ..engine.bfs import (
 )
 from ..models.base import Model
 from ..ops import dedup
-from .multihost import fetch_global, is_coordinator, put_global
+from .multihost import (
+    fetch_global,
+    is_coordinator,
+    is_multiprocess,
+    or_across_processes,
+    put_global,
+)
 from ..ops.fingerprint import fingerprint_lanes
 
 
@@ -332,15 +338,24 @@ def check_sharded(
     hi0, lo0 = fingerprint_lanes(jnp.asarray(init_packed), spec.exact64)
     hi0, lo0 = np.asarray(hi0), np.asarray(lo0)
     owner0 = lo0 % D
+    # which process hosts each shard's device (per-host FpSet ownership)
+    shard_proc = [int(dev.process_index) for dev in mesh.devices.flat]
+    my_proc = jax.process_index()
     if visited_backend == "host":
         from ..native import FpSet
 
-        # one FpSet per shard: ownership routing sends a fingerprint to the
-        # same shard every time, so per-shard sets never need cross-talk
-        host_sets = [FpSet() for _ in range(D)]
+        # one FpSet per shard, living ONLY on the process that hosts the
+        # shard's device: ownership routing sends a fingerprint to the same
+        # shard every time, so per-shard sets never need cross-talk, and
+        # per-host ownership divides set memory and insert work by the
+        # process count (novelty masks are OR-merged across processes to
+        # keep the replicated host loop in lockstep)
+        host_sets = [
+            FpSet() if shard_proc[d] == my_proc else None for d in range(D)
+        ]
         for d in range(D):
             sel = np.nonzero(owner0 == d)[0]
-            if len(sel):
+            if len(sel) and host_sets[d] is not None:
                 host_sets[d].insert(_u64(hi0[sel], lo0[sel]))
         vcap = 64  # device placeholders; the device never holds the set
         vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
@@ -377,7 +392,8 @@ def check_sharded(
     ckpt_path = None
     inv_names = ",".join(sorted(i.name for i in model.invariants))
     ckpt_ident = (
-        f"{model.name}|lanes={spec.num_lanes}|D={D}|backend={visited_backend}|"
+        f"{model.name}|lanes={spec.num_lanes}|D={D}|"
+        f"P={jax.process_count()}|backend={visited_backend}|"
         f"inv={inv_names}|dl={check_deadlock}|"
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
     )
@@ -398,9 +414,29 @@ def check_sharded(
             if host_sets is not None:
                 from ..native import FpSet
 
-                fps_flat, at = snap["host_fps"], 0
+                if is_multiprocess():
+                    # per-host part file written by this same process rank
+                    part = load_validated_snapshot(
+                        f"{ckpt_path}.host{my_proc}", ckpt_ident
+                    )
+                    if int(part["depth"]) != int(snap["depth"]):
+                        raise ValueError(
+                            f"torn checkpoint: host part {my_proc} is at "
+                            f"level {int(part['depth'])} but the main "
+                            f"checkpoint is at level {int(snap['depth'])} "
+                            f"(crash mid-checkpoint?) — refusing to resume; "
+                            f"delete {checkpoint_dir} and restart"
+                        )
+                    fps_flat, lens = part["host_fps"], part["host_lens"]
+                else:
+                    fps_flat, lens = snap["host_fps"], snap["host_lens"]
+                at = 0
                 host_sets = []
-                for ln in snap["host_lens"]:
+                for d, ln in enumerate(lens):
+                    if shard_proc[d] != my_proc:
+                        host_sets.append(None)
+                        at += int(ln)
+                        continue
                     s = FpSet(initial_capacity=max(64, 2 * int(ln)))
                     s.insert(fps_flat[at : at + int(ln)])
                     at += int(ln)
@@ -423,13 +459,35 @@ def check_sharded(
 
     def _save_checkpoint():
         if host_sets is not None:
-            dumps = [s.dump() for s in host_sets]
-            extra = {
-                "host_fps": np.concatenate(dumps)
-                if dumps
-                else np.empty(0, np.uint64),
-                "host_lens": np.asarray([len(x) for x in dumps]),
-            }
+            dumps = [
+                s.dump() if s is not None else np.empty(0, np.uint64)
+                for s in host_sets
+            ]
+            if is_multiprocess():
+                # per-host ownership: each process persists its own shards
+                # in a sidecar part file; resume is symmetric (same mesh
+                # layout is enforced by ckpt_ident's D and P stamps).  The
+                # part carries the level it snapshots: a crash between the
+                # part writes and the coordinator's main write would leave
+                # parts one level ahead of (or behind) the main file, and
+                # resuming such a torn pair would silently skip the
+                # re-expanded frontier's subtrees — the depth cross-check
+                # on load refuses it instead.
+                atomic_savez(
+                    f"{ckpt_path}.host{my_proc}",
+                    ident=ckpt_ident,
+                    depth=depth,
+                    host_fps=np.concatenate(dumps),
+                    host_lens=np.asarray([len(x) for x in dumps]),
+                )
+                extra = {}
+            else:
+                extra = {
+                    "host_fps": np.concatenate(dumps)
+                    if dumps
+                    else np.empty(0, np.uint64),
+                    "host_lens": np.asarray([len(x) for x in dumps]),
+                }
         else:
             # trim the common sentinel tail (rebuilt on resume from vcap)
             vn_np = fetch_global(dev_vn)
@@ -621,6 +679,18 @@ def check_sharded(
             if host_sets is not None and cmax:
                 hi3 = fetch_global(out_hi.reshape(D, M_per)[:, :cmax])
                 lo3 = fetch_global(out_lo.reshape(D, M_per)[:, :cmax])
+                # global dedup: each shard's OWNER process inserts into its
+                # FpSet (batch dedup already happened on device; insert()
+                # returns the first-time mask); the masks are OR-merged so
+                # every process sees the identical novelty decision
+                masks = np.zeros((D, cmax), bool)
+                for d in range(D):
+                    c = int(counts[d])
+                    if c and host_sets[d] is not None:
+                        masks[d, :c] = host_sets[d].insert(
+                            _u64(hi3[d, :c], lo3[d, :c])
+                        ).astype(bool)
+                masks = or_across_processes(masks)
             newc = np.zeros(D, np.int64)
             for d in range(D):
                 c = int(counts[d])
@@ -630,10 +700,7 @@ def check_sharded(
                 p = parent_np[d, :c].astype(np.int64) if store_trace else None
                 a = act_np[d, :c].astype(np.int64) if store_trace else None
                 if host_sets is not None:
-                    # global dedup via this shard's own FpSet (batch dedup
-                    # already happened on device; insert() returns the mask
-                    # of first-time fingerprints)
-                    mask = host_sets[d].insert(_u64(hi3[d, :c], lo3[d, :c]))
+                    mask = masks[d, :c]
                     rows = rows[mask]
                     if store_trace:
                         p, a = p[mask], a[mask]
@@ -756,7 +823,11 @@ def check_sharded(
             "visited_backend": visited_backend,
             "exchange": exchange,
             **(
-                {"host_fpset_sizes": [len(s) for s in host_sets]}
+                {
+                    "host_fpset_sizes": [
+                        len(s) if s is not None else None for s in host_sets
+                    ]
+                }
                 if host_sets is not None
                 else {}
             ),

@@ -1,0 +1,139 @@
+"""Real multi-process (DCN-regime) execution of the sharded engine.
+
+Two OS processes join one jax.distributed job on localhost (the same
+`jax.distributed.initialize` path a TPU pod uses, with the coordinator on
+127.0.0.1 and 2 virtual CPU devices per process -> a 4-device global
+mesh).  Both processes run the identical replicated host loop
+(parallel/multihost.py) and must agree on exact distinct-state counts —
+through BOTH visited backends:
+
+- device: per-shard sorted sets in (virtual) device memory;
+- host: per-HOST FpSet ownership — each process keeps C++ sets only for
+  the shards whose devices it hosts, and the novelty masks are OR-merged
+  across processes (multihost.or_across_processes).
+
+This is the test VERDICT r2 item 5 asked for: nothing about the
+multi-host path executes only in the degenerate single-process regime
+anymore.  Slow marker: two fresh interpreters each pay their own XLA
+compile chain (~1 min here).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import json, sys
+from kafka_specification_tpu.utils.platform_guard import pin_cpu_in_process
+pin_cpu_in_process()
+import jax
+jax.config.update(
+    "jax_compilation_cache_dir", sys.argv[3],
+)
+from kafka_specification_tpu.parallel.multihost import init_distributed
+info = init_distributed()
+from kafka_specification_tpu.models import finite_replicated_log as frl
+from kafka_specification_tpu.parallel.sharded import check_sharded
+model = frl.make_model(3, 4, int(sys.argv[2]))
+res = check_sharded(model, min_bucket=64, store_trace=False,
+                    visited_backend=sys.argv[1])
+print("RESULT " + json.dumps({
+    "pid": info["process_id"], "procs": info["process_count"],
+    "devices": info["global_devices"], "total": res.total,
+    "levels": res.levels, "ok": res.ok,
+    "host_sizes": res.stats.get("host_fpset_sizes"),
+}))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_two_process(visited_backend: str, max_records: int):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    _WORKER,
+                    visited_backend,
+                    str(max_records),
+                    os.path.join(_REPO, ".jax_cache"),
+                ],
+                env=env,
+                cwd=_REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert line, f"no RESULT line:\n{out[-1000:]}\n{err[-2000:]}"
+        outs.append(json.loads(line[-1][len("RESULT "):]))
+    return outs
+
+
+def test_two_process_device_backend_exact_counts():
+    """FRL (3,4,1) = 125 states: both processes of a 2-process / 4-device
+    job report the identical exhaustive result."""
+    outs = _run_two_process("device", 1)
+    for o in outs:
+        assert o["procs"] == 2 and o["devices"] == 4
+        assert o["ok"] and o["total"] == 125
+    assert outs[0]["levels"] == outs[1]["levels"]
+    assert {o["pid"] for o in outs} == {0, 1}
+
+
+def test_two_process_host_fpset_per_host_ownership():
+    """FRL (3,4,2) = 29,791 states through the per-host-owned C++ FpSets:
+    exact global count on both processes, and each process holds sets ONLY
+    for its own 2 of the 4 shards (the other entries are None) — inserts
+    are no longer replicated per process."""
+    outs = _run_two_process("host", 2)
+    for o in outs:
+        assert o["ok"] and o["total"] == 29791
+        sizes = o["host_sizes"]
+        assert len(sizes) == 4
+        owned = [s for s in sizes if s is not None]
+        assert len(owned) == 2  # 2 local devices -> 2 owned shards
+    # the two processes own disjoint shard halves and together cover all
+    # 29,791 fingerprints exactly once
+    merged = [
+        a if a is not None else b
+        for a, b in zip(outs[0]["host_sizes"], outs[1]["host_sizes"])
+    ]
+    assert sum(merged) == 29791
+    assert all(
+        (a is None) != (b is None)
+        for a, b in zip(outs[0]["host_sizes"], outs[1]["host_sizes"])
+    )
