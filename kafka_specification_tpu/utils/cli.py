@@ -269,6 +269,12 @@ def main(argv=None):
     pv.add_argument("cfg")
     pv.add_argument("--module")
     pv.add_argument("--reference", default="/root/reference")
+    pv.add_argument(
+        "--emitted",
+        action="store_true",
+        help="validate the mechanically emitted model's action inventory "
+        "(its `Name~k` DNF branches map back to their source disjunct)",
+    )
 
     args = p.parse_args(argv)
     from pathlib import Path
@@ -301,6 +307,12 @@ def main(argv=None):
             _mark_platform_ready()
 
     if args.cmd == "validate":
+        # structural validation never needs an accelerator, but building
+        # the emitted model initializes a backend — keep it off a possibly
+        # wedged tunnel
+        from .platform_guard import pin_cpu_in_process
+
+        pin_cpu_in_process()
         from .tla_frontend import validate_cfg_constants, validate_model
 
         problems = validate_cfg_constants(tlc_cfg, args.reference, module)
@@ -308,15 +320,16 @@ def main(argv=None):
         # authored product-space constant with no reference counterpart,
         # and the combinator renames actions to p<k>.<Name>
         tlc_cfg.constants.pop("Partitions", None)
-        model = _build_or_fail(module, tlc_cfg)
+        model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
         problems += validate_model(model, args.reference, module)
         if problems:
             for pr in problems:
                 print(f"MISMATCH: {pr}")
             return 1
+        kind = "emitted DNF branches" if args.emitted else "actions"
         print(
-            f"{module}: constants assigned; {len(model.actions)} actions "
-            f"match the reference Next disjuncts exactly."
+            f"{module}: constants assigned; {len(model.actions)} {kind} "
+            f"cover the reference Next disjuncts exactly."
         )
         return 0
 

@@ -263,10 +263,14 @@ def validate_model(model, ref_dir, module: str) -> list[str]:
     """Cross-check a tensor model's actions against the reference module's
     Next disjuncts.  Returns a list of discrepancy strings (empty = clean).
 
-    The model's action names must be exactly the reference Next disjuncts
-    (order preserved is not required by TLC semantics and not enforced);
-    every disjunct must resolve to a definition somewhere in the EXTENDS
-    chain.
+    The model's action names must cover exactly the reference Next
+    disjuncts (order preserved is not required by TLC semantics and not
+    enforced); every disjunct must resolve to a definition somewhere in
+    the EXTENDS chain.  Mechanically emitted models split a disjunct's
+    top-level nondeterminism into DNF branches named `Name~k`
+    (utils/tla_emit); each branch maps back to its source disjunct, so
+    both the hand and the emitted action inventories validate against the
+    same reference Next.
     """
     chain = load_chain(ref_dir, module)
     if module not in chain:
@@ -277,10 +281,11 @@ def validate_model(model, ref_dir, module: str) -> list[str]:
     for d in disjuncts:
         if d not in names:
             problems.append(f"Next disjunct {d} has no definition in the chain")
-    model_actions = [a.name for a in model.actions]
-    if sorted(model_actions) != sorted(disjuncts):
-        missing = set(disjuncts) - set(model_actions)
-        extra = set(model_actions) - set(disjuncts)
+    # `Name~k` DNF branches -> source disjunct `Name`
+    model_actions = {a.name.split("~")[0] for a in model.actions}
+    if model_actions != set(disjuncts):
+        missing = set(disjuncts) - model_actions
+        extra = model_actions - set(disjuncts)
         if missing:
             problems.append(f"model lacks reference actions: {sorted(missing)}")
         if extra:

@@ -1,0 +1,87 @@
+"""Closed-form product-space validation run (VERDICT r2 item 4).
+
+Kip320 TINY (2 brokers, L=2, R=1, E=1) has exactly 277 reachable states
+(oracle-pinned).  Three independent partitions interleaved
+(models/product.py) must reach exactly 277^3 = 21,253,933 distinct states —
+a golden count for the product combinator, the host-FpSet spill path and
+the |base|^K claim (BASELINE.json stretch definition) at a scale this box
+reaches in minutes.  Appends the result to RESULTS.md by hand afterwards.
+
+Usage:  python scripts/run_product_tiny3.py [--partitions K]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from kafka_specification_tpu.utils.platform_guard import pin_cpu_in_process  # noqa: E402
+
+pin_cpu_in_process()
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+
+from kafka_specification_tpu.engine import check  # noqa: E402
+from kafka_specification_tpu.models import kip320  # noqa: E402
+from kafka_specification_tpu.models.kafka_replication import Config  # noqa: E402
+from kafka_specification_tpu.models.product import product_model  # noqa: E402
+from kafka_specification_tpu.oracle.interp import oracle_bfs  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--partitions", type=int, default=3)
+    ap.add_argument("--chunk-size", type=int, default=131072)
+    args = ap.parse_args()
+
+    tiny = Config(2, 2, 1, 1)
+    base_total = oracle_bfs(kip320.make_oracle(tiny), keep_level_sets=False).total
+    print(f"# base Kip320 TINY: {base_total} states (oracle)", flush=True)
+
+    model = product_model(kip320.make_model(tiny), args.partitions)
+    golden = base_total ** args.partitions
+    print(
+        f"# product^{args.partitions}: expect {golden:,} distinct states; "
+        f"fanout={model.total_fanout}, lanes={model.spec.num_lanes}",
+        flush=True,
+    )
+
+    t0 = time.perf_counter()
+    res = check(
+        model,
+        store_trace=False,
+        visited_backend="host",
+        chunk_size=args.chunk_size,
+        min_bucket=4096,
+        progress=lambda d, n, t: print(
+            f"#   level {d}: +{n:,} -> {t:,} ({time.perf_counter()-t0:.0f}s)",
+            flush=True,
+        ),
+    )
+    print(
+        json.dumps(
+            {
+                "workload": f"Kip320 TINY ^{args.partitions} product exhaustive",
+                "distinct_states": res.total,
+                "expected": golden,
+                "match": res.total == golden,
+                "ok": res.ok,
+                "diameter": res.diameter,
+                "seconds": round(res.seconds, 1),
+                "states_per_sec": round(res.states_per_sec, 1),
+            }
+        ),
+        flush=True,
+    )
+    assert res.ok
+    assert res.total == golden, (res.total, golden)
+
+
+if __name__ == "__main__":
+    main()

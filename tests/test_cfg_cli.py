@@ -89,3 +89,13 @@ def test_stretch_config_builds_product_model():
     assert model.spec.num_lanes >= 3 * 9 // 2  # 3 partitions of 5-broker state
     res = check(model, max_states=700, max_depth=2, store_trace=False, min_bucket=64)
     assert res.levels[:3] == [1, 30, 570]  # 3 partitions x 10 controller moves, etc.
+
+
+def test_validate_emitted_covers_reference_next():
+    """`validate --emitted`: the mechanically emitted model's `Name~k` DNF
+    branches map back to their source disjuncts and cover the reference
+    Next exactly (VERDICT r2 item 7 — the two halves of the fidelity story
+    compose).  One module here (emission is ~20s/module); all six L4
+    configs are exercised by the CLI run recorded in RESULTS.md."""
+    rc = cli_main(["validate", "configs/Kip320.cfg", "--emitted"])
+    assert rc == 0
