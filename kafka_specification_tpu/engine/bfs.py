@@ -87,7 +87,18 @@ class _Step:
             [np.full(a.n_choices, i, np.int32) for i, a in enumerate(model.actions)]
         )
         self.act_ids = jnp.asarray(act_ids)
-        self._cache = {}
+        # jitted-step cache shared across check() calls on the same Model:
+        # re-tracing is the dominant cost for models with large emitted
+        # expression trees (utils/tla_emit: seconds per shape), and the
+        # traced steps are pure functions of (model, shape key)
+        cache = getattr(model, "_step_cache", None)
+        if cache is None:
+            cache = {}
+            try:
+                model._step_cache = cache
+            except AttributeError:
+                pass  # exotic model object without attribute support
+        self._cache = cache
 
     def expand_width(self, bucket: int, shift: int) -> int:
         """Candidate rows produced by make_expand(bucket, shift)."""
@@ -217,7 +228,10 @@ class _Step:
         with_merge: bool = True,
         compact: Optional[int] = None,
     ):
-        key = (bucket, vcap, with_invariants, with_merge, compact)
+        # use_pallas is in the key because the cache outlives this _Step
+        # (it is shared per Model) and KSPEC_USE_PALLAS can toggle between
+        # check() calls (scripts/tpu_window.py does exactly that)
+        key = (bucket, vcap, with_invariants, with_merge, compact, self.use_pallas)
         if key not in self._cache:
             self._cache[key] = jax.jit(
                 self.build_raw(bucket, vcap, with_invariants, with_merge, compact)

@@ -624,8 +624,56 @@ class Emitter:
         self.defs = defs  # name -> (params, ast)
         self.consts = consts  # name -> IVal | set value
         self.var_schemas = var_schemas  # TLA variable -> schema
+        self._memo = None  # trace-local CSE cache (see memo_scope)
+
+    def memo_scope(self):
+        """Context manager enabling common-subexpression caching of eval.
+
+        Within one kernel trace, guards and updates re-evaluate the same
+        state reads and operator applications many times; each re-eval
+        re-traces its whole jnp op tree (~1ms/op of tracing overhead and a
+        bigger compiled program).  The memo keys on (AST node identity,
+        identity of every env binding), so it is exact: a different bound
+        value or a different state dict misses.  Scoped per trace because
+        cached values hold that trace's tracers — they must not leak into
+        another trace.
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            old = self._memo
+            old_pins = getattr(self, "_memo_pins", None)
+            self._memo = {}
+            self._memo_pins = []
+            try:
+                yield
+            finally:
+                self._memo = old
+                self._memo_pins = old_pins
+
+        return scope()
 
     def eval(self, ast, env: dict):
+        memo = self._memo
+        if memo is None:
+            return self._eval(ast, env)
+        key = (
+            id(ast),
+            tuple(sorted((k, id(v)) for k, v in env.items())),
+        )
+        hit = memo.get(key, memo)
+        if hit is not memo:
+            return hit
+        out = self._eval(ast, env)
+        memo[key] = out
+        # pin the keyed env values for the scope's lifetime: the key uses
+        # id()s, and a GC'd binding's address could be recycled by a fresh
+        # object, turning a distinct env into a false cache hit
+        self._memo_pins.append(tuple(env.values()))
+        return out
+
+    def _eval(self, ast, env: dict):
         ev = self.eval
         if isinstance(ast, E.Num):
             return IVal.of(ast.v)
@@ -1243,30 +1291,106 @@ def load_defs(ref_dir, module: str) -> dict:
 
 
 # ------------------------------------------------------------ model builder
-def _domain_space(emitter: Emitter, binds, spec):
-    """Static choice decomposition for the bind list.
+def _names_in(ast) -> set:
+    """All Name ids appearing anywhere in `ast`.
 
-    Each existential bind becomes one mixed-radix choice digit whose radix is
+    Used as a (sound) over-approximation of the free variables: after
+    inline() every inner binder is α-renamed fresh, so a bind var's name
+    can never be shadowed inside the expression — any occurrence is a
+    genuine reference."""
+    out = set()
+
+    def walk(v):
+        if isinstance(v, E.Name):
+            out.add(v.id)
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                walk(x)
+        elif hasattr(v, "__dataclass_fields__"):
+            for f in v.__dataclass_fields__:
+                walk(getattr(v, f))
+
+    walk(ast)
+    return out
+
+
+def _split_forced(binds, guards):
+    """Forced-existential elimination (the hand kernels' key trick,
+    SURVEY.md §2.3 "forced ∃").
+
+    A bind var pinned by a top-level guard `var = expr` (or `expr = var`),
+    where expr references neither the var itself nor any later bind var,
+    needs no choice digit: its value is computed from the state at kernel
+    time and only membership in its declared domain is checked.  This is
+    what keeps e.g. LeaderWrite at N choices instead of N·R·(L+1)
+    (KafkaReplication.tla:202-207: `RecordSeq!NextId(id)` pins id = nextId
+    and `Append` pins offset = endOffset) and FencedFollowerFetch at N²
+    instead of N²·(L+1)·R·E (ReplicateTo's offset/record, Kip320.tla:49-56
+    + FiniteReplicatedLog.tla:111-113).
+
+    Returns (entries, remaining_guards) with entries preserving bind order:
+    ("choice", var, dom_ast) | ("forced", var, dom_ast, expr_ast); the
+    consumed equality conjuncts are dropped from the guards.
+    """
+    entries = []
+    remaining = list(guards)
+    for i, (var, dom_ast) in enumerate(binds):
+        later = {v for v, _ in binds[i + 1 :]}
+        pick = None
+        for g in remaining:
+            if isinstance(g, E.Binop) and g.op == "=":
+                for side, other in ((g.a, g.b), (g.b, g.a)):
+                    if isinstance(side, E.Name) and side.id == var:
+                        names = _names_in(other)
+                        if var not in names and not (names & later):
+                            pick = (g, other)
+                            break
+                if pick:
+                    break
+        if pick:
+            remaining.remove(pick[0])
+            entries.append(("forced", var, dom_ast, pick[1]))
+        else:
+            entries.append(("choice", var, dom_ast, None))
+    return entries, remaining
+
+
+def _domain_space(emitter: Emitter, entries, spec):
+    """Static choice decomposition for the bind list (post _split_forced).
+
+    Each "choice" entry becomes one mixed-radix choice digit whose radix is
     the domain's static hull size (state-independent by construction: ranges
     unroll to schema-bound hulls, ISR bitsets to their universe, the keyed
-    request set to its slot count).  Returns (sizes, mapper) where
-    mapper(choice_digits, env) -> ({var: value}, enabled_guard): the guard
-    masks hull slots not actually in the (state-dependent) domain — TLC's
-    "branch on every witness, most disabled" semantics, vectorized.
+    request set to its slot count); each "forced" entry is evaluated
+    directly and guard-checked for domain membership.  Returns
+    (sizes, mapper) where mapper(choice_digits, env) ->
+    ({var: value}, enabled_guard): the guard masks hull slots not actually
+    in the (state-dependent) domain — TLC's "branch on every witness, most
+    disabled" semantics, vectorized.
     """
     dummy_state = {f.name: np.zeros(f.shape, np.int32) for f in spec.fields}
 
     sizes = []
-    for i, (var, dom_ast) in enumerate(binds):
+    for i, (kind, var, dom_ast, _x) in enumerate(entries):
+        if kind != "choice":
+            continue
         env = {"__state__": dummy_state}
-        for v, _d in binds[:i]:
+        for _k, v, _d, _e in entries[:i]:
             env[v] = IVal(0, 0, 0)
         sizes.append(len(_set_iter_static(emitter.eval(dom_ast, env))))
 
     def mapper(digits, env):
         vals = {}
         guard = jnp.bool_(True)
-        for (var, dom_ast), d, n in zip(binds, digits, sizes):
+        digit_iter = iter(zip(digits, sizes))
+        for kind, var, dom_ast, expr_ast in entries:
+            if kind == "forced":
+                val = emitter.eval(expr_ast, {**env, **vals})
+                s = emitter.eval(dom_ast, {**env, **vals})
+                vals[var] = val
+                guard = guard & _as_bool(_value_in_type(val, s))
+                continue
+            d, n = next(digit_iter)
             s = emitter.eval(dom_ast, {**env, **vals})
             # fast paths: direct indexing instead of a select chain
             if isinstance(s, SetRange) and s.lo.lo == s.lo.hi and s.hi.lo == s.hi.hi:
@@ -1336,28 +1460,31 @@ def build_model(
     actions_ir = extract_actions(mod, defs, keep)
 
     def make_kernel(air: ActionIR):
-        sizes, mapper = _domain_space(emitter, air.binds, spec)
+        entries, rem_guards = _split_forced(air.binds, air.guards)
+        sizes, mapper = _domain_space(emitter, entries, spec)
         n_choices = int(np.prod(sizes)) if sizes else 1
 
         def kernel(state, choice):
-            env = {"__state__": state}
-            digits = []
-            c = choice
-            for n in reversed(sizes):
-                digits.append(IVal(c % n, 0, n - 1))
-                c = c // n
-            digits.reverse()
-            vals, ok = mapper(digits, env)
-            env.update(vals)
-            for g in air.guards:
-                ok = ok & _as_bool(emitter.eval(g, env))
-            new_state = dict(state)
-            for var, rhs in air.updates.items():
-                val = emitter.eval(rhs, env)
-                _materialize(var_schemas[var], val, new_state, ())
-            # guard-failed slots keep the (arbitrary) computed tensors; the
-            # engine masks them via `ok`, but clamp indices already guarded
-            return ok, new_state
+            with emitter.memo_scope():
+                env = {"__state__": state}
+                digits = []
+                c = choice
+                for n in reversed(sizes):
+                    digits.append(IVal(c % n, 0, n - 1))
+                    c = c // n
+                digits.reverse()
+                vals, ok = mapper(digits, env)
+                env.update(vals)
+                for g in rem_guards:
+                    ok = ok & _as_bool(emitter.eval(g, env))
+                new_state = dict(state)
+                for var, rhs in air.updates.items():
+                    val = emitter.eval(rhs, env)
+                    _materialize(var_schemas[var], val, new_state, ())
+                # guard-failed slots keep the (arbitrary) computed tensors;
+                # the engine masks them via `ok`, but clamp indices already
+                # guarded
+                return ok, new_state
 
         return Action(air.name, n_choices, kernel)
 
@@ -1508,7 +1635,8 @@ def build_model(
         )
 
         def pred(state, body=body):
-            return _as_bool(emitter.eval(body, {"__state__": state}))
+            with emitter.memo_scope():
+                return _as_bool(emitter.eval(body, {"__state__": state}))
 
         invariants.append(Invariant(iname, pred))
 
@@ -1517,7 +1645,8 @@ def build_model(
         c_body = inline(E.parse_expr(constraint_src), defs, keep)
 
         def constraint(state, c_body=c_body):
-            return _as_bool(emitter.eval(c_body, {"__state__": state}))
+            with emitter.memo_scope():
+                return _as_bool(emitter.eval(c_body, {"__state__": state}))
 
     return Model(
         name=name or f"{mod.name}(emitted)",

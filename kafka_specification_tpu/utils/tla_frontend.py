@@ -281,13 +281,27 @@ def validate_model(model, ref_dir, module: str) -> list[str]:
     for d in disjuncts:
         if d not in names:
             problems.append(f"Next disjunct {d} has no definition in the chain")
-    # `Name~k` DNF branches -> source disjunct `Name`
-    model_actions = {a.name.split("~")[0] for a in model.actions}
-    if model_actions != set(disjuncts):
+    names_raw = [a.name for a in model.actions]
+    if any("~" in n for n in names_raw):
+        # emitted model: `Name~k` DNF branches -> source disjunct `Name`;
+        # several branches per disjunct are expected, so compare coverage
+        model_actions = {n.split("~")[0] for n in names_raw}
+        mismatch = model_actions != set(disjuncts)
+    else:
+        # hand model: exact multiset — a duplicated or missing action name
+        # is a defect even when the name set still matches
+        model_actions = set(names_raw)
+        mismatch = sorted(names_raw) != sorted(disjuncts)
+    if mismatch:
         missing = set(disjuncts) - model_actions
         extra = model_actions - set(disjuncts)
         if missing:
             problems.append(f"model lacks reference actions: {sorted(missing)}")
         if extra:
             problems.append(f"model has non-reference actions: {sorted(extra)}")
+        if not missing and not extra:
+            problems.append(
+                f"action multiset differs from Next disjuncts: "
+                f"{sorted(names_raw)} vs {sorted(disjuncts)}"
+            )
     return problems

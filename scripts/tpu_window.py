@@ -44,15 +44,30 @@ def _child():
     jax.config.update(
         "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache")
     )
-    from kafka_specification_tpu.utils.platform_guard import (
-        platform_ready_probe,
-    )
-
     record = {"started": time.time(), "stages": {}}
 
     def stage(name, t0):
         record["stages"][name] = round(time.perf_counter() - t0, 1)
         print(f"# stage {name}: {record['stages'][name]}s", flush=True)
+
+    try:
+        _run_stages(record, stage)
+    except SystemExit:
+        raise  # deliberate exits (probe-only / no-TPU) are not failures
+    except BaseException as e:  # bank whatever the window yielded so far
+        record["failed"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _write(record)
+    print(json.dumps(record), flush=True)
+
+
+def _run_stages(record, stage):
+    import jax
+
+    from kafka_specification_tpu.utils.platform_guard import (
+        platform_ready_probe,
+    )
 
     t0 = time.perf_counter()
     platform = platform_ready_probe()
@@ -60,7 +75,6 @@ def _child():
     stage("platform_probe", t0)
     if platform == "cpu":
         print("# default platform is CPU — no TPU window", flush=True)
-        _write(record)
         raise SystemExit(4)
     if os.environ.get("KSPEC_TPU_WINDOW_PROBE"):
         print(f"# probe only: {platform} is LIVE", flush=True)
@@ -115,9 +129,6 @@ def _child():
         "states_per_sec": round(res_s.states_per_sec, 1),
     }
     stage("sharded_kip320_2r", t0)
-
-    _write(record)
-    print(json.dumps(record), flush=True)
 
 
 def _write(record):
