@@ -69,23 +69,24 @@ def _child_main():
     assert ores.total == 737_794, ores.total
 
     model = kip320.make_model(cfg)
-    # On the accelerator, run every level at one fixed chunk shape: a single
-    # compiled program for the whole run (compile time dominates there; the
-    # masked waste on small levels is nearly free), with the visited set
-    # device-resident in HBM.  On the CPU fallback, let buckets grow (dense
-    # waste is what dominates) and dedup through the native C++ FpSet — the
-    # device-side sort/probe/merge stages exist to keep the set in HBM,
-    # which on the host backend the C++ open-addressing set does better
-    # (profiled: 74% of the CPU level step was device-side dedup work the
-    # host set re-does on insert anyway).
-    res = check(
-        model,
+    # Backend: on the accelerator the open-addressing HBM hash table
+    # (ops/hashset — O(batch) dedup per level, device-resident); on the CPU
+    # fallback the native C++ host FpSet (fastest when the "device" IS the
+    # host; 3.0x and 4.9x the sorted-set backend respectively, RESULTS.md).
+    kwargs = dict(
         store_trace=False,
         min_bucket=32768 if on_accelerator else 4096,
         chunk_size=32768,
-        visited_capacity_hint=800_000 if on_accelerator else None,
-        visited_backend="device" if on_accelerator else "host",
+        visited_capacity_hint=800_000,
+        visited_backend="device-hash" if on_accelerator else "host",
     )
+    # One warmup pass populates the jit caches (tracing + XLA compiles are
+    # a one-time cost per shape — ~11s CPU, more through the TPU tunnel —
+    # amortized away in any real checking session); the measured run
+    # reports steady-state throughput.  The oracle baseline needs no
+    # warmup: CPython has no jit and its rate is flat.
+    check(model, **kwargs)
+    res = check(model, **kwargs)
     assert res.ok, res.violation
     assert res.total == 737_794, res.total  # oracle-pinned golden count
 

@@ -35,8 +35,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.base import Model
-from ..ops import dedup
+from ..ops import dedup, hashset
 from ..ops.fingerprint import fingerprint_lanes
+
+# insert-or-find on the device hash table; tables donated so XLA updates
+# them in place instead of copying O(capacity) per chunk
+_hash_insert = jax.jit(hashset.probe_insert, donate_argnums=(0, 1))
 
 
 def _next_pow2(n: int) -> int:
@@ -517,13 +521,21 @@ def check(
     wall ms) — the PROGRESS.jsonl observability stream (SURVEY.md §5); the
     same records land in CheckResult.stats["levels"].
 
-    visited_backend: "device" keeps the sorted fingerprint set in HBM (fast
-    path); "host" streams each level's batch-deduped fingerprints through the
-    native C++ open-addressing FpSet (native/fpset.cpp) — the TLC-FPSet
-    spill mode for state spaces whose fingerprints outgrow device memory.
-    Device HBM then holds only O(chunk x fanout) transient data.  With
-    hashed (non-exact64) fingerprints this accepts TLC's usual 64-bit
-    collision risk.
+    visited_backend:
+    - "device": sorted fingerprint pair set in HBM — dedup by lexsort +
+      binary-search probe + rank-scatter merge.  The merge rebuilds
+      O(capacity) per chunk, which dominates at small frontiers.
+    - "device-hash": open-addressing hash table in HBM (ops/hashset) —
+      insert-or-find in O(batch · expected-probes) per chunk, independent
+      of table size; no sort, no merge.  The recommended device-resident
+      backend.
+    - "host": the native C++ open-addressing FpSet (native/fpset.cpp) does
+      ALL dedup on the host — the TLC-FPSet spill mode for state spaces
+      whose fingerprints outgrow device memory (device HBM then holds only
+      O(chunk x fanout) transient data), and the fastest mode on a CPU
+      "device".
+    With hashed (non-exact64) fingerprints all backends accept TLC's usual
+    64-bit collision risk; all three produce identical counts and traces.
 
     chunk_size: frontiers larger than this stream through the compiled step
     in pieces (cross-chunk dedup via the shared visited set), bounding the
@@ -570,9 +582,14 @@ def check(
     init_packed = np.unique(init_packed, axis=0)
     n0 = init_packed.shape[0]
 
-    if visited_backend not in ("device", "host"):
-        raise ValueError(f"visited_backend must be 'device' or 'host', got {visited_backend!r}")
+    if visited_backend not in ("device", "host", "device-hash"):
+        raise ValueError(
+            "visited_backend must be 'device', 'device-hash' or 'host', "
+            f"got {visited_backend!r}"
+        )
     host_set = None
+    ht_hi = ht_lo = None  # device-hash table (ops/hashset)
+    hash_n = 0
 
     def _u64(hi, lo):
         return (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
@@ -587,6 +604,20 @@ def check(
         host_set = FpSet()
         host_set.insert(_u64(hi0, lo0))
         vcap = 64  # placeholder shapes; the device never holds the visited set
+        vhi = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
+        vlo = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
+        vn = jnp.int32(0)
+    elif visited_backend == "device-hash":
+        hcap = _next_pow2(
+            max(4 * n0, 1 << 16, 4 * (visited_capacity_hint or 0))
+        )
+        ht_hi, ht_lo = hashset.new_table(hcap)
+        ht_hi, ht_lo, _m, nn0, ovf0 = hashset.probe_insert(
+            ht_hi, ht_lo, hi0, lo0, jnp.ones(hi0.shape[0], bool)
+        )
+        assert not bool(ovf0) and int(nn0) == n0
+        hash_n = n0
+        vcap = 64  # placeholder shapes for the step signature
         vhi = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
         vlo = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
         vn = jnp.int32(0)
@@ -669,6 +700,18 @@ def check(
 
                 host_set = FpSet(initial_capacity=max(64, 2 * len(snap["host_fps"])))
                 host_set.insert(snap["host_fps"])
+            elif ht_hi is not None:
+                live_hi = snap["hash_hi"]
+                live_lo = snap["hash_lo"]
+                hash_n = live_hi.shape[0]
+                ht_hi, ht_lo = hashset.new_table(_next_pow2(max(4 * hash_n, 1 << 16)))
+                for s0 in range(0, hash_n, 1 << 20):
+                    h = jnp.asarray(live_hi[s0 : s0 + (1 << 20)])
+                    lo = jnp.asarray(live_lo[s0 : s0 + (1 << 20)])
+                    ht_hi, ht_lo, _m, _n2, ovf = hashset.probe_insert(
+                        ht_hi, ht_lo, h, lo, jnp.ones(h.shape[0], bool)
+                    )
+                    assert not bool(ovf)
             else:
                 vcap = int(snap["vcap"])
                 n = int(snap["vn"])
@@ -685,15 +728,19 @@ def check(
         # padding is rebuilt on resume from vcap/vn); uncompressed — live
         # fingerprints are high-entropy and zlib only burns time
         n = int(vn)
-        extra = (
-            {"host_fps": host_set.dump()}
-            if host_set is not None
-            else {
+        if host_set is not None:
+            extra = {"host_fps": host_set.dump()}
+        elif ht_hi is not None:
+            th = np.asarray(ht_hi)
+            tl = np.asarray(ht_lo)
+            live = ~((th == hashset.SENT) & (tl == hashset.SENT))
+            extra = {"hash_hi": th[live], "hash_lo": tl[live]}
+        else:
+            extra = {
                 "vhi": np.asarray(vhi[:n]),
                 "vlo": np.asarray(vlo[:n]),
                 "vn": n,
             }
-        )
         atomic_savez(
             ckpt_path,
             ident=ckpt_ident,
@@ -728,14 +775,26 @@ def check(
             fp_n = piece.shape[0]
             bucket = _next_pow2(max(fp_n, min_bucket))
             M = bucket * C
-            if host_set is None:
+            if visited_backend == "device":
                 need = int(vn) + M
                 if need > vcap:
                     new_cap = _next_pow2(need)
                     pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
                     vhi = jnp.concatenate([vhi, pad])
                     vlo = jnp.concatenate([vlo, pad])
+                    # growth is monotonic: steps compiled for the outgrown
+                    # capacity are dead weight in the Model-lifetime cache
+                    # (each is a full compiled program) — evict them
+                    for k in [
+                        k for k in step_builder._cache if k[1] == vcap
+                    ]:
+                        del step_builder._cache[k]
                     vcap = new_cap
+            elif ht_hi is not None and 2 * hash_n > ht_hi.shape[0]:
+                # keep load factor under ~1/2 so linear probing stays short
+                ht_hi, ht_lo = hashset.rehash_into(
+                    ht_hi, ht_lo, 2 * ht_hi.shape[0]
+                )
             # Candidate compaction: expand/pack/sort/probe/merge at the
             # enabled width (a few % of M) instead of the padded-lattice
             # width.  On overflow (an action enabled more pairs than its
@@ -751,7 +810,7 @@ def check(
                     bucket,
                     vcap,
                     check_invariants,
-                    with_merge=host_set is None,
+                    with_merge=visited_backend == "device",
                     compact=sh or None,
                 )
                 (
@@ -799,6 +858,30 @@ def check(
                     _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
                 )
                 lvl_rows.append(rows[mask])
+                lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
+                lvl_act.append(np.asarray(out_act[:nn])[mask])
+                lvl_new += int(mask.sum())
+            elif ht_hi is not None and nn:
+                # device-hash backend: insert-or-find on the HBM table; a
+                # probe-budget overflow grows the table and re-runs the
+                # SAME batch, OR-accumulating novelty (rows inserted by the
+                # failed attempt report "seen" on the re-run, so nothing is
+                # double-counted or lost)
+                valid = jnp.arange(out_hi.shape[0]) < new_n
+                isnew = np.zeros(out_hi.shape[0], bool)
+                while True:
+                    ht_hi, ht_lo, m, _ni, ovf = _hash_insert(
+                        ht_hi, ht_lo, out_hi, out_lo, valid
+                    )
+                    isnew |= np.asarray(m)
+                    if not bool(ovf):
+                        break
+                    ht_hi, ht_lo = hashset.rehash_into(
+                        ht_hi, ht_lo, 2 * ht_hi.shape[0]
+                    )
+                mask = isnew[:nn]
+                hash_n += int(mask.sum())
+                lvl_rows.append(np.asarray(out[:nn])[mask])
                 lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
                 lvl_act.append(np.asarray(out_act[:nn])[mask])
                 lvl_new += int(mask.sum())
@@ -897,6 +980,9 @@ def check(
     )
     if host_set is not None:
         result_stats["host_fpset_size"] = len(host_set)
+    if ht_hi is not None:
+        result_stats["hash_table_capacity"] = int(ht_hi.shape[0])
+        result_stats["hash_table_size"] = hash_n
     return CheckResult(
         model=model.name,
         levels=levels,

@@ -1,0 +1,145 @@
+"""Device-resident open-addressing fingerprint set (the HBM FPSet).
+
+The sorted-pair visited set (ops/dedup.py) pays O(capacity) per level to
+scatter-merge new fingerprints into sorted order — profiled at 74% of the
+whole level step on the flagship bench (engine/bfs.py notes).  This module
+replaces sort + binary-search probe + rank-merge with one structure and one
+kernel: a power-of-two hash table of (hi, lo) uint32 pairs in device
+memory, probed and claimed with fixed-trip-count linear probing — O(batch)
+per level, independent of table size, with all-deterministic tie-breaks
+(scatter-min claims), so BFS discovery order and counterexample traces stay
+reproducible.
+
+Duplicate handling inside one batch needs no pre-sort: rows carrying the
+same fingerprint land on the same probe slot; the claim scatter-min picks
+the lowest row index as the winner, the losers observe the winner's
+fingerprint on re-read and report "seen".
+
+Insertion is insert-or-find: after `probe_insert`, `is_new[i]` is True for
+exactly one row per distinct fingerprint not already in the table.  The
+caller must re-run with a grown table when `overflow` is set (a row
+exhausted its probe budget) — with load kept under ~0.5 the expected probe
+count is ~1.5 and P=32 budgets are astronomically safe, but correctness
+never depends on that: overflow is detected, never silently dropped.
+
+TPU notes: fingerprints ride as two uint32 lanes (no 64-bit int ALU); the
+probe loop is a `lax.fori_loop` with static trip count; gathers/scatters
+are the only memory ops and vectorize over the batch.  Sharded engines give
+each shard its own table over its owned fingerprint range.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# empty-slot sentinel: the all-ones pair never occurs as a fingerprint
+# (ops/fingerprint.hash_pair remaps it; exact64 packs stay within schema
+# bounds, and engine padding is masked before reaching the table)
+SENT = 0xFFFFFFFF
+
+
+def _fmix32(h):
+    """murmur3 finalizer: full 32-bit avalanche."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def new_table(cap: int):
+    """Empty table of `cap` slots (cap must be a power of two)."""
+    assert cap & (cap - 1) == 0, "hash table capacity must be a power of 2"
+    return (
+        jnp.full((cap,), SENT, jnp.uint32),
+        jnp.full((cap,), SENT, jnp.uint32),
+    )
+
+
+def probe_insert(t_hi, t_lo, q_hi, q_lo, valid, max_probes: int = 32):
+    """Insert-or-find a batch of fingerprints.
+
+    t_hi/t_lo: uint32[cap] table (cap power of two).
+    q_hi/q_lo: uint32[M] batch; `valid` masks live rows.
+    Returns (t_hi', t_lo', is_new[M], n_new, overflow).
+
+    Per probe round, every still-pending row:
+      1. reads its current slot;
+      2. on fingerprint match -> seen (done, not new);
+      3. on empty slot -> claims it via scatter-min of the row index, the
+         winner writes its pair and is new; losers (in-batch duplicates or
+         colliding strangers) re-read and either match (dup, done) or move
+         to the next slot;
+      4. on a foreign occupant -> moves to the next slot.
+    """
+    cap = t_hi.shape[0]
+    M = q_hi.shape[0]
+    mask = jnp.uint32(cap - 1)
+    sent = jnp.uint32(SENT)
+    rows = jnp.arange(M, dtype=jnp.int32)
+    # full avalanche before slotting: exact64-mode fingerprints are raw
+    # packed states whose low bits carry almost no entropy (structured
+    # fields), and linear probing collapses under clustered home slots —
+    # murmur fmix on both lanes makes the slot uniform for either mode
+    pos0 = ((_fmix32(q_lo ^ _fmix32(q_hi)) & mask)).astype(jnp.int32)
+    # claim lattice, allocated ONCE per call and carried through the probe
+    # loop (a fresh cap-sized temp per round would cost O(cap) per level —
+    # the very thing this structure exists to avoid; as a loop carry, XLA
+    # scatters into it in place).  A slot's claim is only ever consulted in
+    # the round that writes it: an empty slot has never been claimed (every
+    # claim round installs its winner's pair immediately).
+    claim0 = jnp.full((cap,), M, jnp.int32)
+
+    def body(_, carry):
+        t_hi, t_lo, claim, pos, pending, is_new = carry
+        cur_hi = t_hi[pos]
+        cur_lo = t_lo[pos]
+        match = pending & (cur_hi == q_hi) & (cur_lo == q_lo)
+        empty = pending & (cur_hi == sent) & (cur_lo == sent)
+        # deterministic claim: lowest row index wins the slot
+        claim = claim.at[jnp.where(empty, pos, cap)].min(rows, mode="drop")
+        won = empty & (claim[pos] == rows)
+        t_hi = t_hi.at[jnp.where(won, pos, cap)].set(q_hi, mode="drop")
+        t_lo = t_lo.at[jnp.where(won, pos, cap)].set(q_lo, mode="drop")
+        # losers of the claim re-check the slot next round (it now holds
+        # the winner's pair: an in-batch duplicate will match there)
+        advance = pending & ~match & ~won & ~empty
+        pos = jnp.where(advance, (pos + 1) & (cap - 1), pos)
+        pending = pending & ~match & ~won
+        is_new = is_new | won
+        return t_hi, t_lo, claim, pos, pending, is_new
+
+    t_hi, t_lo, _claim, _pos, pending, is_new = jax.lax.fori_loop(
+        0,
+        max_probes,
+        body,
+        (t_hi, t_lo, claim0, pos0, valid, jnp.zeros((M,), bool)),
+    )
+    return t_hi, t_lo, is_new, jnp.sum(is_new, dtype=jnp.int32), jnp.any(pending)
+
+
+def rehash_into(t_hi, t_lo, new_cap: int, chunk: int = 1 << 20):
+    """Grow: re-insert every live pair into a fresh `new_cap` table.
+
+    Host-driven (runs between BFS levels, amortized O(n) per doubling);
+    streams the old table in chunks through probe_insert so peak memory is
+    old + new + one chunk.
+    """
+    import numpy as np
+
+    nh, nl = new_table(new_cap)
+    old_hi = np.asarray(t_hi)
+    old_lo = np.asarray(t_lo)
+    live = ~((old_hi == SENT) & (old_lo == SENT))
+    hi_live, lo_live = old_hi[live], old_lo[live]
+    for start in range(0, hi_live.shape[0], chunk):
+        h = jnp.asarray(hi_live[start : start + chunk])
+        lo = jnp.asarray(lo_live[start : start + chunk])
+        nh, nl, _new, _n, ovf = probe_insert(
+            nh, nl, h, lo, jnp.ones(h.shape[0], bool)
+        )
+        if bool(ovf):  # pragma: no cover - only reachable on absurd load
+            return rehash_into(t_hi, t_lo, new_cap * 2, chunk)
+    return nh, nl

@@ -42,7 +42,7 @@ from ..engine.bfs import (
     walk_trace,
 )
 from ..models.base import Model
-from ..ops import dedup
+from ..ops import dedup, hashset
 from .multihost import (
     fetch_global,
     is_coordinator,
@@ -51,6 +51,26 @@ from .multihost import (
     put_global,
 )
 from ..ops.fingerprint import fingerprint_lanes
+
+
+def _grow_hash_tables(dev_vhi, dev_vlo, new_cap: int, shard1):
+    """Rehash every shard's HBM hash table into `new_cap` slots.
+
+    Host-driven (runs between chunk attempts, amortized O(n) per
+    doubling); fetch_global/put_global keep it multi-process-correct —
+    every process computes the identical grown tables."""
+    old_hi = fetch_global(dev_vhi)  # [D, cap]
+    old_lo = fetch_global(dev_vlo)
+    D = old_hi.shape[0]
+    nh = np.empty((D, new_cap), np.uint32)
+    nl = np.empty((D, new_cap), np.uint32)
+    for d in range(D):
+        th, tl = hashset.rehash_into(
+            jnp.asarray(old_hi[d]), jnp.asarray(old_lo[d]), new_cap
+        )
+        nh[d] = np.asarray(th)
+        nl[d] = np.asarray(tl)
+    return put_global(nh, shard1), put_global(nl, shard1)
 
 
 def _norm_shift(bucket: int, shift: int) -> int:
@@ -72,6 +92,7 @@ def _make_sharded_step(
     exchange: str = "all_to_all",
     dest_w: Optional[int] = None,
     with_merge: bool = True,
+    hash_table: bool = False,
 ):
     """Jitted sharded level step.
 
@@ -166,13 +187,31 @@ def _make_sharded_step(
             r_hi = jnp.where(mine, r_hi, sent)
             r_lo = jnp.where(mine, r_lo, sent)
 
-        # minimal-payload sort over the received (owned) candidates
+        # minimal-payload sort over the received (owned) candidates: the
+        # sort both dedups the batch (first-occurrence) and fixes the
+        # shard's discovery order deterministically
         order = jnp.lexsort((r_lo, r_hi))
         hi_s, lo_s = r_hi[order], r_lo[order]
         invalid_s = (hi_s == sent) & (lo_s == sent)
         first = dedup.first_occurrence_mask(hi_s, lo_s, invalid_s)
-        seen, rank = dedup.rank_sorted(vhi, vlo, vn, hi_s, lo_s)
-        is_new = first & ~seen
+        ovf_probe = jnp.bool_(False)
+        if hash_table:
+            # per-shard HBM open-addressing table (ops/hashset): vhi/vlo
+            # carry the table slots; insert-or-find replaces both the
+            # sorted-visited probe AND the O(vcap) rank-merge.  The call
+            # is functional (no donation here): a retried chunk simply
+            # discards the attempt's returned tables, so the existing
+            # overflow discipline stays exact.
+            q_hi = jnp.where(first, hi_s, sent)
+            q_lo = jnp.where(first, lo_s, sent)
+            vhi2, vlo2, is_new, _nn, ovf_probe = hashset.probe_insert(
+                vhi, vlo, q_hi, q_lo, first
+            )
+            vn2 = vn
+            rank = jnp.zeros((R,), jnp.int32)
+        else:
+            seen, rank = dedup.rank_sorted(vhi, vlo, vn, hi_s, lo_s)
+            is_new = first & ~seen
 
         pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, R)
         out = jnp.zeros((R, K), jnp.uint32).at[pos].set(r_cand[order])
@@ -183,7 +222,9 @@ def _make_sharded_step(
         out_rank = jnp.zeros((R,), jnp.int32).at[pos].set(rank)
         new_n = jnp.sum(is_new, dtype=jnp.int32)
 
-        if with_merge:
+        if hash_table:
+            pass  # vhi2/vlo2 already hold the updated table
+        elif with_merge:
             vhi2, vlo2, vn2 = dedup.merge_ranked(
                 vhi, vlo, vn, out_hi, out_lo, out_rank, new_n, vcap
             )
@@ -220,6 +261,7 @@ def _make_sharded_step(
             act_en[None],  # [1, n_actions] -> [D, n_actions]
             ovf_expand[None],
             ovf_dest[None],
+            ovf_probe[None],  # device-hash probe-budget overflow
             out_hi,  # [R] per shard (host-FpSet backend reads these)
             out_lo,
         )
@@ -228,7 +270,7 @@ def _make_sharded_step(
         shard_body,
         mesh=mesh,
         in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
-        out_specs=tuple([P("d")] * 16),
+        out_specs=tuple([P("d")] * 17),
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -274,12 +316,16 @@ def check_sharded(
     buffer overflow is detected on device and the chunk re-runs wider.
 
     visited_backend: "device" keeps each shard's sorted fingerprint set in
-    its own HBM; "host" gives each shard its own native C++ open-addressing
-    FpSet on the host (keyed by owner — ownership routing guarantees a
-    fingerprint always lands in the same shard's set), so the distributed
-    engine can check state spaces whose fingerprints outgrow HBM — the
-    TLC-FPSet spill mode of engine.check, now at pod scale.  Device memory
-    then holds only O(chunk × fanout) transient data per shard.
+    its own HBM (lexsort + probe + O(vcap) rank-merge per chunk);
+    "device-hash" keeps each shard's set as an HBM open-addressing hash
+    table instead (ops/hashset — O(batch) insert-or-find, no merge; the
+    recommended device-resident backend); "host" gives each shard its own
+    native C++ open-addressing FpSet on the host (keyed by owner —
+    ownership routing guarantees a fingerprint always lands in the same
+    shard's set), so the distributed engine can check state spaces whose
+    fingerprints outgrow HBM — the TLC-FPSet spill mode of engine.check,
+    now at pod scale.  Device memory then holds only O(chunk × fanout)
+    transient data per shard.
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
@@ -325,9 +371,10 @@ def check_sharded(
                     0.0,
                     stats={"devices": D},
                 )
-    if visited_backend not in ("device", "host"):
+    if visited_backend not in ("device", "device-hash", "host"):
         raise ValueError(
-            f"visited_backend must be 'device' or 'host', got {visited_backend!r}"
+            f"visited_backend must be 'device', 'device-hash' or 'host', "
+            f"got {visited_backend!r}"
         )
     host_sets = None
 
@@ -361,6 +408,26 @@ def check_sharded(
         vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
         vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
         vn = np.zeros((D,), np.int32)
+    elif visited_backend == "device-hash":
+        # per-shard HBM open-addressing tables (ops/hashset), carried in
+        # the vhi/vlo slots; vn is unused (the tables track membership)
+        vcap = _next_pow2(max(1 << 14, 4 * n0))
+        vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vn = np.zeros((D,), np.int32)
+        for d in range(D):
+            sel = np.nonzero(owner0 == d)[0]
+            if len(sel):
+                th, tl, _m, nn_d, ovf = hashset.probe_insert(
+                    jnp.asarray(vhi[d]),
+                    jnp.asarray(vlo[d]),
+                    jnp.asarray(hi0[sel]),
+                    jnp.asarray(lo0[sel]),
+                    jnp.ones(len(sel), bool),
+                )
+                assert not bool(ovf) and int(nn_d) == len(sel)
+                vhi[d] = np.asarray(th)
+                vlo[d] = np.asarray(tl)
     else:
         vcap = _next_pow2(max(1024, 4 * n0))
         vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
@@ -379,6 +446,8 @@ def check_sharded(
     # the compiled-shape count and device memory stay bounded at pod scale)
     pending = [init_packed[owner0 == d] for d in range(D)]
     chunk = _next_pow2(max(32, chunk_size))
+    # per-shard distinct-state counts (device-hash growth policy + stats)
+    shard_visited = np.bincount(owner0, minlength=D).astype(np.int64)
 
     if exchange not in ("all_to_all", "all_gather"):
         raise ValueError(f"unknown exchange {exchange!r}")
@@ -441,6 +510,28 @@ def check_sharded(
                     s.insert(fps_flat[at : at + int(ln)])
                     at += int(ln)
                     host_sets.append(s)
+            elif visited_backend == "device-hash":
+                lens = snap["hash_lens"]
+                flat_hi, flat_lo = snap["hash_hi"], snap["hash_lo"]
+                shard_visited = lens.astype(np.int64)
+                vcap = _next_pow2(max(1 << 14, 4 * int(lens.max())))
+                vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+                vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+                at = 0
+                for d, ln in enumerate(lens):
+                    ln = int(ln)
+                    if ln:
+                        th, tl, _m, _n2, ovf = hashset.probe_insert(
+                            jnp.asarray(vhi[d]),
+                            jnp.asarray(vlo[d]),
+                            jnp.asarray(flat_hi[at : at + ln]),
+                            jnp.asarray(flat_lo[at : at + ln]),
+                            jnp.ones(ln, bool),
+                        )
+                        assert not bool(ovf)
+                        vhi[d] = np.asarray(th)
+                        vlo[d] = np.asarray(tl)
+                    at += ln
             else:
                 vcap = int(snap["vcap"])
                 vn = snap["vn"]
@@ -488,6 +579,17 @@ def check_sharded(
                     else np.empty(0, np.uint64),
                     "host_lens": np.asarray([len(x) for x in dumps]),
                 }
+        elif visited_backend == "device-hash":
+            # dump each shard's live pairs (slot order is rebuilt on
+            # resume by reinsertion)
+            th = fetch_global(dev_vhi)
+            tl = fetch_global(dev_vlo)
+            live = ~((th == hashset.SENT) & (tl == hashset.SENT))
+            extra = {
+                "hash_hi": th[live],
+                "hash_lo": tl[live],
+                "hash_lens": live.sum(axis=1),
+            }
         else:
             # trim the common sentinel tail (rebuilt on resume from vcap)
             vn_np = fetch_global(dev_vn)
@@ -575,7 +677,15 @@ def check_sharded(
                 T = expander.expand_width(bucket, sh)
                 W = min(T, _default_dest_w(T, D) << w_try)
                 R = D * W if exchange == "all_to_all" else D * T
-                if host_sets is None:
+                if visited_backend == "device-hash":
+                    # keep every shard's table under ~1/2 load so linear
+                    # probing stays short (shard_visited is host-tracked)
+                    if 2 * int(shard_visited.max()) > vcap:
+                        vcap = 2 * vcap
+                        dev_vhi, dev_vlo = _grow_hash_tables(
+                            dev_vhi, dev_vlo, vcap, shard1
+                        )
+                if visited_backend == "device":
                     # grow per-shard visited capacity for the worst-case merge
                     need = int(fetch_global(dev_vn).max()) + R
                     if need > vcap:
@@ -618,7 +728,8 @@ def check_sharded(
                         compact=sh or None,
                         exchange=exchange,
                         dest_w=W,
-                        with_merge=host_sets is None,
+                        with_merge=visited_backend == "device",
+                        hash_table=visited_backend == "device-hash",
                     )
                 (
                     out,
@@ -635,6 +746,7 @@ def check_sharded(
                     act_en,
                     ovf_expand,
                     ovf_dest,
+                    ovf_probe,
                     out_hi,
                     out_lo,
                 ) = steps[key](
@@ -649,6 +761,18 @@ def check_sharded(
                     continue
                 if exchange == "all_to_all" and W < T and fetch_global(ovf_dest).any():
                     w_try += 1
+                    continue
+                if visited_backend == "device-hash" and bool(
+                    fetch_global(ovf_probe).any()
+                ):
+                    # a shard exhausted its probe budget: grow every
+                    # shard's table and re-run the chunk (the attempt's
+                    # returned tables are discarded — the step is
+                    # functional, so nothing was committed)
+                    vcap *= 2
+                    dev_vhi, dev_vlo = _grow_hash_tables(
+                        dev_vhi, dev_vlo, vcap, shard1
+                    )
                     continue
                 dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
                 break
@@ -719,6 +843,7 @@ def check_sharded(
                     next_act[d].append(a)
                 newc[d] = c
             lvl_new_per_shard += newc
+            shard_visited += newc
             if stats_path is not None:
                 lvl_act_en += fetch_global(act_en).astype(np.int64).sum(axis=0)
 
@@ -829,6 +954,11 @@ def check_sharded(
                     ]
                 }
                 if host_sets is not None
+                else {}
+            ),
+            **(
+                {"shard_visited": shard_visited.tolist()}
+                if visited_backend == "device-hash"
                 else {}
             ),
         },

@@ -219,10 +219,12 @@ def main(argv=None):
     )
     pc.add_argument(
         "--visited-backend",
-        choices=["device", "host"],
+        choices=["device", "device-hash", "host"],
         default="device",
-        help="fingerprint set location: device HBM (fast) or the native "
-        "C++ host FpSet (spill mode for huge state spaces)",
+        help="fingerprint set: 'device' = sorted pair set in HBM, "
+        "'device-hash' = open-addressing hash table in HBM (O(batch) per "
+        "level instead of O(capacity) — ops/hashset), 'host' = the native "
+        "C++ FpSet (spill mode for huge state spaces)",
     )
     pc.add_argument(
         "--profile",
@@ -424,6 +426,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
             store_trace=not args.no_trace,
             checkpoint_dir=args.checkpoint,
             stats_path=args.stats,
+            visited_backend=args.visited_backend,
             **chunk_kw,
         )
     else:
