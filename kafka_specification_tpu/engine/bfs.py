@@ -38,9 +38,17 @@ from ..models.base import Model
 from ..ops import dedup, hashset
 from ..ops.fingerprint import fingerprint_lanes
 
-# insert-or-find on the device hash table; tables donated so XLA updates
-# them in place instead of copying O(capacity) per chunk
-_hash_insert = jax.jit(hashset.probe_insert, donate_argnums=(0, 1))
+# insert-or-find on the device hash table; table + claim lattice donated so
+# XLA updates them in place instead of copying O(capacity) per chunk
+def _hash_insert_impl(t_hi, t_lo, claim, q_hi, q_lo, valid):
+    return hashset.probe_insert(t_hi, t_lo, q_hi, q_lo, valid, claim=claim)
+
+
+_hash_insert = jax.jit(_hash_insert_impl, donate_argnums=(0, 1, 2))
+
+# device-hash table floor (module-level so tests can shrink it to exercise
+# the growth / overflow-re-run machinery at small state counts)
+_HASH_MIN_CAP = 1 << 16
 
 
 def _next_pow2(n: int) -> int:
@@ -588,7 +596,7 @@ def check(
             f"got {visited_backend!r}"
         )
     host_set = None
-    ht_hi = ht_lo = None  # device-hash table (ops/hashset)
+    ht_hi = ht_lo = ht_claim = None  # device-hash table (ops/hashset)
     hash_n = 0
 
     def _u64(hi, lo):
@@ -608,14 +616,14 @@ def check(
         vlo = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
         vn = jnp.int32(0)
     elif visited_backend == "device-hash":
-        hcap = _next_pow2(
-            max(4 * n0, 1 << 16, 4 * (visited_capacity_hint or 0))
+        ht_hi, ht_lo = hashset.table_from_pairs(
+            np.asarray(hi0),
+            np.asarray(lo0),
+            min_cap=_next_pow2(
+                max(_HASH_MIN_CAP, 4 * (visited_capacity_hint or 0))
+            ),
         )
-        ht_hi, ht_lo = hashset.new_table(hcap)
-        ht_hi, ht_lo, _m, nn0, ovf0 = hashset.probe_insert(
-            ht_hi, ht_lo, hi0, lo0, jnp.ones(hi0.shape[0], bool)
-        )
-        assert not bool(ovf0) and int(nn0) == n0
+        ht_claim = hashset.new_claim(ht_hi.shape[0])
         hash_n = n0
         vcap = 64  # placeholder shapes for the step signature
         vhi = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
@@ -704,14 +712,10 @@ def check(
                 live_hi = snap["hash_hi"]
                 live_lo = snap["hash_lo"]
                 hash_n = live_hi.shape[0]
-                ht_hi, ht_lo = hashset.new_table(_next_pow2(max(4 * hash_n, 1 << 16)))
-                for s0 in range(0, hash_n, 1 << 20):
-                    h = jnp.asarray(live_hi[s0 : s0 + (1 << 20)])
-                    lo = jnp.asarray(live_lo[s0 : s0 + (1 << 20)])
-                    ht_hi, ht_lo, _m, _n2, ovf = hashset.probe_insert(
-                        ht_hi, ht_lo, h, lo, jnp.ones(h.shape[0], bool)
-                    )
-                    assert not bool(ovf)
+                ht_hi, ht_lo = hashset.table_from_pairs(
+                    live_hi, live_lo, min_cap=_HASH_MIN_CAP
+                )
+                ht_claim = hashset.new_claim(ht_hi.shape[0])
             else:
                 vcap = int(snap["vcap"])
                 n = int(snap["vn"])
@@ -795,6 +799,7 @@ def check(
                 ht_hi, ht_lo = hashset.rehash_into(
                     ht_hi, ht_lo, 2 * ht_hi.shape[0]
                 )
+                ht_claim = hashset.new_claim(ht_hi.shape[0])
             # Candidate compaction: expand/pack/sort/probe/merge at the
             # enabled width (a few % of M) instead of the padded-lattice
             # width.  On overflow (an action enabled more pairs than its
@@ -870,8 +875,8 @@ def check(
                 valid = jnp.arange(out_hi.shape[0]) < new_n
                 isnew = np.zeros(out_hi.shape[0], bool)
                 while True:
-                    ht_hi, ht_lo, m, _ni, ovf = _hash_insert(
-                        ht_hi, ht_lo, out_hi, out_lo, valid
+                    ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
+                        ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
                     )
                     isnew |= np.asarray(m)
                     if not bool(ovf):
@@ -879,6 +884,7 @@ def check(
                     ht_hi, ht_lo = hashset.rehash_into(
                         ht_hi, ht_lo, 2 * ht_hi.shape[0]
                     )
+                    ht_claim = hashset.new_claim(ht_hi.shape[0])
                 mask = isnew[:nn]
                 hash_n += int(mask.sum())
                 lvl_rows.append(np.asarray(out[:nn])[mask])

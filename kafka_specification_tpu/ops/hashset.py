@@ -58,12 +58,18 @@ def new_table(cap: int):
     )
 
 
-def probe_insert(t_hi, t_lo, q_hi, q_lo, valid, max_probes: int = 32):
+CLAIM_FREE = 0x7FFFFFFF  # int32 max: "this slot was never claimed"
+
+
+def probe_insert(t_hi, t_lo, q_hi, q_lo, valid, max_probes: int = 32, claim=None):
     """Insert-or-find a batch of fingerprints.
 
     t_hi/t_lo: uint32[cap] table (cap power of two).
     q_hi/q_lo: uint32[M] batch; `valid` masks live rows.
-    Returns (t_hi', t_lo', is_new[M], n_new, overflow).
+    claim: optional int32[cap] claim lattice carried ACROSS calls (see
+    below); pass the one returned by the previous call (or new_claim) to
+    avoid the O(cap) per-call initialization, or None to allocate fresh.
+    Returns (t_hi', t_lo', claim', is_new[M], n_new, overflow).
 
     Per probe round, every still-pending row:
       1. reads its current slot;
@@ -73,6 +79,13 @@ def probe_insert(t_hi, t_lo, q_hi, q_lo, valid, max_probes: int = 32):
          colliding strangers) re-read and either match (dup, done) or move
          to the next slot;
       4. on a foreign occupant -> moves to the next slot.
+
+    The claim lattice never needs resetting — between rounds or between
+    calls: a slot's claim is only consulted in the round that scatter-mins
+    into it, and every claimed slot receives its winner's pair in that
+    same round, so a slot carrying a stale claim is never empty again and
+    its claim is never read.  (Claim values are row indices, so the free
+    sentinel is int32-max and min-scatter always prefers a real row.)
     """
     cap = t_hi.shape[0]
     M = q_hi.shape[0]
@@ -84,13 +97,8 @@ def probe_insert(t_hi, t_lo, q_hi, q_lo, valid, max_probes: int = 32):
     # fields), and linear probing collapses under clustered home slots —
     # murmur fmix on both lanes makes the slot uniform for either mode
     pos0 = ((_fmix32(q_lo ^ _fmix32(q_hi)) & mask)).astype(jnp.int32)
-    # claim lattice, allocated ONCE per call and carried through the probe
-    # loop (a fresh cap-sized temp per round would cost O(cap) per level —
-    # the very thing this structure exists to avoid; as a loop carry, XLA
-    # scatters into it in place).  A slot's claim is only ever consulted in
-    # the round that writes it: an empty slot has never been claimed (every
-    # claim round installs its winner's pair immediately).
-    claim0 = jnp.full((cap,), M, jnp.int32)
+    if claim is None:
+        claim = new_claim(cap)
 
     def body(_, carry):
         t_hi, t_lo, claim, pos, pending, is_new = carry
@@ -111,17 +119,61 @@ def probe_insert(t_hi, t_lo, q_hi, q_lo, valid, max_probes: int = 32):
         is_new = is_new | won
         return t_hi, t_lo, claim, pos, pending, is_new
 
-    t_hi, t_lo, _claim, _pos, pending, is_new = jax.lax.fori_loop(
+    t_hi, t_lo, claim, _pos, pending, is_new = jax.lax.fori_loop(
         0,
         max_probes,
         body,
-        (t_hi, t_lo, claim0, pos0, valid, jnp.zeros((M,), bool)),
+        (t_hi, t_lo, claim, pos0, valid, jnp.zeros((M,), bool)),
     )
-    return t_hi, t_lo, is_new, jnp.sum(is_new, dtype=jnp.int32), jnp.any(pending)
+    return (
+        t_hi,
+        t_lo,
+        claim,
+        is_new,
+        jnp.sum(is_new, dtype=jnp.int32),
+        jnp.any(pending),
+    )
+
+
+def new_claim(cap: int):
+    """Fresh claim lattice for a `cap`-slot table (see probe_insert)."""
+    return jnp.full((cap,), CLAIM_FREE, jnp.int32)
+
+
+def table_from_pairs(hi, lo, min_cap: int = 1 << 10, chunk: int = 1 << 20):
+    """Build a table containing exactly the given (assumed-distinct) pairs.
+
+    Streams the pairs through probe_insert in chunks; a probe-budget
+    overflow (possible in principle even at low load, just improbable)
+    grows the table and retries instead of failing — shared by table
+    growth and every checkpoint-resume/init reinsertion path.
+    Returns (t_hi, t_lo) with capacity >= max(min_cap, 4*len) rounded up
+    to a power of two.
+    """
+    import numpy as np
+
+    n = int(hi.shape[0])
+    cap = max(int(min_cap), 4 * n, 2)
+    cap = 1 << (cap - 1).bit_length()
+    while True:
+        nh, nl = new_table(cap)
+        ok = True
+        for start in range(0, n, chunk):
+            h = jnp.asarray(hi[start : start + chunk])
+            lo_c = jnp.asarray(lo[start : start + chunk])
+            nh, nl, _c, _m, _n2, ovf = probe_insert(
+                nh, nl, h, lo_c, jnp.ones(h.shape[0], bool)
+            )
+            if bool(ovf):  # pragma: no cover - improbable at 1/4 load
+                ok = False
+                break
+        if ok:
+            return nh, nl
+        cap *= 2
 
 
 def rehash_into(t_hi, t_lo, new_cap: int, chunk: int = 1 << 20):
-    """Grow: re-insert every live pair into a fresh `new_cap` table.
+    """Grow: re-insert every live pair into a (>=) `new_cap` table.
 
     Host-driven (runs between BFS levels, amortized O(n) per doubling);
     streams the old table in chunks through probe_insert so peak memory is
@@ -129,17 +181,7 @@ def rehash_into(t_hi, t_lo, new_cap: int, chunk: int = 1 << 20):
     """
     import numpy as np
 
-    nh, nl = new_table(new_cap)
     old_hi = np.asarray(t_hi)
     old_lo = np.asarray(t_lo)
     live = ~((old_hi == SENT) & (old_lo == SENT))
-    hi_live, lo_live = old_hi[live], old_lo[live]
-    for start in range(0, hi_live.shape[0], chunk):
-        h = jnp.asarray(hi_live[start : start + chunk])
-        lo = jnp.asarray(lo_live[start : start + chunk])
-        nh, nl, _new, _n, ovf = probe_insert(
-            nh, nl, h, lo, jnp.ones(h.shape[0], bool)
-        )
-        if bool(ovf):  # pragma: no cover - only reachable on absurd load
-            return rehash_into(t_hi, t_lo, new_cap * 2, chunk)
-    return nh, nl
+    return table_from_pairs(old_hi[live], old_lo[live], min_cap=new_cap, chunk=chunk)

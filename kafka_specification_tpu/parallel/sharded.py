@@ -53,24 +53,49 @@ from .multihost import (
 from ..ops.fingerprint import fingerprint_lanes
 
 
+# per-shard hash-table floor (module-level so tests can shrink it to
+# exercise growth at small state counts)
+_HASH_MIN_CAP = 1 << 14
+
+
+def _shard_tables_from_pairs(per_shard, min_cap: int):
+    """Uniform-capacity per-shard tables from per-shard (hi, lo) pairs.
+
+    All shards must share one capacity (the shard_map operand is one
+    [D, cap] array); if any shard's build grows past the target (probe
+    overflow — improbable at 1/4 load but handled, never asserted), every
+    shard is rebuilt at the larger capacity.  Returns (vhi, vlo, cap)."""
+    cap = _next_pow2(max(min_cap, 4 * max((len(h) for h, _ in per_shard), default=1)))
+    while True:
+        ths, tls = [], []
+        redo = False
+        for h, lo in per_shard:
+            th, tl = hashset.table_from_pairs(h, lo, min_cap=cap)
+            if th.shape[0] != cap:
+                cap = int(th.shape[0])
+                redo = True
+                break
+            ths.append(np.asarray(th))
+            tls.append(np.asarray(tl))
+        if not redo:
+            return np.stack(ths), np.stack(tls), cap
+
+
 def _grow_hash_tables(dev_vhi, dev_vlo, new_cap: int, shard1):
-    """Rehash every shard's HBM hash table into `new_cap` slots.
+    """Rehash every shard's HBM hash table into (>=) `new_cap` slots.
 
     Host-driven (runs between chunk attempts, amortized O(n) per
     doubling); fetch_global/put_global keep it multi-process-correct —
-    every process computes the identical grown tables."""
+    every process computes the identical grown tables.  Returns
+    (dev_vhi, dev_vlo, cap)."""
     old_hi = fetch_global(dev_vhi)  # [D, cap]
     old_lo = fetch_global(dev_vlo)
-    D = old_hi.shape[0]
-    nh = np.empty((D, new_cap), np.uint32)
-    nl = np.empty((D, new_cap), np.uint32)
-    for d in range(D):
-        th, tl = hashset.rehash_into(
-            jnp.asarray(old_hi[d]), jnp.asarray(old_lo[d]), new_cap
-        )
-        nh[d] = np.asarray(th)
-        nl[d] = np.asarray(tl)
-    return put_global(nh, shard1), put_global(nl, shard1)
+    live = ~((old_hi == hashset.SENT) & (old_lo == hashset.SENT))
+    per_shard = [
+        (old_hi[d][live[d]], old_lo[d][live[d]]) for d in range(old_hi.shape[0])
+    ]
+    nh, nl, cap = _shard_tables_from_pairs(per_shard, new_cap)
+    return put_global(nh, shard1), put_global(nl, shard1), cap
 
 
 def _norm_shift(bucket: int, shift: int) -> int:
@@ -204,7 +229,11 @@ def _make_sharded_step(
             # overflow discipline stays exact.
             q_hi = jnp.where(first, hi_s, sent)
             q_lo = jnp.where(first, lo_s, sent)
-            vhi2, vlo2, is_new, _nn, ovf_probe = hashset.probe_insert(
+            # claim=None: a fresh per-shard claim lattice per chunk (an
+            # HBM memset of cap/D int32 — microseconds at pod scale);
+            # carrying it across chunks would need a third shard_map
+            # operand for little gain at per-shard table sizes
+            vhi2, vlo2, _claim, is_new, _nn, ovf_probe = hashset.probe_insert(
                 vhi, vlo, q_hi, q_lo, first
             )
             vn2 = vn
@@ -411,23 +440,11 @@ def check_sharded(
     elif visited_backend == "device-hash":
         # per-shard HBM open-addressing tables (ops/hashset), carried in
         # the vhi/vlo slots; vn is unused (the tables track membership)
-        vcap = _next_pow2(max(1 << 14, 4 * n0))
-        vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
-        vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        per_shard = [
+            (hi0[owner0 == d], lo0[owner0 == d]) for d in range(D)
+        ]
+        vhi, vlo, vcap = _shard_tables_from_pairs(per_shard, _HASH_MIN_CAP)
         vn = np.zeros((D,), np.int32)
-        for d in range(D):
-            sel = np.nonzero(owner0 == d)[0]
-            if len(sel):
-                th, tl, _m, nn_d, ovf = hashset.probe_insert(
-                    jnp.asarray(vhi[d]),
-                    jnp.asarray(vlo[d]),
-                    jnp.asarray(hi0[sel]),
-                    jnp.asarray(lo0[sel]),
-                    jnp.ones(len(sel), bool),
-                )
-                assert not bool(ovf) and int(nn_d) == len(sel)
-                vhi[d] = np.asarray(th)
-                vlo[d] = np.asarray(tl)
     else:
         vcap = _next_pow2(max(1024, 4 * n0))
         vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
@@ -514,24 +531,16 @@ def check_sharded(
                 lens = snap["hash_lens"]
                 flat_hi, flat_lo = snap["hash_hi"], snap["hash_lo"]
                 shard_visited = lens.astype(np.int64)
-                vcap = _next_pow2(max(1 << 14, 4 * int(lens.max())))
-                vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
-                vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
-                at = 0
-                for d, ln in enumerate(lens):
+                per_shard, at = [], 0
+                for ln in lens:
                     ln = int(ln)
-                    if ln:
-                        th, tl, _m, _n2, ovf = hashset.probe_insert(
-                            jnp.asarray(vhi[d]),
-                            jnp.asarray(vlo[d]),
-                            jnp.asarray(flat_hi[at : at + ln]),
-                            jnp.asarray(flat_lo[at : at + ln]),
-                            jnp.ones(ln, bool),
-                        )
-                        assert not bool(ovf)
-                        vhi[d] = np.asarray(th)
-                        vlo[d] = np.asarray(tl)
+                    per_shard.append(
+                        (flat_hi[at : at + ln], flat_lo[at : at + ln])
+                    )
                     at += ln
+                vhi, vlo, vcap = _shard_tables_from_pairs(
+                    per_shard, _HASH_MIN_CAP
+                )
             else:
                 vcap = int(snap["vcap"])
                 vn = snap["vn"]
@@ -681,9 +690,8 @@ def check_sharded(
                     # keep every shard's table under ~1/2 load so linear
                     # probing stays short (shard_visited is host-tracked)
                     if 2 * int(shard_visited.max()) > vcap:
-                        vcap = 2 * vcap
-                        dev_vhi, dev_vlo = _grow_hash_tables(
-                            dev_vhi, dev_vlo, vcap, shard1
+                        dev_vhi, dev_vlo, vcap = _grow_hash_tables(
+                            dev_vhi, dev_vlo, 2 * vcap, shard1
                         )
                 if visited_backend == "device":
                     # grow per-shard visited capacity for the worst-case merge
@@ -769,9 +777,8 @@ def check_sharded(
                     # shard's table and re-run the chunk (the attempt's
                     # returned tables are discarded — the step is
                     # functional, so nothing was committed)
-                    vcap *= 2
-                    dev_vhi, dev_vlo = _grow_hash_tables(
-                        dev_vhi, dev_vlo, vcap, shard1
+                    dev_vhi, dev_vlo, vcap = _grow_hash_tables(
+                        dev_vhi, dev_vlo, 2 * vcap, shard1
                     )
                     continue
                 dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n

@@ -24,7 +24,7 @@ def test_probe_insert_find_and_duplicates():
     hi = jnp.asarray([1, 2, 1, 3, 2, 1], jnp.uint32)
     lo = jnp.asarray([10, 20, 10, 30, 21, 10], jnp.uint32)
     valid = jnp.ones(6, bool)
-    t_hi, t_lo, is_new, n_new, ovf = hashset.probe_insert(t_hi, t_lo, hi, lo, valid)
+    t_hi, t_lo, _c, is_new, n_new, ovf = hashset.probe_insert(t_hi, t_lo, hi, lo, valid)
     # distinct pairs: (1,10), (2,20), (3,30), (2,21) — first occurrence wins
     assert not bool(ovf)
     assert int(n_new) == 4
@@ -32,7 +32,7 @@ def test_probe_insert_find_and_duplicates():
     # second batch: all seen, plus one new
     hi2 = jnp.asarray([3, 4], jnp.uint32)
     lo2 = jnp.asarray([30, 40], jnp.uint32)
-    t_hi, t_lo, is_new2, n_new2, ovf2 = hashset.probe_insert(
+    t_hi, t_lo, _c, is_new2, n_new2, ovf2 = hashset.probe_insert(
         t_hi, t_lo, hi2, lo2, jnp.ones(2, bool)
     )
     assert not bool(ovf2)
@@ -49,19 +49,19 @@ def test_probe_insert_collision_chains_and_overflow():
     t_hi, t_lo = hashset.new_table(8)
     hi = jnp.asarray(np.arange(6), jnp.uint32)
     lo = jnp.asarray(np.full(6, 7), jnp.uint32)
-    t_hi, t_lo, is_new, n_new, ovf = hashset.probe_insert(
+    t_hi, t_lo, _c, is_new, n_new, ovf = hashset.probe_insert(
         t_hi, t_lo, hi, lo, jnp.ones(6, bool)
     )
     assert not bool(ovf) and int(n_new) == 6
     # same keys again: all found despite collision chains
-    t_hi, t_lo, is_new2, n_new2, ovf2 = hashset.probe_insert(
+    t_hi, t_lo, _c, is_new2, n_new2, ovf2 = hashset.probe_insert(
         t_hi, t_lo, hi, lo, jnp.ones(6, bool)
     )
     assert int(n_new2) == 0 and not bool(ovf2)
     # probe budget 1 with a full-ish table: new colliding keys overflow
     hi3 = jnp.asarray([100, 101], jnp.uint32)
     lo3 = jnp.asarray([7, 7], jnp.uint32)
-    _th, _tl, _m, _n, ovf3 = hashset.probe_insert(
+    _th, _tl, _c3, _m, _n, ovf3 = hashset.probe_insert(
         t_hi, t_lo, hi3, lo3, jnp.ones(2, bool), max_probes=1
     )
     assert bool(ovf3)
@@ -71,12 +71,12 @@ def test_rehash_preserves_membership():
     t_hi, t_lo = hashset.new_table(64)
     hi = jnp.asarray(np.arange(20), jnp.uint32)
     lo = jnp.asarray(np.arange(20) * 7 + 1, jnp.uint32)
-    t_hi, t_lo, _m, _n, _o = hashset.probe_insert(
+    t_hi, t_lo, _c, _m, _n, _o = hashset.probe_insert(
         t_hi, t_lo, hi, lo, jnp.ones(20, bool)
     )
     g_hi, g_lo = hashset.rehash_into(t_hi, t_lo, 256)
     assert g_hi.shape[0] == 256
-    _th, _tl, is_new, n_new, ovf = hashset.probe_insert(
+    _th, _tl, _c2, is_new, n_new, ovf = hashset.probe_insert(
         g_hi, g_lo, hi, lo, jnp.ones(20, bool)
     )
     assert int(n_new) == 0 and not bool(ovf)
@@ -100,15 +100,22 @@ def test_device_hash_backend_exact_counts():
     assert res.stats["hash_table_size"] == 29791
 
 
-def test_device_hash_backend_growth_from_tiny_table():
-    """A table starting far below the state count must grow (rehash_into)
-    and still produce the exact count."""
+def test_device_hash_backend_growth_from_tiny_table(monkeypatch):
+    """A table starting far below the state count must grow (rehash_into,
+    the proactive load-factor doubling, and — at capacity 16 with 102
+    states arriving in chunks — the overflow re-run path) and still
+    produce the exact count.  The floor is shrunk so growth actually
+    triggers (at the default 2^16 floor these runs never grow)."""
+    from kafka_specification_tpu.engine import bfs
+
+    monkeypatch.setattr(bfs, "_HASH_MIN_CAP", 1 << 4)
     res = check(
         id_sequence.make_model(100),
         min_bucket=32,
         visited_backend="device-hash",
     )
     assert res.ok and res.total == 102
+    assert res.stats["hash_table_capacity"] >= 256  # grew from 16
 
 
 def test_device_hash_violation_trace_replays():
@@ -158,16 +165,17 @@ def test_sharded_device_hash_exact_counts():
     assert sum(res.stats["shard_visited"]) == 29791
 
 
-def test_sharded_device_hash_growth_and_violation():
-    """Table growth (tiny initial tables at 4*n0) and the violation path
-    through the sharded hash backend: same depth as the known-answer
-    matrix."""
-    from kafka_specification_tpu.parallel.sharded import check_sharded
+def test_sharded_device_hash_growth_and_violation(monkeypatch):
+    """Per-shard table growth (floor shrunk so _grow_hash_tables actually
+    runs) and the violation path through the sharded hash backend: same
+    depth as the known-answer matrix."""
+    from kafka_specification_tpu.parallel import sharded as sh
 
+    monkeypatch.setattr(sh, "_HASH_MIN_CAP", 1 << 4)
     model = variants.make_model(
         "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("WeakIsr",)
     )
-    res = check_sharded(model, visited_backend="device-hash")
+    res = sh.check_sharded(model, visited_backend="device-hash")
     assert not res.ok
     assert res.violation.invariant == "WeakIsr"
     assert res.violation.depth == 8
