@@ -57,3 +57,17 @@ def test_async_isr_hw_counts_pending_members():
     )
     res = check(broken, min_bucket=32)
     assert res.violation is not None  # ignoring pending members is unsafe
+
+
+@pytest.mark.slow
+def test_async_isr_m3_v3_exhaustive_matches_oracle():
+    """Deeper CONSTRAINT bound (MaxOffset=3, MaxVersion=3): 48,120 states,
+    ValidHighWatermark holds, engine ≡ oracle as exact per-level state
+    sets (round-3 known-answer row in RESULTS.md)."""
+    cfg = async_isr.AsyncIsrConfig(3, 3, 3)
+    res, _ = assert_matches_oracle(
+        async_isr.make_model(cfg), async_isr.make_oracle(cfg)
+    )
+    assert res.ok
+    assert res.total == 48120
+    assert res.diameter == 23
