@@ -58,7 +58,12 @@ class AsyncIsrConfig:
 def make_spec(cfg: AsyncIsrConfig) -> StateSpec:
     N, M, V = cfg.n, cfg.max_offset, cfg.max_version
     # the per-version request bitset has 2^N bits and lives in int32 fields
-    assert N <= 4, "req_bits subset lattice must fit a signed int32 element"
+    if N > 4:
+        raise ValueError(
+            f"AsyncIsr supports at most 4 replicas, got {N}: the request "
+            "set is encoded as a per-version 2^N-bit subset bitset "
+            "(req_bits) that must fit one signed int32 element"
+        )
     return StateSpec(
         [
             # controllerState (:48-51)

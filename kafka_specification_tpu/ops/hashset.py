@@ -33,9 +33,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-# empty-slot sentinel: the all-ones pair never occurs as a fingerprint
-# (ops/fingerprint.hash_pair remaps it; exact64 packs stay within schema
-# bounds, and engine padding is masked before reaching the table)
+# empty-slot sentinel: the all-ones pair never occurs as a fingerprint.
+# Hashed mode: ops/fingerprint.hash_pair remaps it.  Exact64 mode: packing
+# demotes any schema that could legally pack to all-ones in both lanes to
+# hashed fingerprints at build time (StateSpec._may_hit_sentinel,
+# ops/packing.py) — the guarantee is enforced by construction, not assumed.
+# Engine padding is masked before reaching the table.
 SENT = 0xFFFFFFFF
 
 
@@ -150,8 +153,6 @@ def table_from_pairs(hi, lo, min_cap: int = 1 << 10, chunk: int = 1 << 20):
     Returns (t_hi, t_lo) with capacity >= max(min_cap, 4*len) rounded up
     to a power of two.
     """
-    import numpy as np
-
     n = int(hi.shape[0])
     cap = max(int(min_cap), 4 * n, 2)
     cap = 1 << (cap - 1).bit_length()

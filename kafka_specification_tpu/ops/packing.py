@@ -74,12 +74,20 @@ class StateSpec:
                 bit += w
                 lane_bits[lane] = bit
         self.num_lanes = lane + 1 if bit > 0 else lane
-        # a state can only pack to the all-ones sentinel pair if every lane
-        # is completely full of field bits (pad bits are always 0); with a
-        # single lane the exact fingerprint's hi word is constant 0, so the
-        # sentinel pair is unreachable regardless
-        self._may_hit_sentinel = self.num_lanes == 2 and all(
-            lane_bits.get(i, 0) == 32 for i in range(self.num_lanes)
+        # a state can only pack to the all-ones sentinel pair (the dedup
+        # empty-slot marker, ops/dedup.SENT == ops/hashset.SENT) if every
+        # lane is completely full of field bits (pad bits are always 0) AND
+        # every field's biased span actually reaches its all-ones bit
+        # pattern (a span < 2^width leaves the top pattern unrepresentable);
+        # with a single lane the exact fingerprint's hi word is constant 0,
+        # so the sentinel pair is unreachable regardless
+        spans_full = all(
+            f.hi - f.lo + 1 == (1 << f.width) for f in self.fields
+        )
+        self._may_hit_sentinel = (
+            self.num_lanes == 2
+            and all(lane_bits.get(i, 0) == 32 for i in range(self.num_lanes))
+            and spans_full
         )
         self.total_bits = sum(widths)
         self._lane_ids = np.asarray(lane_ids, np.int32)

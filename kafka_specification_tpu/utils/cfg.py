@@ -114,6 +114,17 @@ def _setlen(v) -> int:
     return len(v) if isinstance(v, list) else int(v)
 
 
+def _with_names(built, constants):
+    """Record the .cfg's replica model-value names (`Replicas = {b1, b2,
+    b3}`) in the model's meta so counterexample traces render with the
+    config's own vocabulary (utils/pretty), the way TLC echoes the model
+    values it was given."""
+    names = constants.get("Replicas")
+    if isinstance(names, list) and hasattr(built, "meta"):
+        built.meta.setdefault("replica_names", list(names))
+    return built
+
+
 def build_model(
     module: str, cfg: TlcConfig, oracle: bool = False, emitted: bool = False
 ):
@@ -183,6 +194,7 @@ def build_model(
         # Partitions = K (authored constant, not in the reference): the
         # K-partition product space — the reading of the "5 brokers /
         # 3 partitions" stretch workload (BASELINE.md note; models/product.py)
+        built = _with_names(built, c)
         k = _setlen(c.get("Partitions", 1))
         if k > 1:
             from ..models.product import product_model, product_oracle
@@ -201,8 +213,8 @@ def build_model(
         if emitted:
             from ..models.emitted import make_emitted_async_isr
 
-            return make_emitted_async_isr(acfg, invariants=invs)
-        return (m.make_oracle if oracle else m.make_model)(acfg, invs)
+            return _with_names(make_emitted_async_isr(acfg, invariants=invs), c)
+        return _with_names((m.make_oracle if oracle else m.make_model)(acfg, invs), c)
     raise KeyError(f"unknown module {module!r}")
 
 

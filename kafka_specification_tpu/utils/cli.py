@@ -108,26 +108,31 @@ def _guarded_reexec(argv) -> int:
             except OSError:
                 return []
 
-        deadline = time.monotonic() + _INIT_TIMEOUT
-        compute_deadline = None
-        while time.monotonic() < deadline:
-            stages = marker_stages()
-            if "compute" in stages:
+        try:
+            deadline = time.monotonic() + _INIT_TIMEOUT
+            compute_deadline = None
+            while time.monotonic() < deadline:
+                stages = marker_stages()
+                if "compute" in stages:
+                    return "ok", p.wait()  # platform live: no further limit
+                if "init" in stages and compute_deadline is None:
+                    compute_deadline = time.monotonic() + _COMPUTE_TIMEOUT
+                    deadline = compute_deadline
+                rc = p.poll()
+                if rc == 0:
+                    return "ok", 0  # finished clean before marking
+                if rc is not None:
+                    # nonzero before the marker: init (or pre-init) failure
+                    return "initfail", rc
+                time.sleep(0.2)
+            p.kill()
+            p.wait()
+            return "timeout", None
+        finally:
+            try:
                 os.unlink(marker.name)
-                return "ok", p.wait()  # platform live: no further limit
-            if "init" in stages and compute_deadline is None:
-                compute_deadline = time.monotonic() + _COMPUTE_TIMEOUT
-                deadline = compute_deadline
-            rc = p.poll()
-            if rc == 0:
-                return "ok", 0  # finished clean before marking
-            if rc is not None:
-                # nonzero before the marker: init (or pre-init) failure
-                return "initfail", rc
-            time.sleep(0.2)
-        p.kill()
-        p.wait()
-        return "timeout", None
+            except OSError:
+                pass
 
     kind, rc = run(os.environ)
     if kind != "ok":

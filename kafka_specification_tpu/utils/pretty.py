@@ -3,66 +3,81 @@
 The engines' decoded states are canonical Python tuples (compact, hashable,
 comparable with the oracle); this module renders them the way TLC prints a
 state — named records, one variable per line — so a counterexample reads
-like the reference spec's own vocabulary.
+like the reference spec's own vocabulary.  When the driving .cfg declared
+replica model values (`Replicas = {b1, b2, b3}`), those exact names are
+used (meta["replica_names"], plumbed by utils/cfg.build_model); otherwise
+replicas render as b0..bN-1.
 """
 
 from __future__ import annotations
 
 
-def _set(s):
-    return "{" + ", ".join(f"b{r}" for r in sorted(s)) + "}"
+def _namer(model_meta: dict):
+    """replica index -> display name, honouring the .cfg's model values."""
+    names = model_meta.get("replica_names")
+    if names:
+        return lambda r: names[r] if 0 <= r < len(names) else f"b{r}"
+    return lambda r: f"b{r}"
 
 
-def _opt(v, prefix="b"):
-    return "None" if v == -1 else f"{prefix}{v}"
+def _set(s, nm):
+    return "{" + ", ".join(nm(r) for r in sorted(s)) + "}"
 
 
-def render_kafka_state(state) -> str:
+def _opt(v, nm):
+    return "None" if v == -1 else nm(v)
+
+
+def render_kafka_state(state, nm=None) -> str:
     """Canonical KafkaReplication-family state -> TLA-like record text
     (field names per /root/reference/KafkaReplication.tla:45-75)."""
+    nm = nm or (lambda r: f"b{r}")
     logs, rstates, nrid, nep, reqs, (qep, qldr, qisr) = state
     lines = []
     log_txt = ", ".join(
-        f"b{r} :> <<"
+        f"{nm(r)} :> <<"
         + ", ".join(f"[id|->{i}, epoch|->{e}]" for i, e in log)
         + ">>"
         for r, log in enumerate(logs)
     )
     lines.append(f"replicaLog = ({log_txt})")
     rs_txt = ", ".join(
-        f"b{r} :> [hw|->{hw}, leaderEpoch|->{ep}, leader|->{_opt(ldr)}, isr|->{_set(isr)}]"
+        f"{nm(r)} :> [hw|->{hw}, leaderEpoch|->{ep}, leader|->{_opt(ldr, nm)}, isr|->{_set(isr, nm)}]"
         for r, (hw, ep, ldr, isr) in enumerate(rstates)
     )
     lines.append(f"replicaState = ({rs_txt})")
     lines.append(f"nextRecordId = {nrid}")
     lines.append(f"nextLeaderEpoch = {nep}")
     req_txt = ", ".join(
-        f"[leaderEpoch|->{e}, leader|->{_opt(l)}, isr|->{_set(isr)}]"
+        f"[leaderEpoch|->{e}, leader|->{_opt(l, nm)}, isr|->{_set(isr, nm)}]"
         for e, l, isr in sorted(reqs)
     )
     lines.append(f"leaderAndIsrRequests = {{{req_txt}}}")
     lines.append(
-        f"quorumState = [leaderEpoch|->{qep}, leader|->{_opt(qldr)}, isr|->{_set(qisr)}]"
+        f"quorumState = [leaderEpoch|->{qep}, leader|->{_opt(qldr, nm)}, isr|->{_set(qisr, nm)}]"
     )
     return "\n".join("  " + ln for ln in lines)
 
 
-def render_async_isr_state(state) -> str:
+def render_async_isr_state(state, nm=None) -> str:
     """Canonical AsyncIsr state -> TLA-like record text (AsyncIsr.tla:31-56)."""
+    nm = nm or (lambda r: f"b{r}")
     (c_isr, c_ver), (l_isr, l_ver, pend, pver, offs), reqs, upds = state
     lines = [
-        f"controllerState = [isr|->{_set(c_isr)}, version|->{c_ver}]",
-        f"leaderState = [isr|->{_set(l_isr)}, version|->{l_ver}, "
-        f"pendingIsr|->{_set(pend)}, pendingVersion|->{pver}, "
-        f"offsets|->({', '.join(f'b{r} :> {o}' for r, o in enumerate(offs))})]",
+        f"controllerState = [isr|->{_set(c_isr, nm)}, version|->{c_ver}]",
+        f"leaderState = [isr|->{_set(l_isr, nm)}, version|->{l_ver}, "
+        f"pendingIsr|->{_set(pend, nm)}, pendingVersion|->{pver}, "
+        f"offsets|->({', '.join(f'{nm(r)} :> {o}' for r, o in enumerate(offs))})]",
         "requests = {"
         + ", ".join(
-            f"[isr|->{_set(isr)}, version|->{v}]" for isr, v in sorted(reqs, key=str)
+            f"[isr|->{_set(isr, nm)}, version|->{v}]"
+            for isr, v in sorted(reqs, key=str)
         )
         + "}",
         "updates = {"
         + ", ".join(
-            f"[isr|->{_set(isr)}, version|->{v}]" for isr, v in sorted(upds, key=str)
+            f"[isr|->{_set(isr, nm)}, version|->{v}]"
+            for isr, v in sorted(upds, key=str)
         )
         + "}",
     ]
@@ -72,15 +87,19 @@ def render_async_isr_state(state) -> str:
 def render_state(model_meta: dict, state) -> str:
     """Dispatch on the model family; fall back to repr."""
     variant = model_meta.get("variant", "")
+    nm = _namer(model_meta)
     try:
         if "partitions" in model_meta:
+            sub_meta = {
+                k: v for k, v in model_meta.items() if k != "partitions"
+            }
             parts = [
-                f"  partition {p}:\n" + render_state({"variant": variant}, sub)
+                f"  partition {p}:\n" + render_state(sub_meta, sub)
                 for p, sub in enumerate(state)
             ]
             return "\n".join(parts)
         if variant == "AsyncIsr":
-            return render_async_isr_state(state)
+            return render_async_isr_state(state, nm)
         if variant in (
             "KafkaTruncateToHighWatermark",
             "Kip101",
@@ -88,7 +107,7 @@ def render_state(model_meta: dict, state) -> str:
             "Kip320",
             "Kip320FirstTry",
         ):
-            return render_kafka_state(state)
+            return render_kafka_state(state, nm)
     except Exception:
         pass
     return "  " + repr(state)

@@ -667,10 +667,11 @@ class Emitter:
             return hit
         out = self._eval(ast, env)
         memo[key] = out
-        # pin the keyed env values for the scope's lifetime: the key uses
-        # id()s, and a GC'd binding's address could be recycled by a fresh
-        # object, turning a distinct env into a false cache hit
-        self._memo_pins.append(tuple(env.values()))
+        # pin the AST node and the keyed env values for the scope's
+        # lifetime: the key uses id()s, and a GC'd object's address could
+        # be recycled by a fresh one, turning a distinct (ast, env) into a
+        # false cache hit
+        self._memo_pins.append((ast, tuple(env.values())))
         return out
 
     def _eval(self, ast, env: dict):
@@ -1374,10 +1375,29 @@ def _domain_space(emitter: Emitter, entries, spec):
     for i, (kind, var, dom_ast, _x) in enumerate(entries):
         if kind != "choice":
             continue
-        env = {"__state__": dummy_state}
-        for _k, v, _d, _e in entries[:i]:
-            env[v] = IVal(0, 0, 0)
-        sizes.append(len(_set_iter_static(emitter.eval(dom_ast, env))))
+        # the mixed-radix digit layout requires each choice domain's static
+        # hull to be independent of earlier bind values (the mapper later
+        # evaluates the domain with *real* forced values/digits).  Guard by
+        # sampling the hull under two stub valuations of the earlier binds
+        # and rejecting on disagreement — a two-point sample, not a proof,
+        # but it moves the supported-subset boundary from a silent miscount
+        # to a loud build error (every corpus module passes; a domain whose
+        # hull varies with a bind value lands here by design, even if the
+        # concrete run would have been benign)
+        per_stub = []
+        for stub in (IVal(0, 0, 0), IVal(1, 1, 1)):
+            env = {"__state__": dummy_state}
+            for _k, v, _d, _e in entries[:i]:
+                env[v] = stub
+            per_stub.append(len(_set_iter_static(emitter.eval(dom_ast, env))))
+        if per_stub[0] != per_stub[1]:
+            raise NotImplementedError(
+                f"choice domain of {var!r} has a bind-dependent static hull "
+                f"({per_stub[0]} vs {per_stub[1]} slots): the digit radix "
+                f"and the mapper's unroll could disagree — outside the "
+                f"emitter's supported subset"
+            )
+        sizes.append(per_stub[0])
 
     def mapper(digits, env):
         vals = {}

@@ -1,0 +1,76 @@
+"""TPU-window sentry: probe the tunnel all round, bank evidence either way.
+
+Rounds 2-3 staged scripts/tpu_window.py and retried by hand; no window ever
+landed.  This sentry is the standing replacement: started once at round
+open, it loops for the whole round, attempting tpu_window.py on a cadence
+and appending ONE JSON line per attempt to TPU_SENTRY.jsonl — timestamp,
+return code, duration, and a one-word outcome.  If any attempt lands, the
+window kit itself banks TPU_WINDOW.json + TPU_PROFILE.jsonl, and the sentry
+keeps attempting on the same cadence (a persisting window re-runs the full
+kit each period, so longer windows refresh and extend the banked results).
+
+The probe gate inside tpu_window.py means a wedged tunnel costs ~120s per
+attempt, so a 30-min cadence burns <7% of a core.
+
+Return-code legend (from tpu_window.py):
+  0  full window run completed (results in TPU_WINDOW.json)
+  4  platform probe came back CPU — no TPU visible
+  5  probe or window timed out — tunnel wedged in PJRT init
+  other  child crashed mid-window (partial results still banked)
+
+Usage:  nohup python scripts/tpu_sentry.py >/dev/null 2>&1 &
+        KSPEC_SENTRY_PERIOD=900 KSPEC_SENTRY_HOURS=12 python scripts/tpu_sentry.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LOG = os.path.join(_REPO, "TPU_SENTRY.jsonl")
+_PERIOD = int(os.environ.get("KSPEC_SENTRY_PERIOD", "1800"))
+_HOURS = float(os.environ.get("KSPEC_SENTRY_HOURS", "12"))
+_OUTCOME = {0: "live", 4: "cpu-only", 5: "wedged"}
+
+
+def _attempt(n):
+    t0 = time.time()
+    try:
+        rc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts", "tpu_window.py")],
+            cwd=_REPO,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            timeout=int(os.environ.get("KSPEC_TPU_WINDOW_TIMEOUT", "1800"))
+            + 300,
+        ).returncode
+    except subprocess.TimeoutExpired:
+        rc = 6  # parent-level backstop; tpu_window's own timeouts failed
+    line = {
+        "attempt": n,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
+        "seconds": round(time.time() - t0, 1),
+        "rc": rc,
+        "outcome": _OUTCOME.get(rc, f"crashed({rc})"),
+    }
+    with open(_LOG, "a") as fh:
+        fh.write(json.dumps(line) + "\n")
+    return rc
+
+
+def main():
+    deadline = time.time() + _HOURS * 3600
+    n = 0
+    while time.time() < deadline:
+        n += 1
+        rc = _attempt(n)
+        # a live window: keep re-probing on the same cadence — each success
+        # re-runs the full kit and refreshes TPU_WINDOW.json; a dead tunnel:
+        # wait out the period (minus the ~2min the probe already burned)
+        time.sleep(_PERIOD if rc == 0 else max(60, _PERIOD - 120))
+
+
+if __name__ == "__main__":
+    main()

@@ -71,3 +71,10 @@ def test_async_isr_m3_v3_exhaustive_matches_oracle():
     assert res.ok
     assert res.total == 48120
     assert res.diameter == 23
+
+
+def test_rejects_five_replicas():
+    # the request-set encoding packs a 2^N-subset bitset into one signed
+    # int32 element (models/async_isr.make_spec) — N > 4 must fail loudly
+    with pytest.raises(ValueError, match="at most 4 replicas"):
+        async_isr.make_spec(async_isr.AsyncIsrConfig(5, 1, 1))

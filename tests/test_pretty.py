@@ -35,6 +35,25 @@ def test_render_unknown_falls_back_to_repr():
     assert render_state({}, (1, 2, 3)).strip() == "(1, 2, 3)"
 
 
+def test_render_uses_cfg_model_value_names():
+    # the .cfg declares `Replicas = {b1, b2}` — the rendered trace must use
+    # those names, not positional b0/b1 (TLC echoes the given model values)
+    from kafka_specification_tpu.utils.cfg import build_model, parse_cfg
+
+    cfg = parse_cfg(
+        "SPECIFICATION Spec\n"
+        "CONSTANTS Replicas = {b1, b2}\n"
+        "  LogSize = 2\n  MaxRecords = 1\n  MaxLeaderEpoch = 1\n"
+        "INVARIANTS TypeOk WeakIsr\n"
+    )
+    m = build_model("KafkaTruncateToHighWatermark", cfg)
+    assert m.meta["replica_names"] == ["b1", "b2"]
+    res = check(m, min_bucket=32)
+    text = render_trace(m.meta, res.violation.trace)
+    assert "b1 :>" in text and "b2 :>" in text
+    assert "b0" not in text
+
+
 def test_render_product_state_per_partition():
     from kafka_specification_tpu.models import kip320
     from kafka_specification_tpu.models.product import product_model
