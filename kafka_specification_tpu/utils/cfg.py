@@ -136,9 +136,11 @@ def build_model(
     module is rejected rather than silently ignored.
 
     emitted=True builds the model mechanically from the reference TLA+ text
-    (models/emitted — no hand-translated kernels).  Note emitted invariants
-    are the LITERAL reference predicates: LeaderInIsr and AsyncIsr's TypeOk
-    are False at Init under the literal reading (PARITY.md)."""
+    (models/emitted — no hand-translated kernels).  Invariant names resolve
+    to the corpus-wide intent readings on both paths (LeaderInIsr guarded
+    on leader # None, AsyncIsr TypeOk admitting pendingVersion = Nil); the
+    literal reference predicates — False at Init — remain available as
+    LeaderInIsrLiteral / TypeOkLiteral (PARITY.md)."""
     if emitted and oracle:
         raise ValueError("emitted models have no oracle twin (the oracle IS "
                          "an independent path; use oracle=False)")

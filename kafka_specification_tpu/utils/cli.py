@@ -240,10 +240,19 @@ def main(argv=None):
     pc.add_argument(
         "--emitted",
         action="store_true",
+        default=None,
         help="build the model mechanically from the reference TLA+ text "
-        "(utils/tla_emit — no hand-translated kernels); invariants are the "
-        "LITERAL reference predicates (see PARITY.md on LeaderInIsr and "
-        "AsyncIsr's TypeOk at Init)",
+        "(utils/tla_emit — no hand-translated kernels).  This is the "
+        "DEFAULT when the reference checkout is present (KSPEC_REFERENCE, "
+        "/root/reference); the hand-translated kernels remain as the "
+        "cross-check path (--hand)",
+    )
+    pc.add_argument(
+        "--hand",
+        action="store_true",
+        help="use the hand-translated kernels (models/*.py) instead of the "
+        "emitted ones — the independent cross-check path (also the "
+        "fallback when no reference checkout exists)",
     )
 
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
@@ -265,7 +274,14 @@ def main(argv=None):
     ps.add_argument(
         "--emitted",
         action="store_true",
-        help="simulate the mechanically emitted model (see `check --emitted`)",
+        default=None,
+        help="simulate the mechanically emitted model (the default when "
+        "the reference checkout is present — see `check --emitted`)",
+    )
+    ps.add_argument(
+        "--hand",
+        action="store_true",
+        help="simulate the hand-translated kernels (see `check --hand`)",
     )
 
     pv = sub.add_parser(
@@ -275,7 +291,13 @@ def main(argv=None):
     )
     pv.add_argument("cfg")
     pv.add_argument("--module")
-    pv.add_argument("--reference", default="/root/reference")
+    pv.add_argument(
+        "--reference",
+        default=os.environ.get("KSPEC_REFERENCE", "/root/reference"),
+        help="reference checkout to validate against (default: "
+        "$KSPEC_REFERENCE or /root/reference — same resolution as the "
+        "emitted model builder)",
+    )
     pv.add_argument(
         "--emitted",
         action="store_true",
@@ -343,7 +365,9 @@ def main(argv=None):
     if args.cmd == "simulate":
         from ..engine.simulate import simulate
 
-        model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
+        model = _build_or_fail(
+            module, tlc_cfg, emitted=_kernel_source(args, module)
+        )
         res = simulate(
             model, num_walks=args.walks, max_depth=args.depth, seed=args.seed
         )
@@ -385,7 +409,9 @@ def main(argv=None):
             print("No invariant violations. Exhaustive check complete.")
         return 0 if r.violation is None else 1
 
-    model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
+    model = _build_or_fail(
+        module, tlc_cfg, emitted=_kernel_source(args, module)
+    )
     progress = None
     if args.progress:
         def progress(depth, new_n, total):
@@ -404,6 +430,33 @@ def main(argv=None):
     _print_result(res, args.json, model_meta=model.meta)
     return 0 if res.violation is None else 1
 
+
+
+def _kernel_source(args, module) -> bool:
+    """Resolve check/simulate kernel source: True = emitted (the default
+    when the reference corpus is on disk), False = hand-translated.
+
+    The north star wants stock specs + .cfg to drive the checker — so the
+    mechanical path is the default engine and the hand kernels are the
+    independent cross-check (`--hand`), mirroring how the test suite holds
+    the two to exact state-set equality."""
+    if args.hand and args.emitted:
+        print("error: --hand and --emitted are mutually exclusive", file=sys.stderr)
+        raise SystemExit(2)
+    if args.hand:
+        return False
+    if args.emitted:
+        return True
+    from ..models.emitted import REF
+
+    if (REF / f"{module}.tla").exists():
+        return True
+    print(
+        f"note: no reference checkout at {REF} (set KSPEC_REFERENCE) — "
+        f"using hand-translated kernels",
+        file=sys.stderr,
+    )
+    return False
 
 
 def _build_or_fail(module, tlc_cfg, oracle=False, emitted=False):

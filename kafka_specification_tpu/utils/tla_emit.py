@@ -625,6 +625,58 @@ class Emitter:
         self.consts = consts  # name -> IVal | set value
         self.var_schemas = var_schemas  # TLA variable -> schema
         self._memo = None  # trace-local CSE cache (see memo_scope)
+        self._free_cache = {}  # id(node) -> (node, frozenset of free names)
+        self._def_free_cache = {}  # def name -> frozenset
+
+    def _def_free(self, name: str) -> frozenset:
+        """Free names a reference to def `name` depends on (its body's free
+        names minus its parameters), cached per def; cycles yield {} for
+        the back-edge (a recursive def's fixpoint is its non-cyclic part)."""
+        cache = self._def_free_cache
+        if name in cache:
+            return cache[name]
+        cache[name] = frozenset()  # cycle guard
+        params, body = self.defs[name]
+        cache[name] = self._free_names(body) - frozenset(params)
+        return cache[name]
+
+    def _free_names(self, ast) -> frozenset:
+        """Free names of `ast`: every env slot its evaluation can read —
+        transitively through def references, with state-variable reads
+        mapped to the "__state__" slot and EXCEPT's @ to "@".
+
+        Memoized per AST node (the cache entry pins the node, so its id
+        can't be recycled).  Sound over-approximation: after inline()
+        binders are α-renamed fresh, so including a bound var's name merely
+        widens a memo key, never conflates two distinct valuations."""
+        cached = self._free_cache.get(id(ast))
+        if cached is not None and cached[0] is ast:
+            return cached[1]
+        out = set()
+        if isinstance(ast, E.Name):
+            out.add(ast.id)
+            if ast.id in self.var_schemas:
+                out.add("__state__")
+            elif ast.id in self.defs:
+                out |= self._def_free(ast.id)
+        elif isinstance(ast, E.At):
+            out.add("@")
+        elif isinstance(ast, E.Apply):
+            if ast.op in self.defs:
+                out |= self._def_free(ast.op)
+            for x in ast.args:
+                out |= self._free_names(x)
+        elif isinstance(ast, (tuple, list)):
+            for x in ast:
+                out |= self._free_names(x)
+        elif hasattr(ast, "__dataclass_fields__"):
+            for f in ast.__dataclass_fields__:
+                out |= self._free_names(getattr(ast, f))
+        else:
+            return frozenset()  # str/int leaves: nothing to cache
+        fs = frozenset(out)
+        self._free_cache[id(ast)] = (ast, fs)
+        return fs
 
     def memo_scope(self):
         """Context manager enabling common-subexpression caching of eval.
@@ -658,10 +710,16 @@ class Emitter:
         memo = self._memo
         if memo is None:
             return self._eval(ast, env)
-        key = (
-            id(ast),
-            tuple(sorted((k, id(v)) for k, v in env.items())),
+        # key on the node identity plus ONLY the env slots its evaluation
+        # can read (its free names): a subtree shared across contexts —
+        # e.g. a LET body used both inside and outside a function
+        # constructor whose bound var it never mentions — then hits the
+        # cache instead of re-tracing per context
+        free = self._free_names(ast)
+        keyed = tuple(
+            sorted((k, id(v)) for k, v in env.items() if k in free)
         )
+        key = (id(ast), keyed)
         hit = memo.get(key, memo)
         if hit is not memo:
             return hit
@@ -671,7 +729,7 @@ class Emitter:
         # lifetime: the key uses id()s, and a GC'd object's address could
         # be recycled by a fresh one, turning a distinct (ast, env) into a
         # false cache hit
-        self._memo_pins.append((ast, tuple(env.values())))
+        self._memo_pins.append((ast, tuple(env[k] for k, _ in keyed)))
         return out
 
     def _eval(self, ast, env: dict):
@@ -1335,24 +1393,62 @@ def _split_forced(binds, guards):
     """
     entries = []
     remaining = list(guards)
-    for i, (var, dom_ast) in enumerate(binds):
-        later = {v for v, _ in binds[i + 1 :]}
-        pick = None
+    pending = list(binds)
+    pending_vars = {v for v, _ in pending}
+
+    def pin_of(var, placed_only: bool):
+        """A guard `var = expr` (either side) usable as a pin.  With
+        placed_only, expr may reference no still-pending bind var (so the
+        value is computable once every placed entry is bound); otherwise
+        any pin shape counts (used to decide which bind to sacrifice as a
+        choice digit)."""
         for g in remaining:
             if isinstance(g, E.Binop) and g.op == "=":
                 for side, other in ((g.a, g.b), (g.b, g.a)):
                     if isinstance(side, E.Name) and side.id == var:
                         names = _names_in(other)
-                        if var not in names and not (names & later):
-                            pick = (g, other)
-                            break
-                if pick:
-                    break
-        if pick:
-            remaining.remove(pick[0])
-            entries.append(("forced", var, dom_ast, pick[1]))
-        else:
-            entries.append(("choice", var, dom_ast, None))
+                        if var in names:
+                            continue
+                        if not placed_only or not (
+                            names & (pending_vars - {var})
+                        ):
+                            return g, other
+        return None
+
+    while pending:
+        # force any pending bind whose pin references only placed binds —
+        # hoisting it is sound iff its own domain references no pending
+        # bind (TLA+ scoping: domains only reference earlier binds, and
+        # those are either placed or pending; pending ones block the hoist)
+        placed_forced = False
+        for bi, (var, dom_ast) in enumerate(pending):
+            pick = pin_of(var, placed_only=True)
+            if pick and not (_names_in(dom_ast) & (pending_vars - {var})):
+                pending.pop(bi)
+                pending_vars.discard(var)
+                remaining.remove(pick[0])
+                entries.append(("forced", var, dom_ast, pick[1]))
+                placed_forced = True
+                break
+        if placed_forced:
+            continue
+        # no bind is forcible yet: spend a choice digit.  Prefer (in
+        # original order) a bind with no pin equation at all — placing it
+        # may unblock pins of the others (e.g. `req.leader = leader` with
+        # `leader` bound before `req`: choosing `req` first turns `leader`
+        # into a forced bind instead of an N-wide digit)
+        ci = next(
+            (
+                bi
+                for bi, (var, dom) in enumerate(pending)
+                if pin_of(var, placed_only=False) is None
+                and not (_names_in(dom) & (pending_vars - {var}))
+            ),
+            0,  # first-in-order: its domain refs are all placed by scoping
+        )
+        var, dom_ast = pending.pop(ci)
+        pending_vars.discard(var)
+        entries.append(("choice", var, dom_ast, None))
     return entries, remaining
 
 

@@ -113,13 +113,31 @@ def test_emitted_variant_matches_hand_tiny(module):
 
 
 def test_emitted_kip320_invariants_pass_tiny():
-    """The THEOREM workload from emitted predicate kernels
-    (Kip320.tla:168-171; LeaderInIsr literal excluded — PARITY.md)."""
+    """The THEOREM workload from emitted predicate kernels — all four
+    invariants (Kip320.tla:168-171).  `LeaderInIsr` resolves to the
+    corpus-wide intent reading; the reference's literal predicate (False
+    at Init) stays pinned below — PARITY.md."""
     m = make_emitted_model(
-        "Kip320", TINY, invariants=("TypeOk", "WeakIsr", "StrongIsr")
+        "Kip320",
+        TINY,
+        invariants=("TypeOk", "LeaderInIsr", "WeakIsr", "StrongIsr"),
     )
     r = check(m, store_trace=False)
     assert r.ok and r.total == 277
+
+
+def test_emitted_leader_in_isr_literal_false_at_init():
+    """The literal KafkaReplication.tla:345 predicate fails at depth 0
+    (leader = None at Init, :117-119) — same split the hand model pins in
+    tests/test_kip320.py; the emitted namespace keeps it as
+    LeaderInIsrLiteral."""
+    m = make_emitted_model(
+        "Kip320", TINY, invariants=("LeaderInIsrLiteral",)
+    )
+    r = check(m, store_trace=False)
+    assert not r.ok
+    assert r.violation.invariant == "LeaderInIsrLiteral"
+    assert r.violation.depth == 0
 
 
 def test_emitted_truncate_to_hw_weak_isr_violation_depth():
@@ -179,26 +197,36 @@ def test_emitted_async_isr_matches_hand():
 def test_emitted_async_isr_literal_type_ok_false_at_init():
     """The reference's literal TypeOk is violated at Init: pendingVersion
     is declared Nat (AsyncIsr.tla:45) but initialized to Nil (:145).  The
-    mechanical front-end surfaces this (PARITY.md); the hand model checks
-    the evident intent (Nat ∪ {Nil}) instead."""
+    mechanical front-end surfaces this (PARITY.md); `TypeOk` now resolves
+    to the evident intent (Nat ∪ {Nil}, matching the hand model) so the
+    .cfg-named invariant passes, with the literal kept as TypeOkLiteral."""
     from kafka_specification_tpu.models import async_isr
     from kafka_specification_tpu.models.emitted import make_emitted_async_isr
 
     cfg = async_isr.AsyncIsrConfig(3, 2, 2)
     r = check(
-        make_emitted_async_isr(cfg, invariants=("TypeOk",)), store_trace=False
+        make_emitted_async_isr(cfg, invariants=("TypeOkLiteral",)),
+        store_trace=False,
     )
     assert not r.ok
-    assert r.violation.invariant == "TypeOk" and r.violation.depth == 0
+    assert r.violation.invariant == "TypeOkLiteral" and r.violation.depth == 0
+    # the intent reading holds at Init (and throughout the bounded space)
+    r2 = check(
+        make_emitted_async_isr(cfg, invariants=("TypeOk",)),
+        store_trace=False,
+        max_depth=2,
+    )
+    assert r2.ok
 
 
 def test_emitted_kip320_small_exhaustive():
     """Mechanically emitted Kip320 at (2r,L2,R2,E2) — the 5,973-state
     THEOREM workload — as a routine fast-suite run (VERDICT r2 item 6:
     emitted kernels fast enough to be a default validation path).  The
-    forced-existential elimination (utils/tla_emit._split_forced) keeps
-    the choice lattice near the hand kernels' width (37 vs 29 columns at
-    this config; was 117 with unrolled hulls)."""
+    forced-existential elimination with bind reordering
+    (utils/tla_emit._split_forced) keeps the choice lattice near the hand
+    kernels' width (31 vs 29 columns at this config; was 117 with
+    unrolled hulls)."""
     m = make_emitted_model("Kip320", kr.Config(2, 2, 2, 2))
     res = check(m, store_trace=False, min_bucket=1024)
     assert res.ok

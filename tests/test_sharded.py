@@ -2,6 +2,7 @@
 single-device engine / oracle golden values, violations must be detected."""
 
 import jax
+import pytest
 import numpy as np
 from jax.sharding import Mesh
 
@@ -162,3 +163,25 @@ def test_sharded_deadlock_detection():
     assert res.violation.invariant == "Deadlock"
     assert res.violation.depth == 4
     assert [s for _, s in res.violation.trace] == [0, 1, 2, 3, 4]
+
+
+@pytest.mark.slow  # the RESULTS.md flagship claim, regression-pinned
+@pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
+def test_sharded_kip320_flagship_full_workload(exchange):
+    """The full 737,794-state Kip320 3-broker exhaustive pass through the
+    8-device mesh — the flagship workload the bench runs single-device —
+    in BOTH exchange modes (bucket-by-owner all_to_all and the all_gather
+    broadcast fallback), with all four invariants (VERDICT r3 item 4b)."""
+    m = kip320.make_model(Config(3, 2, 2, 2))
+    res = check_sharded(
+        m,
+        min_bucket=4096,
+        chunk_size=16384,
+        store_trace=False,
+        exchange=exchange,
+        visited_backend="device-hash",
+    )
+    assert res.ok, exchange
+    assert res.total == 737_794, (exchange, res.total)
+    assert res.diameter == 25, (exchange, res.diameter)
+    assert res.stats["devices"] == 8
