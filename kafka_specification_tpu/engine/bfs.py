@@ -112,28 +112,66 @@ class _Step:
                 pass  # exotic model object without attribute support
         self._cache = cache
 
-    def expand_width(self, bucket: int, shift: int) -> int:
-        """Candidate rows produced by make_expand(bucket, shift)."""
-        return (max(1, bucket >> shift) if shift else bucket) * self.C
+    def norm_widths(self, bucket: int, compact):
+        """Normalize a compact spec to per-action buffer widths (rows).
 
-    def make_expand(self, bucket: int, shift: int):
+        compact: None/0 -> full path (returns None); int -> the uniform
+        legacy form, W_a = n_choices_a * (bucket >> compact); sequence ->
+        explicit per-action widths, clamped to the action's full lattice
+        width (at which overflow is impossible)."""
+        acts = self.model.actions
+        if not compact:
+            return None
+        if isinstance(compact, int):
+            if (bucket >> compact) < 1:
+                return None
+            return tuple(max(1, bucket >> compact) * a.n_choices for a in acts)
+        ws = tuple(
+            min(max(1, int(w)), bucket * a.n_choices)
+            for w, a in zip(compact, acts)
+        )
+        assert len(ws) == len(acts), (len(ws), len(acts))
+        return ws
+
+    def expand_width(self, bucket: int, compact) -> int:
+        """Candidate rows produced by make_expand(bucket, compact)."""
+        widths = self.norm_widths(bucket, compact)
+        return bucket * self.C if widths is None else sum(widths)
+
+    def make_expand(self, bucket: int, shift):
         """Expansion kernel: (states[B], fvalid[B]) ->
         (en_pre[B, C], cand[T, K], valid[T], parent[T], actid[T],
-         act_en[n_actions], overflow) with T = expand_width(bucket, shift).
+         act_en[n_actions], act_guard[n_actions], overflow[n_actions])
+        with T = expand_width(bucket, shift).  act_en counts enabled
+        successors post-CONSTRAINT (the action-coverage histogram);
+        act_guard counts guard-enabled pairs pre-CONSTRAINT — the load the
+        compact buffers actually hold, hence what adaptive sizing must
+        track (on constraint-pruning models like AsyncIsr the two can
+        differ widely).
 
-        shift=0: one phase over the full padded lattice (T = B*C).
-        shift>0: two phases — a full-lattice guard sweep whose state
+        shift falsy (or an int shifting the bucket away): one phase over
+        the full padded lattice (T = B*C; overflow is constant False).
+        otherwise: two phases — a full-lattice guard sweep whose state
         *updates* are dead code (XLA eliminates them; guards alone are a few
         % of the kernel cost), then per-action compaction of the enabled
-        (state, choice) pairs into n_choices*(B>>shift) rows where the
-        kernel, functional update, constraint and lane packing actually run.
-        overflow=True iff some action enabled more pairs than its compact
-        buffer holds — the caller must re-run at a smaller shift; outputs
-        are incomplete in that case but never wrong-state (valid rows are
-        always real successors)."""
+        (state, choice) pairs into a W_a-row buffer where the kernel,
+        functional update, constraint and lane packing actually run.
+        `shift` may be a single int (the uniform legacy form,
+        W_a = n_choices_a * (B >> shift)) or a per-action width sequence —
+        enablement density varies an order of magnitude across actions
+        (26-29%% for LeaderWrite/BecomeLeader/Truncate vs <0.1%% for the
+        fenced ISR mutations on the deep 5-broker workload), so per-action
+        widths sized from measured enablement avoid both the dense
+        actions' overflow-retry and the sparse actions' padding waste.
+        overflow[a]=True iff action `a` enabled more pairs than its W_a
+        buffer holds — the caller must re-run with a wider buffer for that
+        action; outputs are incomplete in that case but never wrong-state
+        (valid rows are always real successors)."""
         model, spec = self.model, self.spec
         C = self.C
         act_ids = self.act_ids
+        widths = self.norm_widths(bucket, shift)
+        n_actions = len(model.actions)
         # action boundaries for the enablement histogram (TLC's action
         # coverage analogue, SURVEY.md §5 "Metrics")
         bounds = np.cumsum([0] + [a.n_choices for a in model.actions])
@@ -143,9 +181,19 @@ class _Step:
         def _expand_full(states, fvalid):
             en_pre, en, packed = jax.vmap(self._expand_one)(states)  # [B,C]x2, [B,C,K]
             en = en & fvalid[:, None]
+            guard_en = en_pre & fvalid[:, None]
             act_en = jnp.stack(
                 [
                     jnp.sum(en[:, bounds[i] : bounds[i + 1]], dtype=jnp.int32)
+                    for i in range(len(model.actions))
+                ]
+            )
+            act_guard = jnp.stack(
+                [
+                    jnp.sum(
+                        guard_en[:, bounds[i] : bounds[i + 1]],
+                        dtype=jnp.int32,
+                    )
                     for i in range(len(model.actions))
                 ]
             )
@@ -159,7 +207,8 @@ class _Step:
                 flat // C,
                 act_ids[flat % C],
                 act_en,
-                jnp.bool_(False),
+                act_guard,
+                jnp.zeros((n_actions,), bool),
             )
 
         def _expand_compact(states, fvalid):
@@ -173,14 +222,15 @@ class _Step:
 
             en_pre = jax.vmap(_guards_one)(states)  # [B, C] pre-constraint
             cand_parts, valid_parts, parent_parts, act_parts = [], [], [], []
-            act_en_parts, ovf_parts = [], []
+            act_en_parts, act_guard_parts, ovf_parts = [], [], []
             for ai, a in enumerate(model.actions):
                 na = a.n_choices
-                W = max(1, B >> shift) * na
+                W = widths[ai]
                 ga = (en_pre[:, bounds[ai] : bounds[ai + 1]] & fvalid[:, None]).reshape(
                     B * na
                 )
                 n_en = jnp.sum(ga, dtype=jnp.int32)
+                act_guard_parts.append(n_en)
                 ovf_parts.append(n_en > W)
                 cpos = jnp.where(ga, jnp.cumsum(ga) - 1, W)
                 cidx = jnp.zeros((W,), jnp.int32).at[cpos].set(
@@ -206,10 +256,11 @@ class _Step:
                 jnp.concatenate(parent_parts),
                 jnp.concatenate(act_parts),
                 jnp.stack(act_en_parts),
-                jnp.any(jnp.stack(ovf_parts)),
+                jnp.stack(act_guard_parts),
+                jnp.stack(ovf_parts),
             )
 
-        return _expand_compact if shift else _expand_full
+        return _expand_compact if widths is not None else _expand_full
 
     def _expand_one(self, state: dict):
         """All successors of one state: (enabled_pre_constraint[C],
@@ -238,15 +289,39 @@ class _Step:
         vcap: int,
         with_invariants: bool = True,
         with_merge: bool = True,
-        compact: Optional[int] = None,
+        compact=None,
+        squeeze_full: bool = False,
     ):
         # use_pallas is in the key because the cache outlives this _Step
         # (it is shared per Model) and KSPEC_USE_PALLAS can toggle between
-        # check() calls (scripts/tpu_window.py does exactly that)
-        key = (bucket, vcap, with_invariants, with_merge, compact, self.use_pallas)
+        # check() calls (scripts/tpu_window.py does exactly that).
+        # squeeze_full only changes the program on the uniform-shift
+        # compact path (per-action and full paths already run T = T_exp) —
+        # normalize it so the sticky flag can't force recompiles of
+        # byte-identical steps under fresh keys
+        squeeze_full = (
+            squeeze_full
+            and isinstance(compact, int)
+            and self.norm_widths(bucket, compact) is not None
+        )
+        compact_key = (
+            tuple(compact) if isinstance(compact, (list, tuple)) else compact
+        )
+        key = (
+            bucket,
+            vcap,
+            with_invariants,
+            with_merge,
+            compact_key,
+            squeeze_full,
+            self.use_pallas,
+        )
         if key not in self._cache:
             self._cache[key] = jax.jit(
-                self.build_raw(bucket, vcap, with_invariants, with_merge, compact)
+                self.build_raw(
+                    bucket, vcap, with_invariants, with_merge, compact,
+                    squeeze_full,
+                )
             )
         return self._cache[key]
 
@@ -256,28 +331,35 @@ class _Step:
         vcap: int,
         with_invariants: bool = True,
         with_merge: bool = True,
-        compact: Optional[int] = None,
+        compact=None,
+        squeeze_full: bool = False,
     ):
         """The un-jitted level step (frontier, fvalid, vhi, vlo, vn) -> ...;
         exposed for the driver's compile checks and custom jit wrapping.
         with_merge=False skips the visited-set merge (host FpSet backend).
 
-        compact: a right-shift amount (1, 2, ...) enabling the two-phase
-        expansion.  Phase A sweeps all guards over the full padded choice
-        lattice with the state *updates* dead-code-eliminated by XLA (guards
-        alone are ~3% of the kernel cost — the expensive parts, the
-        functional updates and the lane packing, never run for disabled
-        candidates).  Phase B compacts each action's enabled (state, choice)
-        pairs into a buffer of W_a = n_choices_a * (bucket >> compact) rows
-        and re-runs that action's kernel, update and pack at the compacted
-        width only.  The sort / visited-probe / merge then also run at the
-        compacted total width (only a few percent of the lattice is ever
-        enabled — RESULTS.md measures ~6% on Kip320).  The step returns
-        overflow=True iff some action enabled more pairs than its buffer
-        holds, in which case its outputs are INCOMPLETE and the caller must
-        re-run the chunk at a smaller shift (the host loop retries; results
-        stay exact either way)."""
-        return self._build(bucket, vcap, with_invariants, with_merge, compact)
+        compact: a right-shift amount — one int (uniform) or a per-action
+        sequence — enabling the two-phase expansion.  Phase A sweeps all
+        guards over the full padded choice lattice with the state *updates*
+        dead-code-eliminated by XLA (guards alone are ~3% of the kernel
+        cost — the expensive parts, the functional updates and the lane
+        packing, never run for disabled candidates).  Phase B compacts each
+        action's enabled (state, choice) pairs into a buffer of
+        W_a = n_choices_a * (bucket >> shift_a) rows and re-runs that
+        action's kernel, update and pack at the compacted width only.  The
+        sort / visited-probe / merge then also run at the compacted total
+        width (only a few percent of the lattice is ever enabled —
+        RESULTS.md measures ~6% on Kip320).  The step returns a per-action
+        overflow vector (plus one trailing squeeze-overflow flag): where
+        set, that action enabled more pairs than its buffer holds, the
+        outputs are INCOMPLETE, and the caller must re-run the chunk with a
+        smaller shift for that action (the host loop retries and adapts;
+        results stay exact either way).  squeeze_full=True disables the
+        pre-sort squeeze width reduction (the retry fallback when the
+        squeeze itself overflows)."""
+        return self._build(
+            bucket, vcap, with_invariants, with_merge, compact, squeeze_full
+        )
 
     def _build(
         self,
@@ -285,24 +367,30 @@ class _Step:
         vcap: int,
         with_invariants: bool,
         with_merge: bool = True,
-        compact: Optional[int] = None,
+        compact=None,
+        squeeze_full: bool = False,
     ):
         spec, model = self.spec, self.model
         C, K = self.C, self.K
-        shift = int(compact) if compact else 0
-        if shift and (bucket >> shift) < 1:
-            shift = 0
-        expand = self.make_expand(bucket, shift)
+        widths = self.norm_widths(bucket, compact)
+        per_action = isinstance(compact, (list, tuple))
+        shift = widths is not None  # truthy iff the compact path is on
+        expand = self.make_expand(bucket, compact)
         # Candidate width the sort/probe/outputs run at.  On the compact
-        # path a second-stage squeeze gathers the enabled candidates (the
-        # per-action buffers are ~4x oversized by design, so ~25% occupied)
-        # into a T/2 buffer before fingerprint/sort/probe — the sort is the
+        # path a second-stage squeeze gathers the enabled candidates into a
+        # narrower buffer before fingerprint/sort/probe — the sort is the
         # single most expensive stage, and its cost is set by this width.
-        # Squeeze overflow reuses the existing retry: the host re-runs at a
-        # smaller compact shift, and the shift=0 full path never squeezes,
-        # so results stay exact at every density.
-        T_exp = self.expand_width(bucket, shift)
-        T = max(256, T_exp >> 1) if shift else T_exp
+        # Uniform-shift buffers are ~4x oversized (~25% occupied), so the
+        # squeeze halves (squeeze overflow re-runs with squeeze_full — the
+        # retry keeps results exact at every density).  Per-action widths
+        # are already sized tight from measured enablement, so T is the
+        # full compact width and the squeeze cannot overflow (it only
+        # compacts rows to the front for the fingerprint/output stages).
+        T_exp = self.expand_width(bucket, compact)
+        if not shift or squeeze_full or per_action:
+            T = T_exp
+        else:
+            T = max(256, T_exp >> 1)
 
         # Host-FpSet backend: the device holds no visited set, and the
         # native C++ open-addressing FpSet already dedups both in-batch and
@@ -330,17 +418,17 @@ class _Step:
         def fp_masked(cand, valid):
             """Masked (hi, lo) fingerprints (Pallas opt-in or jnp path)."""
             if self.use_pallas:
+                import math
+
                 from ..ops.pallas_fingerprint import fingerprint_pallas
 
                 interp = jax.default_backend() == "cpu"
-                # block_rows must divide the buffer width: the squeezed
-                # compact buffer is (bucket>>(shift+1))*C rows; the full
-                # lattice is bucket*C
-                block = (
-                    max(1, bucket >> (shift + 1))
-                    if shift
-                    else C * min(bucket, 256)
-                )
+                # block_rows must divide the buffer width (the largest
+                # power-of-two divisor, capped at 8k rows/block): every
+                # buffer here is 1024-aligned or a power-of-two multiple
+                # of C, so blocks stay >= 256 rows
+                rows = cand.shape[0]
+                block = math.gcd(rows, 1 << 13)
                 return fingerprint_pallas(
                     cand, valid, block_rows=block, interpret=interp
                 )
@@ -365,18 +453,35 @@ class _Step:
 
         def step(frontier, fvalid, vhi, vlo, vn):
             states = jax.vmap(spec.unpack)(frontier)
-            en_pre, cand, valid, parent, actid, act_en, overflow = expand(
-                states, fvalid
-            )
+            (
+                en_pre,
+                cand,
+                valid,
+                parent,
+                actid,
+                act_en,
+                act_guard,
+                exp_ovf,
+            ) = expand(states, fvalid)
             deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
             dl_any = jnp.any(deadlocked)
             dl_idx = jnp.argmax(deadlocked)
 
+            # overflow contract: bool[n_actions + 1] — per-action compact-
+            # buffer overflow plus one trailing squeeze-overflow flag
+            def ovf_vec(sq_ovf=None):
+                tail = (
+                    jnp.zeros((1,), bool)
+                    if sq_ovf is None
+                    else jnp.atleast_1d(sq_ovf)
+                )
+                return jnp.concatenate([exp_ovf, tail])
+
             if host_dedup:
-                out, out_parent, out_act, rowvalid, n_en, ovf = squeeze(
+                out, out_parent, out_act, rowvalid, n_en, sq_ovf = squeeze(
                     cand, parent, actid, valid, T
                 )
-                overflow = overflow | ovf
+                overflow = ovf_vec(sq_ovf)
                 out_hi, out_lo = fp_masked(out, rowvalid)
                 viol_any, viol_idx = frontier_invariants(states, fvalid)
                 return (
@@ -395,13 +500,16 @@ class _Step:
                     out_hi,
                     out_lo,
                     overflow,
+                    act_guard,
                 )
 
             if shift:
-                cand, parent, actid, valid, _, ovf = squeeze(
+                cand, parent, actid, valid, _, sq_ovf = squeeze(
                     cand, parent, actid, valid, T
                 )
-                overflow = overflow | ovf
+                overflow = ovf_vec(sq_ovf)
+            else:
+                overflow = ovf_vec()
 
             hi, lo = fp_masked(cand, valid)
             # minimal-payload sort: only the original index rides through the
@@ -447,6 +555,7 @@ class _Step:
                 out_hi,
                 out_lo,
                 overflow,
+                act_guard,
             )
 
         return step
@@ -758,6 +867,34 @@ def check(
 
     chunk = _next_pow2(max(min_bucket, chunk_size))
 
+    # Adaptive per-action compact sizing (two-phase expansion, SURVEY §2.3):
+    # enablement density varies two orders of magnitude across actions
+    # (deep 5-broker chunks: LeaderWrite/Truncate at 26-29% of their
+    # lattice vs fenced ISR mutations at <0.1%), so each action's compact
+    # buffer is sized from the run's measured high-water enablement
+    # (act_hw, enabled pairs per frontier state) with ~1.35x headroom,
+    # rounded up to a power of two so compiled shapes stay few, with
+    # overflow-learned floors.  The first chunks run at the uniform
+    # compact_shift legacy sizing; shapes stabilize once the high-water
+    # marks plateau (a handful of compiles per run).
+    n_actions = len(model.actions)
+    act_hw = np.zeros(n_actions, np.float64)
+    act_w_floor = np.zeros(n_actions, np.int64)
+    squeeze_full = False
+
+    def widths_for(bucket):
+        """compact arg for this bucket: per-action widths, the uniform
+        legacy shift (no measurements yet), or None (full path)."""
+        if compact_shift <= 0 or bucket < 4096:
+            return None
+        if not act_hw.any():
+            return compact_shift
+        out = []
+        for a, hw, floor in zip(model.actions, act_hw, act_w_floor):
+            w = _next_pow2(max(256, int(1.35 * hw * bucket) + 1, int(floor)))
+            out.append(min(w, bucket * a.n_choices))
+        return tuple(out)
+
     while frontier_np.shape[0] > 0:
         if max_depth is not None and depth >= max_depth:
             break
@@ -808,15 +945,16 @@ def check(
             # retry is chunk-local: one dense chunk must not degrade
             # compaction for the rest of a long run) — exact results either
             # way, the shift is purely a performance knob.
-            sh_try = compact_shift
+            compact_arg = widths_for(bucket)
+            attempt_sq_full = squeeze_full
             while True:
-                sh = sh_try if (sh_try > 0 and bucket >= 4096) else 0
                 step = step_builder.get(
                     bucket,
                     vcap,
                     check_invariants,
                     with_merge=visited_backend == "device",
-                    compact=sh or None,
+                    compact=compact_arg,
+                    squeeze_full=attempt_sq_full,
                 )
                 (
                     out,
@@ -834,6 +972,7 @@ def check(
                     out_hi,
                     out_lo,
                     overflow,
+                    act_guard,
                 ) = step(
                     jnp.asarray(_pad_rows(piece, bucket)),
                     jnp.arange(bucket) < fp_n,
@@ -841,10 +980,40 @@ def check(
                     vlo,
                     vn,
                 )
-                if sh == 0 or not bool(overflow):
+                ovf = np.asarray(overflow)
+                if compact_arg is None or not ovf.any():
                     vhi, vlo, vn = vhi_n, vlo_n, vn_n
                     break
-                sh_try -= 1
+                # retry this chunk with the offending buffers widened: a
+                # per-action compact overflow doubles that action's width
+                # (floored for the rest of the run); a squeeze overflow
+                # disables the pre-sort width reduction (sticky); a
+                # uniform-shift overflow steps toward the full path
+                if ovf[-1]:
+                    attempt_sq_full = squeeze_full = True
+                if ovf[:-1].any():
+                    if isinstance(compact_arg, int):
+                        compact_arg = (
+                            compact_arg - 1 if compact_arg > 1 else None
+                        )
+                    else:
+                        compact_arg = tuple(
+                            min(2 * w, bucket * a.n_choices) if o else w
+                            for w, o, a in zip(
+                                compact_arg, ovf[:-1], model.actions
+                            )
+                        )
+                        for ai, o in enumerate(ovf[:-1]):
+                            if o:
+                                act_w_floor[ai] = max(
+                                    act_w_floor[ai], compact_arg[ai]
+                                )
+            # adapt buffer sizing from the committed attempt's
+            # PRE-constraint guard counts (what the buffers actually hold;
+            # act_en is post-constraint and undercounts on pruning models)
+            act_en_np = np.asarray(act_en, np.int64)
+            act_guard_np = np.asarray(act_guard, np.int64)
+            np.maximum(act_hw, act_guard_np / max(fp_n, 1), out=act_hw)
             # frontier-level verdicts (states being expanded = level `depth`)
             if check_invariants:
                 viol_any_np = np.asarray(viol_any)
@@ -897,7 +1066,7 @@ def check(
                 lvl_act.append(np.asarray(out_act[:nn]))
                 lvl_new += nn
             if collect_stats:
-                lvl_act_en += np.asarray(act_en, np.int64)
+                lvl_act_en += act_en_np
 
         if verdict is not None:
             kind, idx, inv_name = verdict

@@ -166,7 +166,7 @@ def _make_sharded_step(
         me = jax.lax.axis_index("d")
 
         states = jax.vmap(spec.unpack)(frontier)
-        en_pre, cand, valid, parent, actid, act_en, ovf_expand = expand(
+        en_pre, cand, valid, parent, actid, act_en, _act_guard, ovf_expand = expand(
             states, fvalid
         )
         deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
@@ -288,7 +288,9 @@ def _make_sharded_step(
             jnp.any(deadlocked)[None],
             jnp.argmax(deadlocked)[None],
             act_en[None],  # [1, n_actions] -> [D, n_actions]
-            ovf_expand[None],
+            # make_expand reports per-action overflow; the sharded retry is
+            # uniform-shift, so collapse to one flag per shard
+            jnp.any(ovf_expand)[None],
             ovf_dest[None],
             ovf_probe[None],  # device-hash probe-budget overflow
             out_hi,  # [R] per shard (host-FpSet backend reads these)

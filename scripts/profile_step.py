@@ -81,7 +81,7 @@ def main():
 
     exp_j = jax.jit(lambda s, v: expand(s, v))
     t_expand = timeit(exp_j, states, fv)
-    en_pre, cand, valid, parent, actid, act_en, ovf = exp_j(states, fv)
+    en_pre, cand, valid, parent, actid, act_en, act_guard, ovf = exp_j(states, fv)
     print(f"enabled={int(valid.sum())} of {valid.shape[0]}")
 
     # guards-only timing: build expand with shift but measure phase A alone

@@ -38,13 +38,23 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--partitions", type=int, default=3)
     ap.add_argument("--chunk-size", type=int, default=131072)
+    ap.add_argument(
+        "--base",
+        choices=["tiny", "2r"],
+        default="tiny",
+        help="base factor: tiny = Kip320 (2r,L2,R1,E1) = 277 states; "
+        "2r = Kip320 (2r,L2,R2,E2) = 5,973 states (5,973^2 = 35,676,729 "
+        "— the next closed-form decade, VERDICT r3 item 6)",
+    )
     args = ap.parse_args()
 
-    tiny = Config(2, 2, 1, 1)
-    base_total = oracle_bfs(kip320.make_oracle(tiny), keep_level_sets=False).total
-    print(f"# base Kip320 TINY: {base_total} states (oracle)", flush=True)
+    base_cfg = Config(2, 2, 1, 1) if args.base == "tiny" else Config(2, 2, 2, 2)
+    base_total = oracle_bfs(
+        kip320.make_oracle(base_cfg), keep_level_sets=False
+    ).total
+    print(f"# base Kip320 {args.base}: {base_total} states (oracle)", flush=True)
 
-    model = product_model(kip320.make_model(tiny), args.partitions)
+    model = product_model(kip320.make_model(base_cfg), args.partitions)
     golden = base_total ** args.partitions
     print(
         f"# product^{args.partitions}: expect {golden:,} distinct states; "

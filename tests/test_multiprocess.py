@@ -1,21 +1,21 @@
 """Real multi-process (DCN-regime) execution of the sharded engine.
 
-Two OS processes join one jax.distributed job on localhost (the same
+N OS processes join one jax.distributed job on localhost (the same
 `jax.distributed.initialize` path a TPU pod uses, with the coordinator on
-127.0.0.1 and 2 virtual CPU devices per process -> a 4-device global
-mesh).  Both processes run the identical replicated host loop
-(parallel/multihost.py) and must agree on exact distinct-state counts —
-through BOTH visited backends:
+127.0.0.1 and 1-2 virtual CPU devices per process).  Every process runs
+the identical replicated host loop (parallel/multihost.py) and must agree
+on exact distinct-state counts — through BOTH visited backends:
 
 - device: per-shard sorted sets in (virtual) device memory;
 - host: per-HOST FpSet ownership — each process keeps C++ sets only for
   the shards whose devices it hosts, and the novelty masks are OR-merged
   across processes (multihost.or_across_processes).
 
-This is the test VERDICT r2 item 5 asked for: nothing about the
-multi-host path executes only in the degenerate single-process regime
-anymore.  Slow marker: two fresh interpreters each pay their own XLA
-compile chain (~1 min here).
+Coverage (VERDICT r2 item 5 + r3 item 5): 2 processes x 2 devices, 4
+processes x 1 device (one owned shard per process — the TLC distributed-
+mode shape), and a 4-process checkpoint/resume cycle across two separate
+jax.distributed jobs (coordinator-only main file + per-host part files).
+Slow marker: each fresh interpreter pays its own XLA compile chain.
 """
 
 import json
@@ -35,16 +35,17 @@ import json, sys
 from kafka_specification_tpu.utils.platform_guard import pin_cpu_in_process
 pin_cpu_in_process()
 import jax
-jax.config.update(
-    "jax_compilation_cache_dir", sys.argv[3],
-)
+cfg = json.loads(sys.argv[1])
+jax.config.update("jax_compilation_cache_dir", cfg["cache"])
 from kafka_specification_tpu.parallel.multihost import init_distributed
 info = init_distributed()
 from kafka_specification_tpu.models import finite_replicated_log as frl
 from kafka_specification_tpu.parallel.sharded import check_sharded
-model = frl.make_model(3, 4, int(sys.argv[2]))
+model = frl.make_model(3, 4, cfg["max_records"])
 res = check_sharded(model, min_bucket=64, store_trace=False,
-                    visited_backend=sys.argv[1])
+                    visited_backend=cfg["backend"],
+                    max_depth=cfg.get("max_depth"),
+                    checkpoint_dir=cfg.get("ckpt"))
 print("RESULT " + json.dumps({
     "pid": info["process_id"], "procs": info["process_count"],
     "devices": info["global_devices"], "total": res.total,
@@ -60,27 +61,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_process(visited_backend: str, max_records: int):
+def _run_procs(worker_cfg: dict, n_procs: int = 2, devs_per_proc: int = 2):
+    worker_cfg = {"cache": os.path.join(_REPO, ".jax_cache"), **worker_cfg}
     port = _free_port()
     procs = []
-    for pid in range(2):
+    for pid in range(n_procs):
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devs_per_proc}"
+        )
         env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_NUM_PROCESSES"] = str(n_procs)
         env["JAX_PROCESS_ID"] = str(pid)
         procs.append(
             subprocess.Popen(
-                [
-                    sys.executable,
-                    "-c",
-                    _WORKER,
-                    visited_backend,
-                    str(max_records),
-                    os.path.join(_REPO, ".jax_cache"),
-                ],
+                [sys.executable, "-c", _WORKER, json.dumps(worker_cfg)],
                 env=env,
                 cwd=_REPO,
                 stdout=subprocess.PIPE,
@@ -106,7 +103,7 @@ def _run_two_process(visited_backend: str, max_records: int):
 def test_two_process_device_backend_exact_counts():
     """FRL (3,4,1) = 125 states: both processes of a 2-process / 4-device
     job report the identical exhaustive result."""
-    outs = _run_two_process("device", 1)
+    outs = _run_procs({"backend": "device", "max_records": 1})
     for o in outs:
         assert o["procs"] == 2 and o["devices"] == 4
         assert o["ok"] and o["total"] == 125
@@ -119,7 +116,7 @@ def test_two_process_host_fpset_per_host_ownership():
     exact global count on both processes, and each process holds sets ONLY
     for its own 2 of the 4 shards (the other entries are None) — inserts
     are no longer replicated per process."""
-    outs = _run_two_process("host", 2)
+    outs = _run_procs({"backend": "host", "max_records": 2})
     for o in outs:
         assert o["ok"] and o["total"] == 29791
         sizes = o["host_sizes"]
@@ -137,3 +134,49 @@ def test_two_process_host_fpset_per_host_ownership():
         (a is None) != (b is None)
         for a, b in zip(outs[0]["host_sizes"], outs[1]["host_sizes"])
     )
+
+
+def test_four_process_single_device_each_exact_counts():
+    """4 processes x 1 device — the TLC distributed-mode shape (one owned
+    shard per process, every exchange crossing a process boundary): exact
+    29,791-state agreement on all four processes, per-host FpSet ownership
+    covering each shard exactly once."""
+    outs = _run_procs(
+        {"backend": "host", "max_records": 2}, n_procs=4, devs_per_proc=1
+    )
+    assert {o["pid"] for o in outs} == {0, 1, 2, 3}
+    for o in outs:
+        assert o["procs"] == 4 and o["devices"] == 4
+        assert o["ok"] and o["total"] == 29791
+        sizes = o["host_sizes"]
+        assert len(sizes) == 4
+        assert len([s for s in sizes if s is not None]) == 1
+        assert sizes[o["pid"]] is not None  # owns exactly its own shard
+    assert len({tuple(o["levels"]) for o in outs}) == 1
+    assert sum(o["host_sizes"][o["pid"]] for o in outs) == 29791
+
+
+def test_four_process_checkpoint_resume(tmp_path):
+    """Checkpoint under one 4-process job, resume under a SECOND 4-process
+    job: the coordinator writes the single main checkpoint, every process
+    writes its own host-FpSet part file, and the resumed job completes to
+    the exact global count (all-process resume, VERDICT r3 item 5)."""
+    ckdir = str(tmp_path / "mck")
+    partial = _run_procs(
+        {"backend": "host", "max_records": 2, "ckpt": ckdir, "max_depth": 6},
+        n_procs=4,
+        devs_per_proc=1,
+    )
+    assert all(o["total"] < 29791 for o in partial)
+    files = sorted(os.listdir(ckdir))
+    assert "sharded_checkpoint.npz" in files  # coordinator's main file
+    for pid in range(4):  # per-host part files (per-host set ownership)
+        assert f"sharded_checkpoint.npz.host{pid}" in files
+    resumed = _run_procs(
+        {"backend": "host", "max_records": 2, "ckpt": ckdir},
+        n_procs=4,
+        devs_per_proc=1,
+    )
+    for o in resumed:
+        assert o["ok"] and o["total"] == 29791
+    assert sum(o["host_sizes"][o["pid"]] for o in resumed) == 29791
