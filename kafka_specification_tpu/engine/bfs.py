@@ -126,12 +126,11 @@ class _Step:
             if (bucket >> compact) < 1:
                 return None
             return tuple(max(1, bucket >> compact) * a.n_choices for a in acts)
-        ws = tuple(
+        assert len(compact) == len(acts), (len(compact), len(acts))
+        return tuple(
             min(max(1, int(w)), bucket * a.n_choices)
             for w, a in zip(compact, acts)
         )
-        assert len(ws) == len(acts), (len(ws), len(acts))
-        return ws
 
     def expand_width(self, bucket: int, compact) -> int:
         """Candidate rows produced by make_expand(bucket, compact)."""
@@ -941,10 +940,12 @@ def check(
             # enabled width (a few % of M) instead of the padded-lattice
             # width.  On overflow (an action enabled more pairs than its
             # compact buffer holds) the visited set returned by the step is
-            # discarded and THIS chunk re-runs at double the width (the
-            # retry is chunk-local: one dense chunk must not degrade
-            # compaction for the rest of a long run) — exact results either
-            # way, the shift is purely a performance knob.
+            # discarded and THIS chunk re-runs with the offending buffers
+            # doubled; the learned floors (act_w_floor) and the
+            # squeeze_full flag persist for the rest of the run so a
+            # recurring density doesn't re-pay the retry every chunk —
+            # exact results either way, sizing is purely a performance
+            # knob.
             compact_arg = widths_for(bucket)
             attempt_sq_full = squeeze_full
             while True:

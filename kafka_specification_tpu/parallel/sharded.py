@@ -700,8 +700,6 @@ def check_sharded(
                     need = int(fetch_global(dev_vn).max()) + R
                     if need > vcap:
                         vcap = _next_pow2(need)
-                        from .multihost import is_multiprocess
-
                         if is_multiprocess():
                             # host round-trip: every process needs the full
                             # global array to contribute its shards

@@ -112,8 +112,12 @@ def main():
     # per-action enablement from a full-lattice sweep, then the same
     # 1.35x/pow2 sizing check()'s widths_for applies
     step0 = sb.get(bucket, vcap, True, with_merge=False, compact=None)
-    act_en0 = np.asarray(step0(fr, fv, vhi, vlo, vn)[11], np.int64)
-    hw0 = act_en0 / fp_n
+    out0 = step0(fr, fv, vhi, vlo, vn)
+    act_en0 = np.asarray(out0[11], np.int64)
+    # size from PRE-constraint guard counts (out[15]) exactly as check()'s
+    # widths_for does — act_en undercounts on constraint-pruning models
+    act_guard0 = np.asarray(out0[15], np.int64)
+    hw0 = act_guard0 / fp_n
     widths = tuple(
         min(
             _next_pow2(max(256, int(1.35 * h * bucket) + 1)),

@@ -47,10 +47,20 @@ def test_sharded_on_mesh_subset():
 
 
 def test_sharded_chunked_levels_exact_count():
-    """chunk_size well below the peak per-shard frontier (FRL(3,4,2) peaks at
-    ~1k rows/shard; the floor clamp is 32) forces several step calls per
-    level; counts must still be exact (cross-chunk dedup via the per-shard
-    visited sets)."""
+    """chunk_size well below the peak per-shard frontier forces several
+    step calls per level; counts must still be exact (cross-chunk dedup
+    via the per-shard visited sets).  FRL(3,3,2) = 15^3 = 3,375 closed
+    form; the 29,791 version runs as slow below."""
+    res = check_sharded(
+        frl.make_model(3, 3, 2), min_bucket=8, chunk_size=128, store_trace=False
+    )
+    assert res.ok
+    assert res.total == 3375
+    assert res.diameter == 9
+
+
+@pytest.mark.slow
+def test_sharded_chunked_levels_exact_count_29791():
     res = check_sharded(
         frl.make_model(3, 4, 2), min_bucket=8, chunk_size=128, store_trace=False
     )
