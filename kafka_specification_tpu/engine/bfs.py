@@ -731,7 +731,11 @@ def check(
                 max(_HASH_MIN_CAP, 4 * (visited_capacity_hint or 0))
             ),
         )
-        ht_claim = hashset.new_claim(ht_hi.shape[0])
+        ht_claim = (
+                None
+                if step_builder.use_pallas
+                else hashset.new_claim(ht_hi.shape[0])
+            )
         hash_n = n0
         vcap = 64  # placeholder shapes for the step signature
         vhi = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
@@ -823,7 +827,11 @@ def check(
                 ht_hi, ht_lo = hashset.table_from_pairs(
                     live_hi, live_lo, min_cap=_HASH_MIN_CAP
                 )
-                ht_claim = hashset.new_claim(ht_hi.shape[0])
+                ht_claim = (
+                None
+                if step_builder.use_pallas
+                else hashset.new_claim(ht_hi.shape[0])
+            )
             else:
                 vcap = int(snap["vcap"])
                 n = int(snap["vn"])
@@ -935,7 +943,11 @@ def check(
                 ht_hi, ht_lo = hashset.rehash_into(
                     ht_hi, ht_lo, 2 * ht_hi.shape[0]
                 )
-                ht_claim = hashset.new_claim(ht_hi.shape[0])
+                ht_claim = (
+                None
+                if step_builder.use_pallas
+                else hashset.new_claim(ht_hi.shape[0])
+            )
             # Candidate compaction: expand/pack/sort/probe/merge at the
             # enabled width (a few % of M) instead of the padded-lattice
             # width.  On overflow (an action enabled more pairs than its
@@ -1045,16 +1057,36 @@ def check(
                 valid = jnp.arange(out_hi.shape[0]) < new_n
                 isnew = np.zeros(out_hi.shape[0], bool)
                 while True:
-                    ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
-                        ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
-                    )
+                    if step_builder.use_pallas:
+                        # Pallas probe kernel (ops/pallas_hashset) — the
+                        # actual TPU dedup kernel a live hardware window
+                        # profiles; interpret mode on CPU, bit-identical
+                        # winners (tests/test_pallas.py)
+                        from ..ops.pallas_hashset import probe_insert_pallas
+
+                        ht_hi, ht_lo, m, _ni, ovf = probe_insert_pallas(
+                            ht_hi,
+                            ht_lo,
+                            out_hi,
+                            out_lo,
+                            valid,
+                            interpret=jax.default_backend() == "cpu",
+                        )
+                    else:
+                        ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
+                            ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
+                        )
                     isnew |= np.asarray(m)
                     if not bool(ovf):
                         break
                     ht_hi, ht_lo = hashset.rehash_into(
                         ht_hi, ht_lo, 2 * ht_hi.shape[0]
                     )
-                    ht_claim = hashset.new_claim(ht_hi.shape[0])
+                    ht_claim = (
+                None
+                if step_builder.use_pallas
+                else hashset.new_claim(ht_hi.shape[0])
+            )
                 mask = isnew[:nn]
                 hash_n += int(mask.sum())
                 lvl_rows.append(np.asarray(out[:nn])[mask])

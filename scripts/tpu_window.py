@@ -125,9 +125,24 @@ def _run_stages(record, stage):
     try:
         res_p = check(frl.make_model(3, 4, 2), min_bucket=4096)
         record["pallas"] = {"states": res_p.total, "ok": res_p.total == 29791}
+        stage("pallas_fingerprint", t0)
+        # Pallas hash-probe kernel (ops/pallas_hashset) through the
+        # device-hash backend — the ACTUAL TPU dedup kernel, profiled on
+        # hardware for the first time in any window that reaches here
+        t0 = time.perf_counter()
+        res_hp = check(
+            frl.make_model(3, 4, 2, force_hashed=True),
+            min_bucket=4096,
+            visited_backend="device-hash",
+        )
+        record["pallas_hash_probe"] = {
+            "states": res_hp.total,
+            "ok": res_hp.total == 29791,
+            "states_per_sec": round(res_hp.states_per_sec, 1),
+        }
     finally:
         os.environ.pop("KSPEC_USE_PALLAS", None)
-    stage("pallas_fingerprint", t0)
+    stage("pallas_hash_probe", t0)
 
     # sharded engine on the chip (mesh of all real devices; 1 on this box)
     t0 = time.perf_counter()
