@@ -889,12 +889,24 @@ def check(
     act_w_floor = np.zeros(n_actions, np.int64)
     squeeze_full = False
 
+    import os as _os
+
+    adaptive_on = _os.environ.get("KSPEC_ADAPTIVE_COMPACT", "1") != "0"
+    # Escalation policy: the uniform shift is CHEAPER when it fits (its
+    # pre-sort squeeze halves the fingerprint width, and 9 pow2-padded
+    # per-action buffers overshoot on sparse workloads — measured 131.8k
+    # vs 93.9k states/sec on the 3r flagship), so per-action widths
+    # activate only once a uniform attempt actually overflows (the dense
+    # deep-chunk regime where they win 1.4-1.9x, docs/PROFILE_5R.md)
+    adaptive_active = False
+
     def widths_for(bucket):
-        """compact arg for this bucket: per-action widths, the uniform
-        legacy shift (no measurements yet), or None (full path)."""
+        """compact arg for this bucket: the uniform legacy shift (until a
+        uniform attempt overflows / adaptation disabled), per-action
+        widths from measured enablement, or None (full path)."""
         if compact_shift <= 0 or bucket < 4096:
             return None
-        if not act_hw.any():
+        if not (adaptive_on and adaptive_active and act_hw.any()):
             return compact_shift
         out = []
         for a, hw, floor in zip(model.actions, act_hw, act_w_floor):
@@ -1006,9 +1018,27 @@ def check(
                     attempt_sq_full = squeeze_full = True
                 if ovf[:-1].any():
                     if isinstance(compact_arg, int):
-                        compact_arg = (
-                            compact_arg - 1 if compact_arg > 1 else None
-                        )
+                        # a uniform attempt overflowed: escalate to
+                        # per-action widths sized from THIS attempt's
+                        # guard counts (phase A sweeps the full lattice,
+                        # so act_guard is complete even on overflow).
+                        # With adaptation disabled, legacy behavior:
+                        # decrement the CURRENT shift toward the full
+                        # path (never re-read compact_shift here — that
+                        # would oscillate and spin the retry forever)
+                        if adaptive_on:
+                            np.maximum(
+                                act_hw,
+                                np.asarray(act_guard, np.int64)
+                                / max(fp_n, 1),
+                                out=act_hw,
+                            )
+                            adaptive_active = True
+                            compact_arg = widths_for(bucket)
+                        if isinstance(compact_arg, int):  # adaptation off
+                            compact_arg = (
+                                compact_arg - 1 if compact_arg > 1 else None
+                            )
                     else:
                         compact_arg = tuple(
                             min(2 * w, bucket * a.n_choices) if o else w
