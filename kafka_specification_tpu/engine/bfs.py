@@ -438,16 +438,21 @@ class _Step:
             """Per-invariant (any-violated, first-index) on the frontier
             being expanded (each state is checked exactly once, at
             expansion; BFS order: states before successors)."""
+            if not (with_invariants and model.invariants):
+                return jnp.stack([jnp.bool_(False)]), jnp.stack([jnp.int32(0)])
+            if model.invariants_fused is not None:
+                # one trace for all predicates: shared subtrees (e.g. the
+                # WeakIsr/StrongIsr quantifier core in emitted models)
+                # evaluate once
+                ok = jax.vmap(model.invariants_fused)(states)  # [B, n_inv]
+                bad = fvalid[:, None] & ~ok
+                return jnp.any(bad, axis=0), jnp.argmax(bad, axis=0)
             viol_any, viol_idx = [], []
-            if with_invariants and model.invariants:
-                for inv in model.invariants:
-                    ok = jax.vmap(inv.pred)(states)
-                    bad = fvalid & ~ok
-                    viol_any.append(jnp.any(bad))
-                    viol_idx.append(jnp.argmax(bad))
-            else:
-                viol_any = [jnp.bool_(False)]
-                viol_idx = [jnp.int32(0)]
+            for inv in model.invariants:
+                ok = jax.vmap(inv.pred)(states)
+                bad = fvalid & ~ok
+                viol_any.append(jnp.any(bad))
+                viol_idx.append(jnp.argmax(bad))
             return jnp.stack(viol_any), jnp.stack(viol_idx)
 
         def step(frontier, fvalid, vhi, vlo, vn):

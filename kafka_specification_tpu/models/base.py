@@ -53,6 +53,12 @@ class Model:
     # interpreter's state representation so state *sets* can be compared.
     decode: Optional[Callable[[dict], object]] = None
     meta: dict = field(default_factory=dict)
+    # optional fused evaluator: state -> bool[len(invariants)] (column i =
+    # invariants[i] holds).  Lets an implementation share work ACROSS
+    # invariant predicates within one trace (the emitted models' WeakIsr
+    # and StrongIsr share their quantifier core); engines fall back to the
+    # per-invariant preds when None (and for single-invariant re-checks).
+    invariants_fused: Optional[Callable] = None
 
     @property
     def total_fanout(self) -> int:

@@ -125,7 +125,17 @@ class IVal:
         if isinstance(x, IVal):
             return x
         if isinstance(x, (int, np.integer)):
-            return IVal(int(x), int(x), int(x))
+            # interned: concrete constants (quantifier-unroll elements,
+            # literals) share ONE instance per value, so the trace CSE's
+            # id-keyed env matching fires across unrolls — e.g. WeakIsr
+            # and StrongIsr binding the same replica index reuse one
+            # evaluated body.  IVal is immutable by convention (no field
+            # is ever written after construction).
+            x = int(x)
+            got = _IVAL_INTERN.get(x)
+            if got is None:
+                got = _IVAL_INTERN.setdefault(x, IVal(x, x, x))
+            return got
         raise TypeError(f"not an integer value: {x!r}")
 
     def __add__(self, o):
@@ -146,6 +156,9 @@ class IVal:
 
     def __repr__(self):
         return f"IVal({self.val!r}, [{self.lo},{self.hi}])"
+
+
+_IVAL_INTERN: dict = {}  # int -> canonical concrete IVal (see IVal.of)
 
 
 def _where_ival(cond, a: IVal, b: IVal) -> IVal:
@@ -1053,6 +1066,100 @@ def inline(ast, defs: dict, keep: set):
     return subst(ast, {})
 
 
+def alpha_normalize(ast):
+    """Canonicalize bound-variable names by binding order (β0, β1, ...).
+
+    inline() α-renames every binder FRESH per substitution site, which is
+    capture-safe but makes structurally identical subtrees (e.g. the
+    `∃ record : HasEntry(r1, ...) ∧ HasEntry(r2, ...)` core shared by
+    WeakIsr and StrongIsr, or a helper inlined at two call sites) differ
+    in nothing but binder names.  Renaming binders to their binding DEPTH
+    restores structural equality so intern_ast can share them — and the
+    id-keyed trace CSE then evaluates them once."""
+
+    def walk(a, env, depth):
+        if isinstance(a, E.Name):
+            return E.Name(env.get(a.id, a.id))
+        if isinstance(a, E.Quant):
+            binds, inner = [], dict(env)
+            d = depth
+            for v, dom in a.binds:
+                nv = f"β{d}"
+                d += 1
+                binds.append((nv, walk(dom, inner, depth)))
+                inner[v] = nv
+            return E.Quant(a.kind, tuple(binds), walk(a.body, inner, d))
+        if isinstance(a, (E.Choose, E.FunCons)):
+            nv = f"β{depth}"
+            return type(a)(
+                nv,
+                walk(a.domain, env, depth),
+                walk(a.body, {**env, a.var: nv}, depth + 1),
+            )
+        if isinstance(a, E.SetMap):
+            nv = f"β{depth}"
+            return E.SetMap(
+                walk(a.body, {**env, a.var: nv}, depth + 1),
+                nv,
+                walk(a.domain, env, depth),
+            )
+        if isinstance(a, E.SetFilter):
+            nv = f"β{depth}"
+            return E.SetFilter(
+                nv,
+                walk(a.domain, env, depth),
+                walk(a.pred, {**env, a.var: nv}, depth + 1),
+            )
+        if isinstance(a, E.Let):  # gone after inline(); rename defensively
+            binds, inner = [], dict(env)
+            for name, params, expr in a.binds:
+                binds.append((name, params, walk(expr, inner, depth)))
+            return E.Let(tuple(binds), walk(a.body, inner, depth))
+        if isinstance(a, tuple):
+            return tuple(walk(x, env, depth) for x in a)
+        if hasattr(a, "__dataclass_fields__"):
+            return type(a)(
+                *(
+                    walk(getattr(a, f), env, depth)
+                    for f in a.__dataclass_fields__
+                )
+            )
+        return a  # str/int leaves
+
+    return walk(ast, {}, 0)
+
+
+def intern_ast(ast, table: dict):
+    """Hash-cons: map structurally equal subtrees to one canonical node.
+
+    With children already canonical, structural identity reduces to child
+    identity, so the table keys on (type, id-of-child...) — O(1) per node
+    without recursive hashing.  Shared nodes make the Emitter's id-keyed
+    CSE fire across duplicated inline sites and across invariants traced
+    in one scope (run alpha_normalize first or binder names defeat it)."""
+    if isinstance(ast, tuple):
+        return tuple(intern_ast(x, table) for x in ast)
+    if not hasattr(ast, "__dataclass_fields__"):
+        return ast
+
+    def keyof(v):
+        if hasattr(v, "__dataclass_fields__"):
+            return id(v)
+        if isinstance(v, tuple):
+            return tuple(keyof(x) for x in v)
+        return v
+
+    kids = tuple(
+        intern_ast(getattr(ast, f), table) for f in ast.__dataclass_fields__
+    )
+    key = (type(ast),) + tuple(keyof(k) for k in kids)
+    got = table.get(key)
+    if got is None:
+        got = type(ast)(*kids)
+        table[key] = got
+    return got
+
+
 def contains_prime(ast) -> bool:
     if isinstance(ast, E.Prime):
         return True
@@ -1575,7 +1682,22 @@ def build_model(
 
     actions_ir = extract_actions(mod, defs, keep)
 
+    # one hash-cons table per model: α-normalized, structurally equal
+    # subtrees (duplicated inline sites, the invariant pair's shared
+    # quantifier core) collapse to one node, so the id-keyed trace CSE
+    # evaluates them once per scope
+    _interns: dict = {}
+
+    def canon(a):
+        return intern_ast(alpha_normalize(a), _interns)
+
     def make_kernel(air: ActionIR):
+        air = ActionIR(
+            name=air.name,
+            binds=[(v, canon(d)) for v, d in air.binds],
+            guards=[canon(g) for g in air.guards],
+            updates={v: canon(r) for v, r in air.updates.items()},
+        )
         entries, rem_guards = _split_forced(air.binds, air.guards)
         sizes, mapper = _domain_space(emitter, entries, spec)
         n_choices = int(np.prod(sizes)) if sizes else 1
@@ -1744,11 +1866,17 @@ def build_model(
         return [state]
 
     invariants = []
+    inv_bodies = []
     for iname in invariant_names:
         params, ast = defs[iname]
-        body = inline(
-            E.Name(iname) if not params else E.Apply(iname, ()), defs, keep
+        body = canon(
+            inline(
+                E.Name(iname) if not params else E.Apply(iname, ()),
+                defs,
+                keep,
+            )
         )
+        inv_bodies.append(body)
 
         def pred(state, body=body):
             with emitter.memo_scope():
@@ -1756,9 +1884,25 @@ def build_model(
 
         invariants.append(Invariant(iname, pred))
 
+    invariants_fused = None
+    if len(inv_bodies) > 1:
+        # one trace, one CSE scope for ALL invariant predicates: the
+        # α-normalized, hash-consed bodies share their common subtrees
+        # (WeakIsr and StrongIsr differ only in the ISR source set —
+        # their ∃record per-(r1, r2, offset) core is one shared node)
+
+        def invariants_fused(state):
+            with emitter.memo_scope():
+                return jnp.stack(
+                    [
+                        _as_bool(emitter.eval(b, {"__state__": state}))
+                        for b in inv_bodies
+                    ]
+                )
+
     constraint = None
     if constraint_src is not None:
-        c_body = inline(E.parse_expr(constraint_src), defs, keep)
+        c_body = canon(inline(E.parse_expr(constraint_src), defs, keep))
 
         def constraint(state, c_body=c_body):
             with emitter.memo_scope():
@@ -1772,6 +1916,7 @@ def build_model(
         invariants=invariants,
         constraint=constraint,
         decode=None,
+        invariants_fused=invariants_fused,
     )
 
 

@@ -37,8 +37,8 @@ def test_engine_runs_are_bit_identical():
 
 def test_sharded_matches_itself_and_engine_counts():
     m = variants.make_model("Kip101", TINY, ("TypeOk",))
-    r1 = check_sharded(m, min_bucket=32, chunk_size=16)
-    r2 = check_sharded(m, min_bucket=32, chunk_size=64)
+    r1 = check_sharded(m, min_bucket=32, chunk_size=32)
+    r2 = check_sharded(m, min_bucket=32, chunk_size=128)
     r3 = check(m, min_bucket=32)
     # chunking must not affect per-level counts, totals, or diameter
     assert r1.levels == r2.levels == r3.levels

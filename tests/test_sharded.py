@@ -121,7 +121,7 @@ def test_sharded_exchange_modes_agree():
     multiple exchanges per level."""
     m = kip320.make_model(Config(2, 2, 1, 1))
     for exchange in ("all_to_all", "all_gather"):
-        res = check_sharded(m, min_bucket=32, chunk_size=64, exchange=exchange)
+        res = check_sharded(m, min_bucket=32, chunk_size=128, exchange=exchange)
         assert res.ok, exchange
         assert res.total == 277, (exchange, res.total)
         assert res.stats["exchange"] == exchange
@@ -131,6 +131,20 @@ def test_sharded_host_fpset_backend_exact_count():
     """Per-shard host FpSet spill (the >HBM mode): counts must match the
     device-resident visited sets, and the per-shard set sizes must sum to
     the distinct-state total."""
+    res = check_sharded(
+        frl.make_model(3, 3, 2),
+        min_bucket=8,
+        chunk_size=128,
+        store_trace=False,
+        visited_backend="host",
+    )
+    assert res.ok
+    assert res.total == 3375
+    assert sum(res.stats["host_fpset_sizes"]) == 3375
+
+
+@pytest.mark.slow
+def test_sharded_host_fpset_backend_exact_count_29791():
     res = check_sharded(
         frl.make_model(3, 4, 2),
         min_bucket=8,
@@ -160,7 +174,7 @@ def test_sharded_async_isr_constraint_model():
     identically to engine.check — 4,088 states at (3r, M2, V2)."""
     cfg = async_isr.AsyncIsrConfig(n_replicas=3, max_offset=2, max_version=2)
     res = check_sharded(
-        async_isr.make_model(cfg), min_bucket=64, chunk_size=512, store_trace=False
+        async_isr.make_model(cfg), min_bucket=64, chunk_size=1024, store_trace=False
     )
     assert res.ok
     assert res.total == 4088
