@@ -26,6 +26,7 @@ is run with TLC's deadlock check disabled for the same reason).
 from __future__ import annotations
 
 import json
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -127,8 +128,14 @@ class _Step:
                 return None
             return tuple(max(1, bucket >> compact) * a.n_choices for a in acts)
         assert len(compact) == len(acts), (len(compact), len(acts))
+        # Round caller-supplied widths up to a multiple of 256 (unless the
+        # full lattice width — always a pow2 multiple of n_choices — is
+        # smaller): fp_masked blocks the candidate buffer by
+        # gcd(rows, 8192), so an odd width would give 1-row Pallas
+        # fingerprint blocks (round-5 advisor item).  The alignment
+        # invariant is enforced HERE, where the widths are created.
         return tuple(
-            min(max(1, int(w)), bucket * a.n_choices)
+            min(-256 * (-max(1, int(w)) // 256), bucket * a.n_choices)
             for w, a in zip(compact, acts)
         )
 
@@ -711,6 +718,10 @@ def check(
     host_set = None
     ht_hi = ht_lo = ht_claim = None  # device-hash table (ops/hashset)
     hash_n = 0
+    # ht_claim is allocated LAZILY at the insert site (the jnp probe path
+    # needs it; the Pallas path does not), so table (re)builds just reset
+    # it to None.  pallas_vmem_noted: warn once per run on VMEM fallback.
+    pallas_vmem_noted = False
 
     def _u64(hi, lo):
         return (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(
@@ -736,11 +747,7 @@ def check(
                 max(_HASH_MIN_CAP, 4 * (visited_capacity_hint or 0))
             ),
         )
-        ht_claim = (
-                None
-                if step_builder.use_pallas
-                else hashset.new_claim(ht_hi.shape[0])
-            )
+        ht_claim = None
         hash_n = n0
         vcap = 64  # placeholder shapes for the step signature
         vhi = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
@@ -832,11 +839,7 @@ def check(
                 ht_hi, ht_lo = hashset.table_from_pairs(
                     live_hi, live_lo, min_cap=_HASH_MIN_CAP
                 )
-                ht_claim = (
-                None
-                if step_builder.use_pallas
-                else hashset.new_claim(ht_hi.shape[0])
-            )
+                ht_claim = None
             else:
                 vcap = int(snap["vcap"])
                 n = int(snap["vn"])
@@ -960,11 +963,7 @@ def check(
                 ht_hi, ht_lo = hashset.rehash_into(
                     ht_hi, ht_lo, 2 * ht_hi.shape[0]
                 )
-                ht_claim = (
-                None
-                if step_builder.use_pallas
-                else hashset.new_claim(ht_hi.shape[0])
-            )
+                ht_claim = None
             # Candidate compaction: expand/pack/sort/probe/merge at the
             # enabled width (a few % of M) instead of the padded-lattice
             # width.  On overflow (an action enabled more pairs than its
@@ -1092,22 +1091,50 @@ def check(
                 valid = jnp.arange(out_hi.shape[0]) < new_n
                 isnew = np.zeros(out_hi.shape[0], bool)
                 while True:
+                    # Pallas probe kernel (ops/pallas_hashset) — the actual
+                    # TPU dedup kernel a live hardware window profiles;
+                    # interpret mode on CPU, bit-identical winners
+                    # (tests/test_pallas.py).  It stages the whole table in
+                    # VMEM, so beyond MAX_VMEM_CAP slots it cannot compile
+                    # — fall back to the jnp HBM probe, loudly, and keep
+                    # checking per iteration (a mid-run rehash can cross
+                    # the threshold).
+                    use_p = False
                     if step_builder.use_pallas:
-                        # Pallas probe kernel (ops/pallas_hashset) — the
-                        # actual TPU dedup kernel a live hardware window
-                        # profiles; interpret mode on CPU, bit-identical
-                        # winners (tests/test_pallas.py)
-                        from ..ops.pallas_hashset import probe_insert_pallas
+                        # lazy import: the default (non-pallas) path must
+                        # not depend on jax.experimental.pallas at all
+                        from ..ops import pallas_hashset as pallas_hs
 
-                        ht_hi, ht_lo, m, _ni, ovf = probe_insert_pallas(
-                            ht_hi,
-                            ht_lo,
-                            out_hi,
-                            out_lo,
-                            valid,
-                            interpret=jax.default_backend() == "cpu",
+                        use_p = pallas_hs.fits_vmem(ht_hi.shape[0])
+                    if (
+                        step_builder.use_pallas
+                        and not use_p
+                        and not pallas_vmem_noted
+                    ):
+                        pallas_vmem_noted = True
+                        print(
+                            "[kspec] KSPEC_USE_PALLAS: table capacity "
+                            f"{ht_hi.shape[0]} exceeds the VMEM-staged "
+                            f"kernel's limit ({pallas_hs.MAX_VMEM_CAP}); "
+                            "falling back to the jnp HBM probe path",
+                            file=sys.stderr,
+                            flush=True,
                         )
+                    if use_p:
+                        ht_hi, ht_lo, m, _ni, ovf = (
+                            pallas_hs.probe_insert_pallas(
+                                ht_hi,
+                                ht_lo,
+                                out_hi,
+                                out_lo,
+                                valid,
+                                interpret=jax.default_backend() == "cpu",
+                            )
+                        )
+                        ht_claim = None
                     else:
+                        if ht_claim is None:
+                            ht_claim = hashset.new_claim(ht_hi.shape[0])
                         ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
                             ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
                         )
@@ -1117,11 +1144,7 @@ def check(
                     ht_hi, ht_lo = hashset.rehash_into(
                         ht_hi, ht_lo, 2 * ht_hi.shape[0]
                     )
-                    ht_claim = (
-                None
-                if step_builder.use_pallas
-                else hashset.new_claim(ht_hi.shape[0])
-            )
+                    ht_claim = None
                 mask = isnew[:nn]
                 hash_n += int(mask.sum())
                 lvl_rows.append(np.asarray(out[:nn])[mask])

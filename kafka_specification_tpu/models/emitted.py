@@ -23,6 +23,7 @@ carries a fresh leaderEpoch (ControllerUpdateIsr, :138-145).
 from __future__ import annotations
 
 import os
+import sys as _sys
 from pathlib import Path
 
 from ..utils.tla_emit import (
@@ -38,10 +39,25 @@ from ..utils.tla_emit import (
 from ..utils.tla_frontend import parse_tla
 from .kafka_replication import ABSENT, NIL, NONE, Config, make_spec
 
-# the reference checkout the emitted path parses at runtime (the checker
-# consuming the spec corpus exactly as TLC would); overridable for portable
-# checkouts — `cli validate --reference` and this env var agree
-REF = Path(os.environ.get("KSPEC_REFERENCE", "/root/reference"))
+# The reference checkout the emitted path parses at runtime (the checker
+# consuming the spec corpus exactly as TLC would).  Resolved LAZILY so one
+# knob controls both the emitted builders and `cli validate`: the CLI's
+# --reference value threads through as `override`, and the env var is read
+# at call time, not import time (round-5 advisor item).
+
+
+def ref_path(override=None) -> Path:
+    """Resolve the reference checkout: explicit override > KSPEC_REFERENCE
+    env var > /root/reference."""
+    return Path(
+        override or os.environ.get("KSPEC_REFERENCE", "/root/reference")
+    )
+
+
+def __getattr__(name):  # PEP 562: keep `emitted.REF` importable, but lazy
+    if name == "REF":
+        return ref_path()
+    raise AttributeError(name)
 
 #: the five L4 variant modules (SURVEY.md §2.1) in historical order
 VARIANTS = (
@@ -103,32 +119,59 @@ def l3_schemas(cfg: Config) -> dict:
     }
 
 
+#: the reference's literal LeaderInIsr (KafkaReplication.tla:345) — the
+#: intent rebinding below only applies when the module's definition still
+#: IS this literal (known False at Init, :117-119); a future module whose
+#: LeaderInIsr genuinely differs keeps its own meaning (round-5 advisor).
+_LEADER_IN_ISR_LITERAL = "quorumState.leader \\in quorumState.isr"
+
+
+def _rebind_if_literal(defs, name, literal_src, intent_src, where):
+    """Rebind `name` to the corpus-wide intent reading IFF its definition
+    still parses equal to the known reference literal; otherwise keep the
+    module's own definition and say so.  The literal stays available as
+    `<name>Literal` (PARITY.md)."""
+    from ..utils import tla_expr as E
+
+    if defs.get(name) == ((), E.parse_expr(literal_src)):
+        defs[f"{name}Literal"] = defs[name]
+        defs[name] = ((), E.parse_expr(intent_src))
+    elif name in defs:
+        print(
+            f"[kspec] {where}: {name} differs from the corpus literal — "
+            "keeping the module's own definition (no intent rebinding)",
+            file=_sys.stderr,
+        )
+
+
 def make_emitted_model(
     module: str,
     cfg: Config,
     invariants=("TypeOk",),
+    reference=None,
 ):
     """Emit the checker model for one variant module from reference text.
 
     invariants: names resolved in the module's definition namespace
     (TypeOk / WeakIsr / StrongIsr / LeaderInIsr).  `LeaderInIsr` is bound
     to the corpus-wide *intent* reading (leader = None \\/ membership) so
-    hand and emitted paths check the same property; the reference's
-    literal predicate — False at Init, KafkaReplication.tla:345 with
-    :117-119 — stays available as `LeaderInIsrLiteral` (PARITY.md).
+    hand and emitted paths check the same property — but ONLY when the
+    module's literal predicate matches the known corpus literal
+    (KafkaReplication.tla:345, False at Init); otherwise the module's own
+    definition stands.  The literal stays available as
+    `LeaderInIsrLiteral` (PARITY.md).
     """
-    from ..utils import tla_expr as E
-
-    defs = load_defs(REF, module)
-    defs["LeaderInIsrLiteral"] = defs["LeaderInIsr"]
-    defs["LeaderInIsr"] = (
-        (),
-        E.parse_expr(
-            "(quorumState.leader = None) "
-            "\\/ (quorumState.leader \\in quorumState.isr)"
-        ),
+    ref = ref_path(reference)
+    defs = load_defs(ref, module)
+    _rebind_if_literal(
+        defs,
+        "LeaderInIsr",
+        _LEADER_IN_ISR_LITERAL,
+        "(quorumState.leader = None) "
+        "\\/ (quorumState.leader \\in quorumState.isr)",
+        module,
     )
-    mod = parse_tla(REF / f"{module}.tla")
+    mod = parse_tla(ref / f"{module}.tla")
     consts = {
         "Replicas": (0, cfg.n - 1),
         "LogSize": cfg.l,
@@ -173,9 +216,21 @@ ASYNC_ISR_BOUNDED = (
 )
 
 
+#: the reference's literal TypeOk (AsyncIsr.tla:62-66) — the intent
+#: rebinding below only applies while the module's definition IS this
+#: literal (False at Init because pendingVersion starts at Nil, :45,:145).
+_ASYNC_TYPEOK_LITERAL = (
+    "/\\ (controllerState \\in ControllerState) "
+    "/\\ (leaderState \\in LeaderState) "
+    "/\\ (requests \\subseteq Message) "
+    "/\\ (updates \\subseteq Message)"
+)
+
+
 def make_emitted_async_isr(
     cfg,
     invariants=("TypeOk", "ValidHighWatermark"),
+    reference=None,
 ):
     """Emit the standalone AsyncIsr model (AsyncIsr.tla) from reference
     text onto the hand model's lanes (models/async_isr.make_spec).
@@ -185,28 +240,29 @@ def make_emitted_async_isr(
     may repeat versions (the leader reuses its current version, :88-115) ->
     the per-version subset-lattice bitset (SPairSet).
     """
-    from ..utils import tla_expr as E
     from .async_isr import LEADER, make_spec as make_async_spec
 
-    defs = load_defs(REF, "AsyncIsr")
+    ref = ref_path(reference)
+    defs = load_defs(ref, "AsyncIsr")
     # literal TypeOk is False at Init: LeaderState declares
     # `pendingVersion: Nat` (AsyncIsr.tla:45) but Init sets it to Nil = -1
     # (:145).  Bind `TypeOk` to the evident intent (pendingVersion may be
-    # Nil) so the .cfg-named invariant passes as the author expected; the
-    # literal stays available as `TypeOkLiteral` (PARITY.md).
-    defs["TypeOkLiteral"] = defs["TypeOk"]
-    defs["TypeOk"] = (
-        (),
-        E.parse_expr(
-            "/\\ (controllerState \\in ControllerState) "
-            "/\\ (leaderState \\in [isr: SUBSET Replicas, version: Nat, "
-            "pendingIsr: SUBSET Replicas, pendingVersion: -1 .. MaxVersion, "
-            "offsets: [Replicas -> Nat]]) "
-            "/\\ (requests \\subseteq Message) "
-            "/\\ (updates \\subseteq Message)"
-        ),
+    # Nil) so the .cfg-named invariant passes as the author expected —
+    # gated on the definition still being the known literal (round-5
+    # advisor): a changed TypeOk keeps its own meaning.
+    _rebind_if_literal(
+        defs,
+        "TypeOk",
+        _ASYNC_TYPEOK_LITERAL,
+        "/\\ (controllerState \\in ControllerState) "
+        "/\\ (leaderState \\in [isr: SUBSET Replicas, version: Nat, "
+        "pendingIsr: SUBSET Replicas, pendingVersion: -1 .. MaxVersion, "
+        "offsets: [Replicas -> Nat]]) "
+        "/\\ (requests \\subseteq Message) "
+        "/\\ (updates \\subseteq Message)",
+        "AsyncIsr",
     )
-    mod = parse_tla(REF / "AsyncIsr.tla")
+    mod = parse_tla(ref / "AsyncIsr.tla")
     N, M, V = cfg.n, cfg.max_offset, cfg.max_version
     schemas = {
         "controllerState": SRec(

@@ -44,6 +44,20 @@ from jax.experimental import pallas as pl
 from . import hashset
 from .hashset import SENT
 
+# The kernel stages BOTH table lanes (uint32[cap] x 2) plus the batch block
+# in VMEM (~16 MiB/core on current TPUs).  8 bytes/slot => cap 2^20 is
+# 8 MiB of table, leaving headroom for the batch block, outputs and
+# compiler scratch.  Beyond this the pallas_call simply fails to fit —
+# callers must take the jnp probe path (HBM-resident table) instead; the
+# engine gates on fits_vmem() and falls back loudly (engine/bfs).  An
+# HBM-resident variant (memory_space=ANY + explicit DMA) would lift this.
+MAX_VMEM_CAP = 1 << 20
+
+
+def fits_vmem(cap: int) -> bool:
+    """True when a cap-slot table can be VMEM-staged by this kernel."""
+    return cap <= MAX_VMEM_CAP
+
 
 def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
             t_hi_ref, t_lo_ref, is_new_ref, ovf_ref):

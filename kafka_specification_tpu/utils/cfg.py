@@ -126,10 +126,19 @@ def _with_names(built, constants):
 
 
 def build_model(
-    module: str, cfg: TlcConfig, oracle: bool = False, emitted: bool = False
+    module: str,
+    cfg: TlcConfig,
+    oracle: bool = False,
+    emitted: bool = False,
+    reference=None,
 ):
     """Instantiate the tensor model (or its oracle twin) for a TLA+ module
     name under a parsed TLC config.
+
+    reference: explicit reference-checkout path for the emitted builders
+    (default: KSPEC_REFERENCE env var, resolved lazily — models/emitted
+    .ref_path); `cli validate --reference` threads through here so one
+    knob controls both resolutions.
 
     CONSTRAINT is only meaningful for AsyncIsr in this corpus (its bound is
     driven by the MaxOffset/MaxVersion constants); naming one for any other
@@ -152,14 +161,17 @@ def build_model(
     c = cfg.constants
     if module == "IdSequence":
         if emitted:
-            return _emitted_id_sequence(int(c["MaxId"]))
+            return _emitted_id_sequence(int(c["MaxId"]), reference)
         from ..models import id_sequence as m
 
         return (m.make_oracle if oracle else m.make_model)(int(c["MaxId"]))
     if module == "FiniteReplicatedLog":
         if emitted:
             return _emitted_frl(
-                _setlen(c["Replicas"]), int(c["LogSize"]), _setlen(c["LogRecords"])
+                _setlen(c["Replicas"]),
+                int(c["LogSize"]),
+                _setlen(c["LogRecords"]),
+                reference,
             )
         from ..models import finite_replicated_log as m
 
@@ -179,7 +191,9 @@ def build_model(
         if emitted:
             from ..models.emitted import make_emitted_model
 
-            built = make_emitted_model(module, kcfg, invariants=invs)
+            built = make_emitted_model(
+                module, kcfg, invariants=invs, reference=reference
+            )
         elif module in KAFKA_VARIANTS:
             from ..models import variants as m
 
@@ -215,20 +229,25 @@ def build_model(
         if emitted:
             from ..models.emitted import make_emitted_async_isr
 
-            return _with_names(make_emitted_async_isr(acfg, invariants=invs), c)
+            return _with_names(
+                make_emitted_async_isr(
+                    acfg, invariants=invs, reference=reference
+                ),
+                c,
+            )
         return _with_names((m.make_oracle if oracle else m.make_model)(acfg, invs), c)
     raise KeyError(f"unknown module {module!r}")
 
 
-def _emitted_id_sequence(max_id: int):
+def _emitted_id_sequence(max_id: int, reference=None):
     from pathlib import Path
 
-    from ..models.emitted import REF
+    from ..models.emitted import ref_path
     from ..ops.packing import Field, StateSpec
     from .tla_emit import SInt, build_model as emit
     from .tla_frontend import parse_tla
 
-    mod = parse_tla(Path(REF) / "IdSequence.tla")
+    mod = parse_tla(ref_path(reference) / "IdSequence.tla")
     spec = StateSpec([Field("nextId", (), 0, max_id + 1)])
     return emit(
         mod,
@@ -239,15 +258,15 @@ def _emitted_id_sequence(max_id: int):
     )
 
 
-def _emitted_frl(n: int, log_size: int, n_records: int):
+def _emitted_frl(n: int, log_size: int, n_records: int, reference=None):
     from pathlib import Path
 
-    from ..models.emitted import REF
+    from ..models.emitted import ref_path
     from ..ops.packing import Field, StateSpec
     from .tla_emit import SFun, SInt, SRec, build_model as emit
     from .tla_frontend import parse_tla
 
-    mod = parse_tla(Path(REF) / "FiniteReplicatedLog.tla")
+    mod = parse_tla(ref_path(reference) / "FiniteReplicatedLog.tla")
     spec = StateSpec(
         [Field("end", (n,), 0, log_size), Field("rec", (n, log_size), -1, n_records - 1)]
     )

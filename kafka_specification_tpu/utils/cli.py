@@ -349,7 +349,9 @@ def main(argv=None):
         # authored product-space constant with no reference counterpart,
         # and the combinator renames actions to p<k>.<Name>
         tlc_cfg.constants.pop("Partitions", None)
-        model = _build_or_fail(module, tlc_cfg, emitted=args.emitted)
+        model = _build_or_fail(
+            module, tlc_cfg, emitted=args.emitted, reference=args.reference
+        )
         problems += validate_model(model, args.reference, module)
         if problems:
             for pr in problems:
@@ -447,21 +449,24 @@ def _kernel_source(args, module) -> bool:
         return False
     if args.emitted:
         return True
-    from ..models.emitted import REF
+    from ..models.emitted import ref_path
 
-    if (REF / f"{module}.tla").exists():
+    ref = ref_path()
+    if (ref / f"{module}.tla").exists():
         return True
     print(
-        f"note: no reference checkout at {REF} (set KSPEC_REFERENCE) — "
+        f"note: no reference checkout at {ref} (set KSPEC_REFERENCE) — "
         f"using hand-translated kernels",
         file=sys.stderr,
     )
     return False
 
 
-def _build_or_fail(module, tlc_cfg, oracle=False, emitted=False):
+def _build_or_fail(module, tlc_cfg, oracle=False, emitted=False, reference=None):
     try:
-        return build_model(module, tlc_cfg, oracle=oracle, emitted=emitted)
+        return build_model(
+            module, tlc_cfg, oracle=oracle, emitted=emitted, reference=reference
+        )
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         raise SystemExit(2)

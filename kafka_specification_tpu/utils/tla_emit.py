@@ -1086,7 +1086,13 @@ def alpha_normalize(ast):
             for v, dom in a.binds:
                 nv = f"β{d}"
                 d += 1
-                binds.append((nv, walk(dom, inner, depth)))
+                # Walk each domain at the RUNNING counter d, not the
+                # quantifier's entry depth: a nested binder inside a later
+                # (dependent) domain must never reuse an earlier sibling
+                # bind's β-name, or references to that sibling get captured
+                # (e.g. {x ∈ S : x # r1} inside the r2 domain of
+                # ∃ r1 ∈ S, r2 ∈ … would normalize to β0 # β0).
+                binds.append((nv, walk(dom, inner, d)))
                 inner[v] = nv
             return E.Quant(a.kind, tuple(binds), walk(a.body, inner, d))
         if isinstance(a, (E.Choose, E.FunCons)):

@@ -95,3 +95,25 @@ def test_engine_device_hash_with_pallas_probe_matches_golden(monkeypatch):
     )
     assert res.ok
     assert res.total == 49
+
+
+def test_engine_pallas_vmem_gate_falls_back_loudly(monkeypatch, capsys):
+    """Regression (round-5 advisor, medium): the Pallas probe stages the
+    whole table in VMEM, so KSPEC_USE_PALLAS=1 with a table beyond
+    MAX_VMEM_CAP must fall back to the jnp HBM probe (loudly) instead of
+    failing to compile mid-run — and stay exact."""
+    import kafka_specification_tpu.ops.pallas_hashset as ph
+    from kafka_specification_tpu.engine.bfs import check
+    from kafka_specification_tpu.models import finite_replicated_log as frl
+
+    monkeypatch.setenv("KSPEC_USE_PALLAS", "1")
+    # shrink the gate below the engine's minimum table so EVERY insert
+    # takes the fallback path
+    monkeypatch.setattr(ph, "MAX_VMEM_CAP", 16)
+    model = frl.make_model(2, 2, 2, force_hashed=True)
+    res = check(
+        model, min_bucket=32, store_trace=False, visited_backend="device-hash"
+    )
+    assert res.ok and res.total == 49
+    err = capsys.readouterr().err
+    assert "exceeds the VMEM-staged kernel's limit" in err

@@ -173,3 +173,31 @@ def test_concrete_successors_match_hand_oracle():
         to_logs(t) for a in hand.actions for t in a.successors(state)
     }
     assert succs == hand_succs and len(succs) == 6
+
+
+def test_alpha_normalize_dependent_domain_nested_binder():
+    """Regression (round-5 advisor, high): a nested binder inside a later
+    bind's dependent domain must not reuse an earlier sibling's β-name.
+
+    `∃ r1 ∈ S, r2 ∈ {x ∈ S : x # r1} : r2 # r1` used to normalize the
+    filter to `β0 # β0` (always false) because every bind domain was
+    walked at the quantifier's entry depth — so the checker would have
+    silently verified a wrong model for any spec with a dependent
+    quantifier domain containing a nested binder."""
+    from kafka_specification_tpu.utils.tla_emit import alpha_normalize
+
+    ast = parse_expr(
+        "\\E r1 \\in {1, 2}, r2 \\in {x \\in {1, 2} : x # r1} : r2 # r1"
+    )
+    ev = ConcreteEval({}, {})
+    assert ev.eval(ast, {})  # sanity: the raw tree is satisfiable
+    norm = alpha_normalize(ast)
+    assert ev.eval(norm, {}), (
+        "normalized tree must agree with the raw tree"
+    )
+    # And the universal dual: ∀ r1, r2 ∈ {x : x # r1} : r2 # r1 is
+    # vacuously-true-per-r1 only if the filter keeps its dependency.
+    ast2 = parse_expr(
+        "\\A r1 \\in {1, 2}, r2 \\in {x \\in {1, 2} : x # r1} : r2 # r1"
+    )
+    assert ev.eval(ast2, {}) and ev.eval(alpha_normalize(ast2), {})
