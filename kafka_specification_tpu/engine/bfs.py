@@ -56,6 +56,79 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
+class AdaptiveCompact:
+    """Per-action compact-buffer sizing policy, shared by the single-device
+    engine and the sharded engine (round-5 review item: one policy, two
+    hand-synced copies otherwise).
+
+    Escalation: stay on the uniform legacy shift until a uniform attempt
+    actually overflows (the uniform path is cheaper when it fits —
+    docs/PROFILE_5R.md), then size each action's buffer at ~1.35x the
+    run's measured high-water per-state enablement, pow2-rounded with
+    overflow-learned floors.  Callers supply the per-state guard density
+    (single-device: act_guard / chunk rows; sharded: max over shards of
+    act_guard / shard rows) so the policy itself is engine-agnostic, and
+    all inputs are host-replicated values so multi-process runs stay in
+    lockstep.  KSPEC_ADAPTIVE_COMPACT=0 pins the legacy uniform-only
+    behavior.
+    """
+
+    def __init__(self, actions, compact_shift: int, bucket_gate: int):
+        import os as _os
+
+        self.actions = actions
+        self.shift = compact_shift
+        self.gate = bucket_gate
+        self.hw = np.zeros(len(actions), np.float64)
+        self.floor = np.zeros(len(actions), np.int64)
+        self.on = _os.environ.get("KSPEC_ADAPTIVE_COMPACT", "1") != "0"
+        self.active = False
+
+    def widths_for(self, bucket: int):
+        """compact arg for this bucket: None (full path), the uniform
+        legacy shift, or a per-action width tuple once escalated."""
+        if self.shift <= 0 or bucket < self.gate:
+            return None
+        if not (self.on and self.active and self.hw.any()):
+            return self.shift
+        out = []
+        for a, hw, floor in zip(self.actions, self.hw, self.floor):
+            w = _next_pow2(max(256, int(1.35 * hw * bucket) + 1, int(floor)))
+            out.append(min(w, bucket * a.n_choices))
+        return tuple(out)
+
+    def observe(self, density: np.ndarray):
+        """Fold one attempt's per-state guard densities into the
+        high-water marks."""
+        np.maximum(self.hw, density, out=self.hw)
+
+    def escalate(self, attempt, ovf_a, bucket: int, density: np.ndarray):
+        """Next attempt after an expansion overflow of `attempt`.
+
+        attempt: the overflowed compact arg (int = uniform shift, tuple =
+        per-action widths).  ovf_a: per-action overflow flags (tuple
+        case).  density: the overflowing attempt's complete per-state
+        guard densities (phase A sweeps the full lattice regardless of
+        buffer overflow, so these are exact).
+        """
+        if isinstance(attempt, int):
+            if self.on:
+                self.observe(density)
+                self.active = True
+                attempt = self.widths_for(bucket)
+            if isinstance(attempt, int):  # adaptation off (or degenerate)
+                return attempt - 1 if attempt > 1 else None
+            return attempt
+        nxt = tuple(
+            min(2 * w, bucket * a.n_choices) if o else w
+            for w, o, a in zip(attempt, ovf_a, self.actions)
+        )
+        for ai, o in enumerate(ovf_a):
+            if o:
+                self.floor[ai] = max(self.floor[ai], nxt[ai])
+        return nxt
+
+
 @dataclass
 class Violation:
     invariant: str
@@ -885,42 +958,12 @@ def check(
     # Adaptive per-action compact sizing (two-phase expansion, SURVEY §2.3):
     # enablement density varies two orders of magnitude across actions
     # (deep 5-broker chunks: LeaderWrite/Truncate at 26-29% of their
-    # lattice vs fenced ISR mutations at <0.1%), so each action's compact
-    # buffer is sized from the run's measured high-water enablement
-    # (act_hw, enabled pairs per frontier state) with ~1.35x headroom,
-    # rounded up to a power of two so compiled shapes stay few, with
-    # overflow-learned floors.  The first chunks run at the uniform
-    # compact_shift legacy sizing; shapes stabilize once the high-water
-    # marks plateau (a handful of compiles per run).
-    n_actions = len(model.actions)
-    act_hw = np.zeros(n_actions, np.float64)
-    act_w_floor = np.zeros(n_actions, np.int64)
+    # lattice vs fenced ISR mutations at <0.1%).  The policy — uniform
+    # shift until a uniform attempt overflows, then measured high-water
+    # widths with learned floors — lives in AdaptiveCompact, shared with
+    # the sharded engine (docs/PROFILE_5R.md has the measurements).
+    adapt = AdaptiveCompact(model.actions, compact_shift, bucket_gate=4096)
     squeeze_full = False
-
-    import os as _os
-
-    adaptive_on = _os.environ.get("KSPEC_ADAPTIVE_COMPACT", "1") != "0"
-    # Escalation policy: the uniform shift is CHEAPER when it fits (its
-    # pre-sort squeeze halves the fingerprint width, and 9 pow2-padded
-    # per-action buffers overshoot on sparse workloads — measured 131.8k
-    # vs 93.9k states/sec on the 3r flagship), so per-action widths
-    # activate only once a uniform attempt actually overflows (the dense
-    # deep-chunk regime where they win 1.4-1.9x, docs/PROFILE_5R.md)
-    adaptive_active = False
-
-    def widths_for(bucket):
-        """compact arg for this bucket: the uniform legacy shift (until a
-        uniform attempt overflows / adaptation disabled), per-action
-        widths from measured enablement, or None (full path)."""
-        if compact_shift <= 0 or bucket < 4096:
-            return None
-        if not (adaptive_on and adaptive_active and act_hw.any()):
-            return compact_shift
-        out = []
-        for a, hw, floor in zip(model.actions, act_hw, act_w_floor):
-            w = _next_pow2(max(256, int(1.35 * hw * bucket) + 1, int(floor)))
-            out.append(min(w, bucket * a.n_choices))
-        return tuple(out)
 
     while frontier_np.shape[0] > 0:
         if max_depth is not None and depth >= max_depth:
@@ -938,6 +981,19 @@ def check(
         lvl_new = 0
         lvl_act_en = np.zeros(len(model.actions), np.int64)
         verdict = None  # (kind, global_frontier_idx, inv_name)
+        # Host-native backend: assemble the next level in a preallocated
+        # arena via the fused C pass (native.FpSet.insert_compact) — one
+        # cache-friendly sweep per chunk instead of u64 packing + novelty
+        # mask + masked gathers + per-level concatenate.  Growth copies
+        # only the filled prefix (amortized O(level)).
+        use_arena = host_set is not None and host_set.native
+        if use_arena:
+            a_cap = max(1 << 14, int(1.5 * f_total))
+            a_rows = np.empty((a_cap, K), np.uint32)
+            a_parent = np.empty(a_cap, np.int64)
+            a_act = np.empty(a_cap, np.int32)
+            a_w = 0
+        prof_step = prof_host_s = 0.0
         for start in range(0, f_total, chunk):
             piece = frontier_np[start : start + chunk]
             fp_n = piece.shape[0]
@@ -974,8 +1030,9 @@ def check(
             # recurring density doesn't re-pay the retry every chunk —
             # exact results either way, sizing is purely a performance
             # knob.
-            compact_arg = widths_for(bucket)
+            compact_arg = adapt.widths_for(bucket)
             attempt_sq_full = squeeze_full
+            t_attempt = time.perf_counter()
             while True:
                 step = step_builder.get(
                     bucket,
@@ -1021,46 +1078,24 @@ def check(
                 if ovf[-1]:
                     attempt_sq_full = squeeze_full = True
                 if ovf[:-1].any():
-                    if isinstance(compact_arg, int):
-                        # a uniform attempt overflowed: escalate to
-                        # per-action widths sized from THIS attempt's
-                        # guard counts (phase A sweeps the full lattice,
-                        # so act_guard is complete even on overflow).
-                        # With adaptation disabled, legacy behavior:
-                        # decrement the CURRENT shift toward the full
-                        # path (never re-read compact_shift here — that
-                        # would oscillate and spin the retry forever)
-                        if adaptive_on:
-                            np.maximum(
-                                act_hw,
-                                np.asarray(act_guard, np.int64)
-                                / max(fp_n, 1),
-                                out=act_hw,
-                            )
-                            adaptive_active = True
-                            compact_arg = widths_for(bucket)
-                        if isinstance(compact_arg, int):  # adaptation off
-                            compact_arg = (
-                                compact_arg - 1 if compact_arg > 1 else None
-                            )
-                    else:
-                        compact_arg = tuple(
-                            min(2 * w, bucket * a.n_choices) if o else w
-                            for w, o, a in zip(
-                                compact_arg, ovf[:-1], model.actions
-                            )
-                        )
-                        for ai, o in enumerate(ovf[:-1]):
-                            if o:
-                                act_w_floor[ai] = max(
-                                    act_w_floor[ai], compact_arg[ai]
-                                )
+                    # shared escalation policy (AdaptiveCompact): a uniform
+                    # overflow escalates to per-action widths sized from
+                    # THIS attempt's guard counts (phase A sweeps the full
+                    # lattice, so act_guard is complete even on overflow);
+                    # a per-action overflow doubles the offenders, floored
+                    # for the rest of the run
+                    compact_arg = adapt.escalate(
+                        compact_arg,
+                        ovf[:-1],
+                        bucket,
+                        np.asarray(act_guard, np.int64) / max(fp_n, 1),
+                    )
             # adapt buffer sizing from the committed attempt's
             # PRE-constraint guard counts (what the buffers actually hold;
             # act_en is post-constraint and undercounts on pruning models)
             act_en_np = np.asarray(act_en, np.int64)
             act_guard_np = np.asarray(act_guard, np.int64)
-            np.maximum(act_hw, act_guard_np / max(fp_n, 1), out=act_hw)
+            adapt.observe(act_guard_np / max(fp_n, 1))
             # frontier-level verdicts (states being expanded = level `depth`)
             if check_invariants:
                 viol_any_np = np.asarray(viol_any)
@@ -1073,15 +1108,45 @@ def check(
                 verdict = ("deadlock", start + int(dl_idx), "Deadlock")
                 break
             nn = int(new_n)
+            prof_step += time.perf_counter() - t_attempt
+            t_host = time.perf_counter()
             if host_set is not None and nn:
-                rows = np.asarray(out[:nn])
-                mask = host_set.insert(
-                    _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
-                )
-                lvl_rows.append(rows[mask])
-                lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
-                lvl_act.append(np.asarray(out_act[:nn])[mask])
-                lvl_new += int(mask.sum())
+                if use_arena:
+                    if a_w + nn > a_cap:
+                        a_cap = max(2 * a_cap, a_w + nn)
+                        na = np.empty((a_cap, K), np.uint32)
+                        na[:a_w] = a_rows[:a_w]
+                        a_rows = na
+                        npar = np.empty(a_cap, np.int64)
+                        npar[:a_w] = a_parent[:a_w]
+                        a_parent = npar
+                        nact = np.empty(a_cap, np.int32)
+                        nact[:a_w] = a_act[:a_w]
+                        a_act = nact
+                    w = host_set.insert_compact(
+                        np.ascontiguousarray(out_hi[:nn], np.uint32),
+                        np.ascontiguousarray(out_lo[:nn], np.uint32),
+                        np.ascontiguousarray(out[:nn], np.uint32),
+                        np.ascontiguousarray(out_parent[:nn], np.int32),
+                        start,
+                        np.ascontiguousarray(out_act[:nn], np.int32),
+                        a_rows[a_w:],
+                        a_parent[a_w:],
+                        a_act[a_w:],
+                    )
+                    a_w += w
+                    lvl_new += w
+                else:  # numpy-set fallback (no native toolchain)
+                    rows = np.asarray(out[:nn])
+                    mask = host_set.insert(
+                        _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
+                    )
+                    lvl_rows.append(rows[mask])
+                    lvl_parent.append(
+                        np.asarray(out_parent[:nn])[mask] + start
+                    )
+                    lvl_act.append(np.asarray(out_act[:nn])[mask])
+                    lvl_new += int(mask.sum())
             elif ht_hi is not None and nn:
                 # device-hash backend: insert-or-find on the HBM table; a
                 # probe-budget overflow grows the table and re-runs the
@@ -1156,6 +1221,7 @@ def check(
                 lvl_parent.append(np.asarray(out_parent[:nn]) + start)
                 lvl_act.append(np.asarray(out_act[:nn]))
                 lvl_new += nn
+            prof_host_s += time.perf_counter() - t_host
             if collect_stats:
                 lvl_act_en += act_en_np
 
@@ -1173,15 +1239,32 @@ def check(
             break
 
         new_n = lvl_new
-        next_frontier = (
-            np.concatenate(lvl_rows)
-            if lvl_rows
-            else np.empty((0, K), np.uint32)
-        )
-        level_parent = (
-            np.concatenate(lvl_parent) if lvl_parent else np.empty(0, np.int64)
-        )
-        level_act = np.concatenate(lvl_act) if lvl_act else np.empty(0, np.int64)
+        if use_arena:
+            next_frontier = a_rows[:a_w]
+            level_parent = a_parent[:a_w]
+            level_act = a_act[:a_w]
+            if (store_trace or collect_levels is not None) and a_w < int(
+                0.95 * a_cap
+            ):
+                # retained levels: shrink-copy so the trace store doesn't
+                # hold the arena's growth headroom for the whole run
+                next_frontier = next_frontier.copy()
+                level_parent = level_parent.copy()
+                level_act = level_act.copy()
+        else:
+            next_frontier = (
+                np.concatenate(lvl_rows)
+                if lvl_rows
+                else np.empty((0, K), np.uint32)
+            )
+            level_parent = (
+                np.concatenate(lvl_parent)
+                if lvl_parent
+                else np.empty(0, np.int64)
+            )
+            level_act = (
+                np.concatenate(lvl_act) if lvl_act else np.empty(0, np.int64)
+            )
         depth += 1
         if new_n:
             levels.append(new_n)
@@ -1196,6 +1279,8 @@ def check(
                 "duplicates": enabled_total - new_n,
                 "total": total,
                 "level_ms": round((time.perf_counter() - t_level) * 1e3, 1),
+                "step_ms": round(prof_step * 1e3, 1),
+                "host_ms": round(prof_host_s * 1e3, 1),
                 "action_enablement": {
                     a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
                 },
@@ -1242,6 +1327,7 @@ def check(
             "fanout": C,
             "lanes": K,
             "visited_backend": visited_backend,
+            "adaptive_active": adapt.active,
         }
     )
     if host_set is not None:

@@ -56,6 +56,21 @@ def _load():
                 ctypes.c_uint64,
                 ctypes.POINTER(ctypes.c_uint8),
             ]
+            lib.fpset_insert_compact.restype = ctypes.c_uint64
+            lib.fpset_insert_compact.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
             lib.fpset_contains_batch.argtypes = [
                 ctypes.c_void_p,
                 ctypes.POINTER(ctypes.c_uint64),
@@ -109,6 +124,55 @@ class FpSet:
                     self._py.add(fp)
                 out[i] = new
         return out.astype(bool)
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def insert_compact(
+        self,
+        hi: np.ndarray,
+        lo: np.ndarray,
+        rows: np.ndarray,
+        parent: np.ndarray,
+        parent_base: int,
+        act: np.ndarray,
+        arena_rows: np.ndarray,
+        arena_parent: np.ndarray,
+        arena_act: np.ndarray,
+    ) -> int:
+        """Fused insert + novel-row compaction (engine/bfs host backend).
+
+        Inserts fp = hi<<32|lo per candidate; for novel ones appends
+        rows[i] / parent[i]+parent_base / act[i] into the arena slices
+        (which must have >= len(hi) rows of headroom).  Returns the number
+        of rows appended.  One C pass — no u64 temp, no novelty-mask
+        gather, no per-level concatenate.  Requires the native library
+        (callers fall back to insert() + masking when `native` is False).
+        """
+        n = hi.shape[0]
+        assert self._lib is not None
+        assert arena_rows.shape[0] >= n and rows.flags.c_contiguous
+        assert arena_rows.flags.c_contiguous
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        w = self._lib.fpset_insert_compact(
+            self._h,
+            hi.ctypes.data_as(u32p),
+            lo.ctypes.data_as(u32p),
+            n,
+            rows.ctypes.data_as(u32p),
+            rows.shape[1],
+            parent.ctypes.data_as(i32p),
+            parent_base,
+            act.ctypes.data_as(i32p),
+            arena_rows.ctypes.data_as(u32p),
+            arena_parent.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            arena_act.ctypes.data_as(i32p),
+        )
+        if w == np.iinfo(np.uint64).max:
+            raise MemoryError("fpset grow failed")
+        return int(w)
 
     def contains(self, fps: np.ndarray) -> np.ndarray:
         fps = np.ascontiguousarray(fps, dtype=np.uint64)

@@ -129,6 +129,36 @@ uint64_t fpset_insert_batch(void* h, const uint64_t* fps, uint64_t n,
   return added;
 }
 
+// Fused level assembly (engine/bfs host backend): one pass over a chunk's
+// candidates that (a) inserts each (hi,lo) fingerprint, and (b) for the
+// NEW ones only, appends the packed state row, globalized parent index and
+// action id into caller-provided arena slices.  Replaces the Python-side
+// u64 packing + novelty-mask gather + per-level concatenate with a single
+// cache-friendly pass (the probe is the only random access).  Returns the
+// number of rows appended, or UINT64_MAX on alloc failure.
+uint64_t fpset_insert_compact(void* h, const uint32_t* hi, const uint32_t* lo,
+                              uint64_t n, const uint32_t* rows, uint64_t K,
+                              const int32_t* parent_in, int64_t parent_base,
+                              const int32_t* act_in, uint32_t* arena_rows,
+                              int64_t* parent_out, int32_t* act_out) {
+  FpSet* s = static_cast<FpSet*>(h);
+  uint64_t w = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    if ((s->count + 1) * 4 > s->capacity * 3) {
+      if (!grow(s)) return UINT64_MAX;
+    }
+    uint64_t fp = (static_cast<uint64_t>(hi[i]) << 32) |
+                  static_cast<uint64_t>(lo[i]);
+    if (insert_one(s, fp)) {
+      memcpy(arena_rows + w * K, rows + i * K, K * sizeof(uint32_t));
+      parent_out[w] = static_cast<int64_t>(parent_in[i]) + parent_base;
+      act_out[w] = act_in[i];
+      w++;
+    }
+  }
+  return w;
+}
+
 // Membership only (no mutation): out_found[i] = 1 iff present.
 void fpset_contains_batch(void* h, const uint64_t* fps, uint64_t n,
                           uint8_t* out_found) {

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.bfs import (
+    AdaptiveCompact,
     CheckResult,
     Violation,
     _next_pow2,
@@ -150,7 +151,13 @@ def _make_sharded_step(
     expander = _Step(model)
     K, C = spec.num_lanes, expander.C
     D = mesh.devices.size
-    shift = _norm_shift(bucket, int(compact) if compact else 0)
+    # compact: None (full path), int (uniform legacy shift) or a per-action
+    # width tuple (adaptive sizing — engine.bfs.make_expand handles both;
+    # round-5 port of the single-device adaptive compact widths)
+    if isinstance(compact, tuple):
+        shift = compact
+    else:
+        shift = _norm_shift(bucket, int(compact) if compact else 0)
     expand = expander.make_expand(bucket, shift)
     T = expander.expand_width(bucket, shift)
     if exchange not in ("all_to_all", "all_gather"):
@@ -166,7 +173,7 @@ def _make_sharded_step(
         me = jax.lax.axis_index("d")
 
         states = jax.vmap(spec.unpack)(frontier)
-        en_pre, cand, valid, parent, actid, act_en, _act_guard, ovf_expand = expand(
+        en_pre, cand, valid, parent, actid, act_en, act_guard, ovf_expand = expand(
             states, fvalid
         )
         deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
@@ -288,9 +295,13 @@ def _make_sharded_step(
             jnp.any(deadlocked)[None],
             jnp.argmax(deadlocked)[None],
             act_en[None],  # [1, n_actions] -> [D, n_actions]
-            # make_expand reports per-action overflow; the sharded retry is
-            # uniform-shift, so collapse to one flag per shard
-            jnp.any(ovf_expand)[None],
+            # per-action expansion overflow + pre-constraint guard counts:
+            # the host sizes adaptive per-action compact buffers from the
+            # guard histogram exactly as the single-device engine does
+            # (replicated-deterministic — every process sees the same
+            # fetched globals)
+            ovf_expand[None],  # [1, n_actions] -> [D, n_actions]
+            act_guard[None],  # [1, n_actions] -> [D, n_actions]
             ovf_dest[None],
             ovf_probe[None],  # device-hash probe-budget overflow
             out_hi,  # [R] per shard (host-FpSet backend reads these)
@@ -301,7 +312,7 @@ def _make_sharded_step(
         shard_body,
         mesh=mesh,
         in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
-        out_specs=tuple([P("d")] * 17),
+        out_specs=tuple([P("d")] * 18),
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -476,6 +487,22 @@ def check_sharded(
     violation = None
     steps = {}
     w_extra = 0  # extra doublings of the all_to_all per-destination width
+
+    # Adaptive per-action compact sizing (round-5 port of the single-device
+    # engine's policy — one shared implementation, engine.bfs.AdaptiveCompact).
+    # All inputs derive from fetch_global'd arrays and host-known shard
+    # sizes, so every process computes identical widths (replicated-
+    # deterministic — the shard_map operands stay in lockstep).  The
+    # sharded bucket gate stays at this engine's historical 1024.
+    adapt = AdaptiveCompact(model.actions, compact_shift, bucket_gate=1024)
+
+    def _shard_density(act_guard_np, took):
+        """Per-state guard density for the policy: max over shards of
+        guard_counts / shard_rows."""
+        dens = act_guard_np.astype(np.float64) / np.maximum(
+            took.astype(np.float64), 1.0
+        )[:, None]
+        return dens.max(axis=0)
 
     ckpt_path = None
     inv_names = ",".join(sorted(i.name for i in model.invariants))
@@ -673,19 +700,24 @@ def check_sharded(
                 offs[d] += rows.shape[0]
             fvalid = np.arange(bucket)[None, :] < took[:, None]
 
-            # overflow-retry loop: expansion-compaction overflow halves the
-            # shift, destination-bucket overflow doubles the per-dest width;
-            # a failed attempt's visited arrays are simply discarded (the
+            # overflow-retry loop: a uniform-shift expansion overflow
+            # escalates to per-action adaptive widths seeded from the
+            # overflowing attempt's guard counts (or, with adaptation off,
+            # steps the shift toward the full path); a per-action overflow
+            # doubles the offending buffers (floored for the rest of the
+            # run); destination-bucket overflow doubles the per-dest width.
+            # A failed attempt's visited arrays are simply discarded (the
             # step is functional), so results stay exact at every width.
-            # Both retries are CHUNK-LOCAL: one dense or skew-routed chunk
-            # must not pin the whole remaining run to a wider shape (the
-            # compiled steps stay cached either way).
-            sh_try, w_try = compact_shift, w_extra
+            # Width retries are CHUNK-LOCAL (learned floors persist): one
+            # dense or skew-routed chunk must not pin the whole remaining
+            # run to a wider shape (the compiled steps stay cached).
+            attempt, w_try = adapt.widths_for(bucket), w_extra
             while True:
-                sh = _norm_shift(
-                    bucket, sh_try if (sh_try > 0 and bucket >= 1024) else 0
-                )
-                T = expander.expand_width(bucket, sh)
+                if isinstance(attempt, int):
+                    ca = _norm_shift(bucket, attempt) or None
+                else:
+                    ca = attempt  # per-action width tuple, or None (full)
+                T = expander.expand_width(bucket, ca)
                 W = min(T, _default_dest_w(T, D) << w_try)
                 R = D * W if exchange == "all_to_all" else D * T
                 if visited_backend == "device-hash":
@@ -726,14 +758,14 @@ def check_sharded(
                                 jnp.concatenate([dev_vlo, pad], axis=1), shard1
                             )
 
-                key = (bucket, vcap, sh, exchange, W)
+                key = (bucket, vcap, ca, exchange, W)
                 if key not in steps:
                     steps[key] = _make_sharded_step(
                         model,
                         mesh,
                         bucket,
                         vcap,
-                        compact=sh or None,
+                        compact=ca,
                         exchange=exchange,
                         dest_w=W,
                         with_merge=visited_backend == "device",
@@ -753,6 +785,7 @@ def check_sharded(
                     dl_idx,
                     act_en,
                     ovf_expand,
+                    act_guard,
                     ovf_dest,
                     ovf_probe,
                     out_hi,
@@ -764,9 +797,21 @@ def check_sharded(
                     dev_vlo,
                     dev_vn,
                 )
-                if sh and fetch_global(ovf_expand).any():
-                    sh_try = sh - 1
-                    continue
+                if ca is not None:
+                    ovf_np = fetch_global(ovf_expand)  # [D, n_actions]
+                    if ovf_np.any():
+                        # shared escalation policy (engine.bfs
+                        # .AdaptiveCompact): uniform overflow escalates to
+                        # per-action widths from THIS attempt's complete
+                        # guard counts; per-action overflow doubles the
+                        # offenders, floored for the rest of the run
+                        attempt = adapt.escalate(
+                            attempt,  # == ca: _norm_shift only zeroes
+                            ovf_np.any(axis=0),
+                            bucket,
+                            _shard_density(fetch_global(act_guard), took),
+                        )
+                        continue
                 if exchange == "all_to_all" and W < T and fetch_global(ovf_dest).any():
                     w_try += 1
                     continue
@@ -783,6 +828,9 @@ def check_sharded(
                     continue
                 dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
                 break
+            # adapt buffer sizing from the committed attempt's guard counts
+            # (mirrors engine.check; no-op until escalation activates)
+            adapt.observe(_shard_density(fetch_global(act_guard), took))
             # frontier-level verdicts (states being expanded = level `depth`)
             viol_any_np = fetch_global(viol_any)  # [D, n_inv]
             if viol_any_np.any():
@@ -954,6 +1002,7 @@ def check_sharded(
             "fanout": C,
             "visited_backend": visited_backend,
             "exchange": exchange,
+            "adaptive_active": adapt.active,
             **(
                 {
                     "host_fpset_sizes": [

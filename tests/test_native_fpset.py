@@ -126,3 +126,26 @@ def test_host_backend_compact_shift_path():
     assert res.total == 29791  # 31^3 closed-form golden count (RESULTS.md)
     assert res.diameter == 12
     assert res.stats["host_fpset_size"] == 29791
+
+
+def test_host_arena_trace_replays_across_chunks():
+    """Regression for the fused C insert+compact level assembly (round 5):
+    parent indices are globalized inside the C pass (parent_base), so a
+    multi-chunk level must still yield a trace that replays through the
+    oracle transition relation."""
+    m = variants.make_model(
+        "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("TypeOk", "WeakIsr")
+    )
+    # chunk_size far below level sizes forces many parent_base offsets
+    res = check(m, min_bucket=32, chunk_size=32, visited_backend="host")
+    v = res.violation
+    assert v is not None and v.invariant == "WeakIsr" and v.depth == 8
+    o = variants.make_oracle(
+        "KafkaTruncateToHighWatermark", Config(2, 2, 1, 1), ("TypeOk",)
+    )
+    actions = {a.name: a for a in o.actions}
+    cur = o.init_states()[0]
+    assert v.trace[0] == ("<init>", cur)
+    for name, nxt in v.trace[1:]:
+        assert nxt in set(actions[name].successors(cur)), name
+        cur = nxt

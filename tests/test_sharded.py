@@ -209,3 +209,55 @@ def test_sharded_kip320_flagship_full_workload(exchange):
     assert res.total == 737_794, (exchange, res.total)
     assert res.diameter == 25, (exchange, res.diameter)
     assert res.stats["devices"] == 8
+
+
+def test_adaptive_compact_policy_unit():
+    """The shared sizing policy (engine.bfs.AdaptiveCompact): uniform
+    shift until a uniform overflow, then measured widths with learned
+    floors — pure host logic, no devices."""
+    import numpy as np
+
+    from kafka_specification_tpu.engine.bfs import AdaptiveCompact
+
+    class A:  # minimal action stub
+        def __init__(self, n):
+            self.n_choices = n
+
+    acts = [A(4), A(16)]
+    ad = AdaptiveCompact(acts, compact_shift=2, bucket_gate=1024)
+    assert ad.widths_for(512) is None  # below gate -> full path
+    assert ad.widths_for(4096) == 2  # uniform until escalation
+    # uniform overflow escalates using the attempt's guard densities
+    nxt = ad.escalate(2, np.array([True, False]), 4096,
+                      np.array([1.0, 0.01]))
+    assert ad.active and isinstance(nxt, tuple) and len(nxt) == 2
+    # dense action ~1.35*1.0*4096 pow2 -> 8192, clamped to 4*4096=16384 cap
+    assert nxt[0] == 8192 and nxt[1] == 256
+    # per-action overflow doubles the offender and floors it
+    nxt2 = ad.escalate(nxt, np.array([True, False]), 4096,
+                       np.array([1.0, 0.01]))
+    assert nxt2[0] == 16384 == ad.floor[0] and nxt2[1] == 256
+    # widths_for now reflects the floor
+    assert ad.widths_for(4096)[0] == 16384
+
+
+@pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
+def test_sharded_adaptive_escalation_exact(exchange):
+    """Round-5 verdict item 2: the sharded engine escalates to per-action
+    adaptive widths (same policy object as the single-device engine) and
+    stays exact.  A deliberately undersized uniform shift forces the
+    uniform attempt to overflow at the first compact-eligible bucket."""
+    from kafka_specification_tpu.models import kip320
+    from kafka_specification_tpu.models.kafka_replication import Config
+
+    model = kip320.make_model(Config(2, 2, 2, 2))
+    res = check_sharded(
+        model,
+        min_bucket=8192,  # per-shard bucket 1024 -> compact active
+        chunk_size=2048,
+        store_trace=False,
+        compact_shift=6,  # 1024>>6 = 16 rows/action-choice: overflows
+        exchange=exchange,
+    )
+    assert res.ok and res.total == 5973
+    assert res.stats["adaptive_active"] is True
