@@ -30,10 +30,13 @@ import sys
 import time
 
 _CHILD_ENV = "KSPEC_BENCH_CHILD"
-# TPU attempt budget: client init (~20s healthy) + a handful of compiles
-# (~20-40s each through the tunnel) + the 25-level run itself
-_TPU_TIMEOUT = int(os.environ.get("KSPEC_BENCH_TPU_TIMEOUT", "1200"))
-_CPU_TIMEOUT = int(os.environ.get("KSPEC_BENCH_CPU_TIMEOUT", "1800"))
+# TPU attempt budget: client init (~20s healthy) + compiles (~20-40s each
+# through the tunnel) + TWO measured 25-level passes (emitted default +
+# the hand cross-check, each with a warmup) — roughly double the round-4
+# budget so a healthy-but-slow tunnel isn't silently demoted to the CPU
+# fallback mid-benchmark
+_TPU_TIMEOUT = int(os.environ.get("KSPEC_BENCH_TPU_TIMEOUT", "2400"))
+_CPU_TIMEOUT = int(os.environ.get("KSPEC_BENCH_CPU_TIMEOUT", "2700"))
 
 
 def _child_main():
@@ -68,7 +71,17 @@ def _child_main():
     oracle_sps = ores.total / (time.perf_counter() - t0)
     assert ores.total == 737_794, ores.total
 
-    model = kip320.make_model(cfg)
+    # THE measured model is the path users actually get: `cli check`
+    # defaults to the mechanically emitted kernels (utils/tla_emit) when
+    # the reference corpus is on disk, so the headline number is the
+    # emitted flagship (round-5 verdict item 4).  The hand-translated
+    # kernels — the independent cross-check path (`--hand`) — are timed
+    # too and reported as a stderr side-note.
+    from kafka_specification_tpu.models.emitted import make_emitted_model
+
+    invs = ("TypeOk", "LeaderInIsr", "WeakIsr", "StrongIsr")
+    model = make_emitted_model("Kip320", cfg, invariants=invs)
+    hand_model = kip320.make_model(cfg)
     # Backend: on the accelerator the open-addressing HBM hash table
     # (ops/hashset — O(batch) dedup per level, device-resident); on the CPU
     # fallback the native C++ host FpSet (fastest when the "device" IS the
@@ -90,11 +103,16 @@ def _child_main():
     assert res.ok, res.violation
     assert res.total == 737_794, res.total  # oracle-pinned golden count
 
+    check(hand_model, **kwargs)
+    hres = check(hand_model, **kwargs)
+    assert hres.ok and hres.total == 737_794, (hres.total, hres.violation)
+
     print(
         json.dumps(
             {
                 "metric": "Kip320 3-broker exhaustive check (737,794 states, "
-                "4 invariants), distinct states/sec",
+                "4 invariants), EMITTED kernels (the cli default path), "
+                "distinct states/sec",
                 "value": round(res.states_per_sec, 1),
                 "unit": "states/sec",
                 "vs_baseline": round(res.states_per_sec / oracle_sps, 2),
@@ -102,8 +120,10 @@ def _child_main():
         )
     )
     print(
-        f"# engine: {res.seconds:.1f}s wall on {platform}, diameter "
-        f"{res.diameter}, oracle baseline {oracle_sps:.0f} states/sec",
+        f"# emitted (default path): {res.seconds:.1f}s wall on {platform}, "
+        f"diameter {res.diameter}; hand cross-check kernels: "
+        f"{hres.states_per_sec:,.0f} states/sec ({hres.seconds:.1f}s); "
+        f"oracle baseline {oracle_sps:.0f} states/sec",
         file=sys.stderr,
     )
 

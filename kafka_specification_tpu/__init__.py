@@ -59,8 +59,14 @@ def load_config(path):
     return parse_cfg(path)
 
 
-def build_model(module, cfg, oracle=False):
-    """Instantiate a model from a TLA+ module name + parsed TLC config."""
+def build_model(module, cfg, oracle=False, emitted=False, reference=None):
+    """Instantiate a model from a TLA+ module name + parsed TLC config.
+
+    emitted=True builds the mechanically emitted kernels (the CLI's
+    default path when the reference corpus is on disk); reference
+    overrides the checkout location (else KSPEC_REFERENCE)."""
     from .utils.cfg import build_model as _build_model
 
-    return _build_model(module, cfg, oracle=oracle)
+    return _build_model(
+        module, cfg, oracle=oracle, emitted=emitted, reference=reference
+    )

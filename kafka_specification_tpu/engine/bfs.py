@@ -26,6 +26,7 @@ is run with TLC's deadlock check disabled for the same reason).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from dataclasses import dataclass, field
@@ -74,14 +75,12 @@ class AdaptiveCompact:
     """
 
     def __init__(self, actions, compact_shift: int, bucket_gate: int):
-        import os as _os
-
         self.actions = actions
         self.shift = compact_shift
         self.gate = bucket_gate
         self.hw = np.zeros(len(actions), np.float64)
         self.floor = np.zeros(len(actions), np.int64)
-        self.on = _os.environ.get("KSPEC_ADAPTIVE_COMPACT", "1") != "0"
+        self.on = os.environ.get("KSPEC_ADAPTIVE_COMPACT", "1") != "0"
         self.active = False
 
     def widths_for(self, bucket: int):
@@ -157,8 +156,6 @@ class _Step:
     """Builds and caches the jitted level step for one model."""
 
     def __init__(self, model: Model):
-        import os
-
         self.model = model
         self.spec = model.spec
         self.K = self.spec.num_lanes
@@ -654,8 +651,6 @@ def _pad_rows(arr: np.ndarray, n: int, fill=0):
 
 def atomic_savez(path: str, **arrays):
     """np.savez to a tmp file + atomic rename (shared checkpoint writer)."""
-    import os
-
     np.savez(path + ".tmp.npz", **arrays)
     os.replace(path + ".tmp.npz", path)
 
@@ -769,8 +764,6 @@ def check(
 
     ckpt_path = None
     if checkpoint_dir is not None:
-        import os
-
         store_trace = False
         os.makedirs(checkpoint_dir, exist_ok=True)
         ckpt_path = os.path.join(checkpoint_dir, "bfs_checkpoint.npz")
@@ -895,8 +888,6 @@ def check(
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
     )
     if ckpt_path is not None:
-        import os
-
         if os.path.exists(ckpt_path):
             snap = load_validated_snapshot(ckpt_path, ckpt_ident)
             frontier_np = snap["frontier"]
@@ -1186,6 +1177,9 @@ def check(
                             flush=True,
                         )
                     if use_p:
+                        # KSPEC_PALLAS_GROUP: interleaved probe chains per
+                        # round (memory-level parallelism; winners
+                        # bit-identical — ops/pallas_hashset)
                         ht_hi, ht_lo, m, _ni, ovf = (
                             pallas_hs.probe_insert_pallas(
                                 ht_hi,
@@ -1194,6 +1188,9 @@ def check(
                                 out_lo,
                                 valid,
                                 interpret=jax.default_backend() == "cpu",
+                                group=int(
+                                    os.environ.get("KSPEC_PALLAS_GROUP", "8")
+                                ),
                             )
                         )
                         ht_claim = None

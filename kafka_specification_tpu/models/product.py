@@ -25,31 +25,49 @@ from .base import Action, Invariant, Model
 def product_model(base: Model, k: int, name: str | None = None) -> Model:
     """K independent copies of `base` interleaved as one model."""
     assert k >= 1
-    bspec = base.spec
+    return product_models(
+        [base] * k,
+        name=name or f"{base.name} x{k}partitions",
+        meta={**base.meta, "partitions": k, "base": base.name},
+    )
+
+
+def product_models(bases, name: str | None = None, meta: dict | None = None) -> Model:
+    """Product of HETEROGENEOUS independent partitions (round-5 verdict
+    item 5: mixed-base exact products like 277^2 x 5,973 need partitions
+    with different constants, hence different specs and fanouts).
+
+    Per-partition sub-specs may differ; invariant NAMES must agree across
+    bases (the product invariant is the conjunction of each partition's
+    same-named predicate over its own sub-state)."""
+    assert bases
+    specs = [b.spec for b in bases]
+    k = len(bases)
 
     fields = []
-    for p in range(k):
+    for p, bspec in enumerate(specs):
         for f in bspec.fields:
             fields.append(Field(f"p{p}.{f.name}", f.shape, f.lo, f.hi))
     spec = StateSpec(fields)
 
     def split(state, p):
-        return {f.name: state[f"p{p}.{f.name}"] for f in bspec.fields}
+        return {f.name: state[f"p{p}.{f.name}"] for f in specs[p].fields}
 
     def embed(state, p, sub):
         out = dict(state)
-        for f in bspec.fields:
+        for f in specs[p].fields:
             out[f"p{p}.{f.name}"] = sub[f.name]
         return out
 
     def init_states():
-        # K independent instances: the init set is the k-fold cross product
-        # (every corpus model has one deterministic init, but the combinator
-        # must not silently drop mixed-init tuples for bases that don't)
+        # independent instances: the init set is the cross product of the
+        # per-partition init sets (every corpus model has one
+        # deterministic init, but the combinator must not silently drop
+        # mixed-init tuples for bases that don't)
         import itertools
 
         outs = []
-        for combo in itertools.product(base.init_states(), repeat=k):
+        for combo in itertools.product(*[b.init_states() for b in bases]):
             s = {}
             for p, binit in enumerate(combo):
                 for key, v in binit.items():
@@ -58,51 +76,66 @@ def product_model(base: Model, k: int, name: str | None = None) -> Model:
         return outs
 
     actions = []
-    for p in range(k):
-        for a in base.actions:
+    for p, b in enumerate(bases):
+        for a in b.actions:
             def kernel(state, choice, p=p, a=a):
                 ok, nxt = a.kernel(split(state, p), choice)
                 return ok, embed(state, p, nxt)
 
             actions.append(Action(f"p{p}.{a.name}", a.n_choices, kernel))
 
+    inv_names = [i.name for i in bases[0].invariants]
+    for b in bases[1:]:
+        assert [i.name for i in b.invariants] == inv_names, (
+            "product bases must agree on invariant selection: "
+            f"{inv_names} vs {[i.name for i in b.invariants]}"
+        )
     invariants = []
-    for inv in base.invariants:
-        def pred(state, inv=inv):
+    for i_idx, inv_name in enumerate(inv_names):
+        def pred(state, i_idx=i_idx):
             ok = None
-            for p in range(k):
-                r = inv.pred(split(state, p))
+            for p, b in enumerate(bases):
+                r = b.invariants[i_idx].pred(split(state, p))
                 ok = r if ok is None else (ok & r)
             return ok
 
-        invariants.append(Invariant(inv.name, pred))
+        invariants.append(Invariant(inv_name, pred))
 
     constraint = None
-    if base.constraint is not None:
+    if any(b.constraint is not None for b in bases):
         def constraint(state):
             ok = None
-            for p in range(k):
-                r = base.constraint(split(state, p))
+            for p, b in enumerate(bases):
+                if b.constraint is None:
+                    continue
+                r = b.constraint(split(state, p))
                 ok = r if ok is None else (ok & r)
             return ok
 
     decode = None
-    if base.decode is not None:
+    if all(b.decode is not None for b in bases):
         def decode(s):
             return tuple(
-                base.decode({f.name: s[f"p{p}.{f.name}"] for f in bspec.fields})
+                bases[p].decode(
+                    {f.name: s[f"p{p}.{f.name}"] for f in specs[p].fields}
+                )
                 for p in range(k)
             )
 
     return Model(
-        name=name or f"{base.name} x{k}partitions",
+        name=name or " x ".join(b.name for b in bases),
         spec=spec,
         init_states=init_states,
         actions=actions,
         invariants=invariants,
         constraint=constraint,
         decode=decode,
-        meta={**base.meta, "partitions": k, "base": base.name},
+        meta=meta
+        or {
+            **bases[0].meta,
+            "partitions": k,
+            "base": [b.name for b in bases],
+        },
     )
 
 

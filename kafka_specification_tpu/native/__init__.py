@@ -152,8 +152,14 @@ class FpSet:
         """
         n = hi.shape[0]
         assert self._lib is not None
-        assert arena_rows.shape[0] >= n and rows.flags.c_contiguous
-        assert arena_rows.flags.c_contiguous
+        assert rows.flags.c_contiguous and arena_rows.flags.c_contiguous
+        # every arena slice needs headroom for the all-novel worst case —
+        # the C pass writes unchecked
+        assert (
+            arena_rows.shape[0] >= n
+            and arena_parent.shape[0] >= n
+            and arena_act.shape[0] >= n
+        )
         u32p = ctypes.POINTER(ctypes.c_uint32)
         i32p = ctypes.POINTER(ctypes.c_int32)
         w = self._lib.fpset_insert_compact(

@@ -107,8 +107,103 @@ def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
     ovf_ref[0] = ovf
 
 
+def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
+                    _tl, t_hi_ref, t_lo_ref, is_new_ref, ovf_ref):
+    """Interleaved probe: G independent row chains in flight per round.
+
+    TPU Pallas has no vector gather over VMEM (dynamic indexing is scalar
+    or contiguous-slice — pallas guide "Dynamic Indexing"), so a hash
+    probe is irreducibly a dependent-load chain PER ROW.  What CAN be
+    parallelized is memory-level parallelism ACROSS rows: each round
+    issues G independent scalar loads (no cross-dependences, so the
+    scalar unit pipelines them) and then resolves the G rows in
+    row-index order entirely in registers.
+
+    In-register arbitration keeps the sequential-claim contract: row g's
+    loaded value is patched with any slot written by rows h<g in the SAME
+    round (ascending h, so the latest write wins), which makes the commit
+    order strictly row-index order.  Same-fp rows share one probe chain,
+    so the lowest-index row claims and the rest observe its write as a
+    match — `is_new` winners are identical to the row-serial kernel and
+    the jnp path.  Mixed collision chains may land at different slot
+    POSITIONS than the serial kernel (same caveat as the module header:
+    membership and winners never differ; pathological near-full tables
+    can differ in the overflow flag, which only triggers the caller's
+    grow-and-rerun).
+    """
+    block = q_hi_ref.shape[0]
+    cap = t_hi_ref.shape[0]
+    mask = jnp.uint32(cap - 1)
+    sent = jnp.uint32(SENT)
+
+    def group_body(gi, ovf):
+        base = gi * group
+        qh = [q_hi_ref[base + g] for g in range(group)]
+        ql = [q_lo_ref[base + g] for g in range(group)]
+        pos0 = [
+            (hashset._fmix32(ql[g] ^ hashset._fmix32(qh[g])) & mask).astype(
+                jnp.int32
+            )
+            for g in range(group)
+        ]
+        pend0 = [valid_ref[base + g] for g in range(group)]
+
+        def probe_round(_p, carry):
+            pos, pending, isnew = carry
+            # phase 1: G independent loads (the MLP win — no
+            # cross-dependences inside one round)
+            cur_hi = [t_hi_ref[pos[g]] for g in range(group)]
+            cur_lo = [t_lo_ref[pos[g]] for g in range(group)]
+            # phase 2: resolve in row-index order, patching each row's
+            # view with same-round writes by earlier rows
+            npos, npend, nnew = list(pos), list(pending), list(isnew)
+            writes = []  # (slot, hi, lo) committed this round, ascending
+            for g in range(group):
+                ch, cl = cur_hi[g], cur_lo[g]
+                for ws, wh, wl in writes:
+                    hit = pos[g] == ws
+                    ch = jnp.where(hit, wh, ch)
+                    cl = jnp.where(hit, wl, cl)
+                match = pending[g] & (ch == qh[g]) & (cl == ql[g])
+                empty = pending[g] & (ch == sent) & (cl == sent)
+                sh = jnp.where(empty, qh[g], ch)
+                sl = jnp.where(empty, ql[g], cl)
+                t_hi_ref[pos[g]] = sh
+                t_lo_ref[pos[g]] = sl
+                writes.append((pos[g], sh, sl))
+                nnew[g] = isnew[g] | empty
+                advance = pending[g] & ~match & ~empty
+                npos[g] = jnp.where(
+                    advance, (pos[g] + 1) & jnp.int32(cap - 1), pos[g]
+                )
+                npend[g] = advance
+            return tuple(npos), tuple(npend), tuple(nnew)
+
+        pos, pending, isnew = jax.lax.fori_loop(
+            0,
+            max_probes,
+            probe_round,
+            (
+                tuple(pos0),
+                tuple(pend0),
+                tuple(jnp.bool_(False) for _ in range(group)),
+            ),
+        )
+        for g in range(group):
+            is_new_ref[base + g] = isnew[g]
+        for g in range(group):
+            ovf = ovf | pending[g]
+        return ovf
+
+    ovf = jax.lax.fori_loop(
+        0, block // group, group_body, jnp.bool_(False)
+    )
+    ovf_ref[0] = ovf
+
+
 @functools.partial(
-    jax.jit, static_argnames=("max_probes", "block_rows", "interpret")
+    jax.jit,
+    static_argnames=("max_probes", "block_rows", "interpret", "group"),
 )
 def probe_insert_pallas(
     t_hi,
@@ -119,6 +214,7 @@ def probe_insert_pallas(
     max_probes: int = 32,
     block_rows: int = 4096,
     interpret: bool = False,
+    group: int = 1,
 ):
     """Pallas insert-or-find; same contract as hashset.probe_insert minus
     the claim lattice (sequential probing needs no parallel arbitration).
@@ -126,6 +222,12 @@ def probe_insert_pallas(
     Returns (t_hi', t_lo', is_new[M], n_new, overflow).  M must be a
     multiple of block_rows or smaller than it (the engine's buffers are
     powers of two).
+
+    group > 1 selects the interleaved kernel (_kernel_grouped): `group`
+    independent row chains probe per round, so the scalar unit pipelines
+    their loads instead of serializing on one row's dependent-load chain;
+    is_new winners and table membership are identical to group=1 (the
+    in-register arbitration keeps commit order = row-index order).
     """
     import math
 
@@ -135,7 +237,10 @@ def probe_insert_pallas(
     # aligned, so blocks stay >= 256)
     block = math.gcd(m, block_rows)
     grid = (m // block,)
-    kern = functools.partial(_kernel, max_probes)
+    if group > 1 and block % group == 0:
+        kern = functools.partial(_kernel_grouped, max_probes, group)
+    else:
+        kern = functools.partial(_kernel, max_probes)
     t_hi2, t_lo2, is_new, ovf = pl.pallas_call(
         kern,
         grid=grid,

@@ -239,55 +239,45 @@ def build_model(
     raise KeyError(f"unknown module {module!r}")
 
 
-def _emitted_id_sequence(max_id: int, reference=None):
-    from pathlib import Path
-
+def _emitted_inferred(module: str, consts: dict, name: str, reference=None):
+    """Emit a module whose tensor schema is INFERRED from its TypeOk
+    (utils/schema_infer) — no per-module mapping code (round-5 verdict
+    item 7).  Modules whose state needs a representation choice beyond
+    bounds (the message-set encodings of L3/AsyncIsr, PARITY.md) keep
+    their curated schemas in models/emitted — the documented override
+    hook, not this path."""
     from ..models.emitted import ref_path
-    from ..ops.packing import Field, StateSpec
-    from .tla_emit import SInt, build_model as emit
+    from .schema_infer import infer_schemas, spec_from_schemas
+    from .tla_emit import build_model as emit, load_defs
     from .tla_frontend import parse_tla
 
-    mod = parse_tla(ref_path(reference) / "IdSequence.tla")
-    spec = StateSpec([Field("nextId", (), 0, max_id + 1)])
+    ref = ref_path(reference)
+    mod = parse_tla(ref / f"{module}.tla")
+    defs = load_defs(ref, module)
+    schemas = infer_schemas(defs, consts, mod.variables)
     return emit(
-        mod,
+        mod, consts, schemas, spec_from_schemas(schemas), name=name
+    )
+
+
+def _emitted_id_sequence(max_id: int, reference=None):
+    return _emitted_inferred(
+        "IdSequence",
         {"MaxId": max_id},
-        {"nextId": SInt("nextId", 0, max_id + 1)},
-        spec,
-        name=f"IdSequence(emitted,{max_id})",
+        f"IdSequence(emitted,{max_id})",
+        reference,
     )
 
 
 def _emitted_frl(n: int, log_size: int, n_records: int, reference=None):
-    from pathlib import Path
-
-    from ..models.emitted import ref_path
-    from ..ops.packing import Field, StateSpec
-    from .tla_emit import SFun, SInt, SRec, build_model as emit
-    from .tla_frontend import parse_tla
-
-    mod = parse_tla(ref_path(reference) / "FiniteReplicatedLog.tla")
-    spec = StateSpec(
-        [Field("end", (n,), 0, log_size), Field("rec", (n, log_size), -1, n_records - 1)]
-    )
-    schema = SFun(
-        n,
-        SRec(
-            {
-                "endOffset": SInt("end", 0, log_size),
-                "records": SFun(log_size, SInt("rec", -1, n_records - 1)),
-            }
-        ),
-    )
-    return emit(
-        mod,
+    return _emitted_inferred(
+        "FiniteReplicatedLog",
         {
             "Replicas": (0, n - 1),
             "LogRecords": (0, n_records - 1),
             "Nil": -1,
             "LogSize": log_size,
         },
-        {"logs": schema},
-        spec,
-        name=f"FiniteReplicatedLog(emitted,{n}x{log_size})",
+        f"FiniteReplicatedLog(emitted,{n}x{log_size})",
+        reference,
     )

@@ -1,0 +1,200 @@
+"""Generic tensor-schema inference from a module's TypeOk.
+
+The north star wants stock specs to drive the checker with no per-module
+mapping code (BASELINE.json "module-override hook" is the escape hatch,
+not the default).  This module derives the packed tensor schema — the
+(variable -> SInt/SRec/SFun/SBitset) map plus the matching StateSpec —
+mechanically from the parsed TypeOk:
+
+    TypeOk == /\\ nextId \\in IdSet \\union {MaxId + 1}
+              /\\ \\A r \\in Replicas : logs[r] \\in [endOffset: ..., ...]
+
+Procedure (SURVEY.md §2.5 "Spec parsing" row; round-5 verdict item 7):
+
+1. inline() TypeOk over the module's definitions, so named type sets
+   (IdSet, ReplicaLogTypeOk, ...) become structural type expressions.
+2. Flatten the conjunction and collect membership facts:
+   - `var \\in T`                      -> schema[var] = infer(T)
+   - `\\A x \\in D : ... var[x] \\in T ...` with D a 0-based index range
+                                       -> schema[var] = SFun(|D|, infer(T))
+   (non-membership conjuncts — e.g. FiniteReplicatedLog's Nil-fill
+   canonicality clauses — bound *values*, not shapes, and are skipped.)
+3. infer(T) structurally:
+   - RecordType  -> SRec of inferred fields
+   - FunType     -> SFun(|dom|, infer(rng)) for a 0-based int-range dom
+   - PowerSet(S) -> SBitset(|S|)
+   - anything that evaluates concretely to a finite int set (ranges,
+     unions with sentinels like {Nil}, named constant sets) -> SInt with
+     that set's [min, max] bounds.
+4. Emit the StateSpec: one Field per SInt/SBitset leaf, shaped by the
+   enclosing SFun sizes, named by its path — names agree between schema
+   and spec by construction, which is all the emitter requires.
+
+Model-value strings (e.g. None == "NONE") must already be pinned to ints
+in `consts`, exactly as the emitted model builders do (models/emitted).
+Anything outside the supported shape raises SchemaInferenceError — the
+caller falls back to its curated schema (the documented override hook;
+the corpus' message-set encodings SKeyedSet/SPairSet are representation
+*choices* justified in PARITY.md, not inferable bounds).
+"""
+
+from __future__ import annotations
+
+from . import tla_expr as E
+from .tla_concrete import ConcreteEval
+from .tla_emit import SBitset, SFun, SInt, SRec, inline
+from ..ops.packing import Field, StateSpec
+
+
+class SchemaInferenceError(ValueError):
+    pass
+
+
+def _as_int_set(val, what):
+    if not isinstance(val, frozenset) or not all(
+        isinstance(x, int) for x in val
+    ):
+        raise SchemaInferenceError(f"{what} is not a finite int set: {val!r}")
+    return val
+
+
+def _index_size(val, what) -> int:
+    """A function/quantifier domain must be 0..n-1 to become an axis."""
+    s = _as_int_set(val, what)
+    n = len(s)
+    if s != frozenset(range(n)):
+        raise SchemaInferenceError(
+            f"{what} must be a 0-based contiguous index range, got {sorted(s)}"
+        )
+    return n
+
+
+def _norm_consts(consts: dict) -> dict:
+    """Accept the emitted builders' consts convention ((lo, hi) tuples for
+    index sets) and normalize for ConcreteEval."""
+    out = {}
+    for k, v in consts.items():
+        if isinstance(v, tuple) and len(v) == 2:
+            out[k] = frozenset(range(v[0], v[1] + 1))
+        else:
+            out[k] = v
+    return out
+
+
+def infer_schemas(defs: dict, consts: dict, variables) -> dict:
+    """(module defs, consts, declared VARIABLES) -> {var: schema}.
+
+    Raises SchemaInferenceError when TypeOk is absent or any variable's
+    type expression falls outside the supported structural subset.
+    """
+    if "TypeOk" not in defs:
+        raise SchemaInferenceError("module has no TypeOk")
+    ev = ConcreteEval({}, _norm_consts(consts))
+
+    def ev_int_set(t, what):
+        try:
+            return _as_int_set(ev.eval(t, {}), what)
+        except SchemaInferenceError:
+            raise
+        except Exception as e:
+            raise SchemaInferenceError(f"cannot evaluate {what}: {e}") from e
+
+    def infer(t, path: str):
+        if isinstance(t, E.RecordType):
+            return SRec(
+                {n: infer(x, f"{path}_{n}") for n, x in t.fields}
+            )
+        if isinstance(t, E.FunType):
+            n = _index_size(
+                ev_int_set(t.dom, f"{path} function domain"),
+                f"{path} function domain",
+            )
+            return SFun(n, infer(t.rng, path))
+        if isinstance(t, E.PowerSet):
+            n = _index_size(
+                ev_int_set(t.base, f"{path} SUBSET base"),
+                f"{path} SUBSET base",
+            )
+            return SBitset(path, n)
+        s = ev_int_set(t, f"{path} type set")
+        if not s:
+            raise SchemaInferenceError(f"{path} type set is empty")
+        return SInt(path, min(s), max(s))
+
+    body = inline(defs["TypeOk"][1], defs, keep=set())
+    facts = []  # (var, n_outer or None, type-expr)
+
+    def collect(a):
+        if isinstance(a, E.Binop) and a.op == "and":
+            collect(a.a)
+            collect(a.b)
+            return
+        if isinstance(a, E.Binop) and a.op == "\\in":
+            if isinstance(a.a, E.Name):
+                facts.append((a.a.id, None, a.b))
+            return
+        if isinstance(a, E.Quant) and a.kind == "A" and len(a.binds) == 1:
+            var, dom = a.binds[0]
+
+            def inner(b):
+                if isinstance(b, E.Binop) and b.op == "and":
+                    inner(b.a)
+                    inner(b.b)
+                    return
+                if (
+                    isinstance(b, E.Binop)
+                    and b.op == "\\in"
+                    and isinstance(b.a, E.Index)
+                    and isinstance(b.a.base, E.Name)
+                    and isinstance(b.a.idx, E.Name)
+                    and b.a.idx.id == var
+                ):
+                    n = _index_size(
+                        ev_int_set(dom, f"\\A {var} domain"),
+                        f"\\A {var} domain",
+                    )
+                    facts.append((b.a.base.id, n, b.b))
+
+            inner(a.body)
+
+    collect(body)
+    by_var = {}
+    for var, n_outer, texpr in facts:
+        if var in by_var:
+            continue  # first membership fact wins (TypeOk order)
+        s = infer(texpr, var)
+        by_var[var] = SFun(n_outer, s) if n_outer is not None else s
+    missing = [v for v in variables if v not in by_var]
+    if missing:
+        raise SchemaInferenceError(
+            f"TypeOk states no membership bound for variable(s) {missing}"
+        )
+    return {v: by_var[v] for v in variables}
+
+
+def spec_from_schemas(schemas: dict) -> StateSpec:
+    """Flatten inferred schemas into the packed StateSpec.
+
+    Field order follows the schemas dict (VARIABLES declaration order) and
+    record-field order within; shapes stack the enclosing SFun sizes.
+    Field names are the schema leaves' path names, so the emitter's
+    name-keyed lane writes line up by construction.
+    """
+    fields = []
+
+    def walk(s, dims):
+        if isinstance(s, SFun):
+            walk(s.elem, dims + (s.size,))
+        elif isinstance(s, SRec):
+            for sub in s.fields.values():
+                walk(sub, dims)
+        elif isinstance(s, SBitset):
+            fields.append(Field(s.field, dims, 0, (1 << s.size) - 1))
+        elif isinstance(s, SInt):
+            fields.append(Field(s.field, dims, s.lo, s.hi))
+        else:  # pragma: no cover - guarded by infer_schemas
+            raise SchemaInferenceError(f"unsupported schema node {s!r}")
+
+    for s in schemas.values():
+        walk(s, ())
+    return StateSpec(fields)

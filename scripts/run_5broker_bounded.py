@@ -25,6 +25,7 @@ import time
 # value must not be misread as the MINUTES positional.
 _argv = sys.argv[1:]
 MAX_DEPTH = None
+CHUNK = 131072
 _consumed = set()
 for _i, _a in enumerate(_argv):
     if _a.startswith("--max-depth"):
@@ -32,6 +33,12 @@ for _i, _a in enumerate(_argv):
             MAX_DEPTH = int(_a.split("=", 1)[1])
         elif _i + 1 < len(_argv):
             MAX_DEPTH = int(_argv[_i + 1])
+            _consumed.add(_i + 1)
+    elif _a.startswith("--chunk"):
+        if "=" in _a:
+            CHUNK = int(_a.split("=", 1)[1])
+        elif _i + 1 < len(_argv):
+            CHUNK = int(_argv[_i + 1])
             _consumed.add(_i + 1)
 _pos = [
     a
@@ -82,7 +89,7 @@ try:
         model,
         store_trace=False,
         visited_backend="host",
-        chunk_size=131072,
+        chunk_size=CHUNK,
         min_bucket=8192,
         progress=progress,
         max_depth=MAX_DEPTH,

@@ -30,7 +30,10 @@ jax.config.update(
 from kafka_specification_tpu.engine import check  # noqa: E402
 from kafka_specification_tpu.models import kip320  # noqa: E402
 from kafka_specification_tpu.models.kafka_replication import Config  # noqa: E402
-from kafka_specification_tpu.models.product import product_model  # noqa: E402
+from kafka_specification_tpu.models.product import (  # noqa: E402
+    product_model,
+    product_models,
+)
 from kafka_specification_tpu.oracle.interp import oracle_bfs  # noqa: E402
 
 
@@ -40,24 +43,46 @@ def main():
     ap.add_argument("--chunk-size", type=int, default=131072)
     ap.add_argument(
         "--base",
-        choices=["tiny", "2r"],
+        choices=["tiny", "2r", "mixed"],
         default="tiny",
         help="base factor: tiny = Kip320 (2r,L2,R1,E1) = 277 states; "
         "2r = Kip320 (2r,L2,R2,E2) = 5,973 states (5,973^2 = 35,676,729 "
-        "— the next closed-form decade, VERDICT r3 item 6)",
+        "— the next closed-form decade, VERDICT r3 item 6); "
+        "mixed = tiny^2 x 2r (heterogeneous partitions, "
+        "277^2 x 5,973 = 458,345,517 — the half-billion exact product, "
+        "round-5 verdict item 5; --partitions is ignored)",
     )
     args = ap.parse_args()
 
-    base_cfg = Config(2, 2, 1, 1) if args.base == "tiny" else Config(2, 2, 2, 2)
-    base_total = oracle_bfs(
-        kip320.make_oracle(base_cfg), keep_level_sets=False
-    ).total
-    print(f"# base Kip320 {args.base}: {base_total} states (oracle)", flush=True)
+    if args.base == "mixed":
+        # heterogeneous partitions: two TINY factors and one 2r factor
+        # (product_models) — closed form |tiny|^2 * |2r|
+        cfg_t, cfg_2r = Config(2, 2, 1, 1), Config(2, 2, 2, 2)
+        tot_t = oracle_bfs(kip320.make_oracle(cfg_t), keep_level_sets=False).total
+        tot_2r = oracle_bfs(kip320.make_oracle(cfg_2r), keep_level_sets=False).total
+        print(f"# bases: tiny={tot_t}, 2r={tot_2r} (oracle)", flush=True)
+        model = product_models(
+            [
+                kip320.make_model(cfg_t),
+                kip320.make_model(cfg_t),
+                kip320.make_model(cfg_2r),
+            ],
+            name="Kip320 tiny^2 x 2r (mixed product)",
+        )
+        golden = tot_t * tot_t * tot_2r
+        workload = "Kip320 tiny^2 x 2r mixed product exhaustive"
+    else:
+        base_cfg = Config(2, 2, 1, 1) if args.base == "tiny" else Config(2, 2, 2, 2)
+        base_total = oracle_bfs(
+            kip320.make_oracle(base_cfg), keep_level_sets=False
+        ).total
+        print(f"# base Kip320 {args.base}: {base_total} states (oracle)", flush=True)
 
-    model = product_model(kip320.make_model(base_cfg), args.partitions)
-    golden = base_total ** args.partitions
+        model = product_model(kip320.make_model(base_cfg), args.partitions)
+        golden = base_total ** args.partitions
+        workload = f"Kip320 {args.base.upper()} ^{args.partitions} product exhaustive"
     print(
-        f"# product^{args.partitions}: expect {golden:,} distinct states; "
+        f"# product: expect {golden:,} distinct states; "
         f"fanout={model.total_fanout}, lanes={model.spec.num_lanes}",
         flush=True,
     )
@@ -77,7 +102,7 @@ def main():
     print(
         json.dumps(
             {
-                "workload": f"Kip320 TINY ^{args.partitions} product exhaustive",
+                "workload": workload,
                 "distinct_states": res.total,
                 "expected": golden,
                 "match": res.total == golden,

@@ -128,8 +128,11 @@ def _run_stages(record, stage):
         stage("pallas_fingerprint", t0)
         # Pallas hash-probe kernel (ops/pallas_hashset) through the
         # device-hash backend — the ACTUAL TPU dedup kernel, profiled on
-        # hardware for the first time in any window that reaches here
+        # hardware for the first time in any window that reaches here.
+        # group=1 pins the row-serial formulation (the engine default is
+        # the grouped kernel, measured next)
         t0 = time.perf_counter()
+        os.environ["KSPEC_PALLAS_GROUP"] = "1"
         res_hp = check(
             frl.make_model(3, 4, 2, force_hashed=True),
             min_bucket=4096,
@@ -140,9 +143,27 @@ def _run_stages(record, stage):
             "ok": res_hp.total == 29791,
             "states_per_sec": round(res_hp.states_per_sec, 1),
         }
+        stage("pallas_hash_probe", t0)
+        # grouped (interleaved-chain) probe variant: same winners, G
+        # loads in flight per round — the serial-vs-MLP comparison THE
+        # hardware profile exists to answer (ops/pallas_hashset
+        # _kernel_grouped; KSPEC_PALLAS_GROUP routes the engine)
+        t0 = time.perf_counter()
+        os.environ["KSPEC_PALLAS_GROUP"] = "8"
+        res_hg = check(
+            frl.make_model(3, 4, 2, force_hashed=True),
+            min_bucket=4096,
+            visited_backend="device-hash",
+        )
+        record["pallas_hash_probe_grouped"] = {
+            "states": res_hg.total,
+            "ok": res_hg.total == 29791,
+            "states_per_sec": round(res_hg.states_per_sec, 1),
+        }
     finally:
         os.environ.pop("KSPEC_USE_PALLAS", None)
-    stage("pallas_hash_probe", t0)
+        os.environ.pop("KSPEC_PALLAS_GROUP", None)
+    stage("pallas_hash_probe_grouped", t0)
 
     # sharded engine on the chip (mesh of all real devices; 1 on this box)
     t0 = time.perf_counter()

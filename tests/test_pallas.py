@@ -117,3 +117,62 @@ def test_engine_pallas_vmem_gate_falls_back_loudly(monkeypatch, capsys):
     assert res.ok and res.total == 49
     err = capsys.readouterr().err
     assert "exceeds the VMEM-staged kernel's limit" in err
+
+
+def test_pallas_grouped_probe_matches_jnp_winners():
+    """The interleaved (group>1) probe kernel: same is_new winners and
+    table MEMBERSHIP as the jnp claim-lattice path and the row-serial
+    kernel — slot layout may legally differ in mixed collision chains,
+    so the comparison is set-level, not slot-level."""
+    import numpy as np
+
+    from kafka_specification_tpu.ops import hashset
+    from kafka_specification_tpu.ops.pallas_hashset import probe_insert_pallas
+
+    rng = np.random.default_rng(7)
+    cap = 2048  # ~256 distinct inserts -> 1/8 load (no probe overflow;
+    # near-full tables are the documented may-legally-diverge regime)
+    t_hi0, t_lo0 = hashset.new_table(cap)
+    # batch with deliberate duplicates and invalid rows
+    m = 512
+    base = rng.integers(0, 1 << 32, size=(m, 2), dtype=np.uint64)
+    base[m // 2 :] = base[: m // 2]  # every fp appears twice
+    q_hi = jnp.asarray(base[:, 0].astype(np.uint32))
+    q_lo = jnp.asarray(base[:, 1].astype(np.uint32))
+    valid = jnp.asarray(rng.random(m) < 0.9)
+
+    ref_hi, ref_lo, ref_claim, ref_new, _n, ref_ovf = hashset.probe_insert(
+        t_hi0, t_lo0, q_hi, q_lo, valid, claim=hashset.new_claim(cap)
+    )
+    for group in (1, 8):
+        t_hi, t_lo, is_new, _nn, ovf = probe_insert_pallas(
+            hashset.new_table(cap)[0],
+            hashset.new_table(cap)[1],
+            q_hi,
+            q_lo,
+            valid,
+            interpret=True,
+            group=group,
+        )
+        assert not bool(ovf) and not bool(ref_ovf)
+        assert np.array_equal(np.asarray(is_new), np.asarray(ref_new)), group
+        live = lambda h, l: set(
+            zip(np.asarray(h)[np.asarray(h) != hashset.SENT].tolist(),
+                np.asarray(l)[np.asarray(h) != hashset.SENT].tolist())
+        )
+        assert live(t_hi, t_lo) == live(ref_hi, ref_lo), group
+
+
+def test_engine_pallas_grouped_exact(monkeypatch):
+    """Full BFS with the grouped probe kernel routed via
+    KSPEC_PALLAS_GROUP: exact golden count."""
+    monkeypatch.setenv("KSPEC_USE_PALLAS", "1")
+    monkeypatch.setenv("KSPEC_PALLAS_GROUP", "8")
+    from kafka_specification_tpu.engine.bfs import check
+    from kafka_specification_tpu.models import finite_replicated_log as frl
+
+    model = frl.make_model(2, 2, 2, force_hashed=True)
+    res = check(
+        model, min_bucket=32, store_trace=False, visited_backend="device-hash"
+    )
+    assert res.ok and res.total == 49

@@ -45,3 +45,19 @@ def test_product_kafka_variant_matches_oracle():
     res, _ = assert_matches_oracle(model, oracle, min_bucket=1024)
     assert res.ok
     assert res.total == 353 * 353
+
+
+def test_mixed_base_product_closed_form():
+    """product_models (heterogeneous partitions, round-5): the reachable
+    set of Kip320-tiny x IdSequence is exactly 277 * 4 — partitions with
+    entirely different specs, fanouts and kernels interleaved in one
+    model (the shape the 277^2 x 5,973 half-billion run relies on)."""
+    from kafka_specification_tpu.models.product import product_models
+
+    a = kip320.make_model(Config(2, 2, 1, 1), invariants=("TypeOk",))
+    b = id_sequence.make_model(2)  # 4 states; TypeOk only
+    assert [i.name for i in a.invariants] == [i.name for i in b.invariants]
+    m = product_models([a, b])
+    r = check(m, min_bucket=256, store_trace=False, visited_backend="host")
+    assert r.ok
+    assert r.total == 277 * 4

@@ -241,6 +241,7 @@ def test_adaptive_compact_policy_unit():
     assert ad.widths_for(4096)[0] == 16384
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
 def test_sharded_adaptive_escalation_exact(exchange):
     """Round-5 verdict item 2: the sharded engine escalates to per-action
