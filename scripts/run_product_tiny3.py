@@ -94,6 +94,8 @@ def main():
         visited_backend="host",
         chunk_size=args.chunk_size,
         min_bucket=4096,
+        checkpoint_dir=os.environ.get("KSPEC_PROD_CKPT") or None,
+        checkpoint_every=2,
         progress=lambda d, n, t: print(
             f"#   level {d}: +{n:,} -> {t:,} ({time.perf_counter()-t0:.0f}s)",
             flush=True,

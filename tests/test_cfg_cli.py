@@ -1,6 +1,7 @@
 """TLC .cfg parsing, module registry, CLI, and checkpoint/resume."""
 
 import numpy as np
+import pytest
 
 from kafka_specification_tpu.utils.cfg import parse_cfg, build_model
 from kafka_specification_tpu.utils.cli import main as cli_main
@@ -80,6 +81,8 @@ def test_checkpoint_resume(tmp_path):
     assert resumed.ok
 
 
+@pytest.mark.slow  # round-5 fast-suite budget (<=300s): cheaper siblings keep the
+# fast-path coverage; this full variant runs in the slow set
 def test_stretch_config_builds_product_model():
     """The 5-broker/3-partition stretch workload is expressible via the
     authored Partitions constant and explores correctly under a bound."""

@@ -30,6 +30,8 @@ def test_kip320_tiny_exact_match():
     assert res.total == 277
 
 
+@pytest.mark.slow  # round-5 fast-suite budget (<=300s): cheaper siblings keep the
+# fast-path coverage; this full variant runs in the slow set
 def test_kip320_first_try_tiny_exact_match():
     res, _ = assert_matches_oracle(
         kip320.make_first_try_model(TINY, ALL_INVS),

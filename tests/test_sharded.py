@@ -168,6 +168,8 @@ def test_sharded_host_backend_violation_trace():
     assert len(res.violation.trace) == 9
 
 
+@pytest.mark.slow  # round-5 fast-suite budget (<=300s): cheaper siblings keep the
+# fast-path coverage; this full variant runs in the slow set
 def test_sharded_async_isr_constraint_model():
     """AsyncIsr carries the corpus's only state CONSTRAINT
     (AsyncIsr.tla:117-119 is unguarded); the sharded engine must apply it
