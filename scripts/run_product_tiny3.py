@@ -43,18 +43,37 @@ def main():
     ap.add_argument("--chunk-size", type=int, default=131072)
     ap.add_argument(
         "--base",
-        choices=["tiny", "2r", "mixed"],
+        choices=["tiny", "2r", "mixed", "mixed107"],
         default="tiny",
         help="base factor: tiny = Kip320 (2r,L2,R1,E1) = 277 states; "
         "2r = Kip320 (2r,L2,R2,E2) = 5,973 states (5,973^2 = 35,676,729 "
         "— the next closed-form decade, VERDICT r3 item 6); "
         "mixed = tiny^2 x 2r (heterogeneous partitions, "
-        "277^2 x 5,973 = 458,345,517 — the half-billion exact product, "
-        "round-5 verdict item 5; --partitions is ignored)",
+        "277^2 x 5,973 = 458,302,317 — the half-billion exact product, "
+        "round-5 verdict item 5; --partitions is ignored); "
+        "mixed107 = 2r^2 x IdSequence(MaxId=1) "
+        "(5,973^2 x 3 = 107,030,187 — a mixed-base decade past the "
+        "round-4 35.7M, sized to land inside a round; TypeOk only, the "
+        "partitions must agree on invariant names)",
     )
     args = ap.parse_args()
 
-    if args.base == "mixed":
+    if args.base == "mixed107":
+        from kafka_specification_tpu.models import id_sequence
+        cfg_2r = Config(2, 2, 2, 2)
+        tot_2r = oracle_bfs(kip320.make_oracle(cfg_2r), keep_level_sets=False).total
+        print(f"# base Kip320 2r: {tot_2r} states (oracle); IdSequence(1): 3", flush=True)
+        model = product_models(
+            [
+                kip320.make_model(cfg_2r, invariants=("TypeOk",)),
+                kip320.make_model(cfg_2r, invariants=("TypeOk",)),
+                id_sequence.make_model(1),
+            ],
+            name="Kip320 2r^2 x IdSeq1 (mixed product)",
+        )
+        golden = tot_2r * tot_2r * 3
+        workload = "Kip320 2r^2 x IdSequence(1) mixed product exhaustive"
+    elif args.base == "mixed":
         # heterogeneous partitions: two TINY factors and one 2r factor
         # (product_models) — closed form |tiny|^2 * |2r|
         cfg_t, cfg_2r = Config(2, 2, 1, 1), Config(2, 2, 2, 2)
@@ -96,6 +115,7 @@ def main():
         min_bucket=4096,
         checkpoint_dir=os.environ.get("KSPEC_PROD_CKPT") or None,
         checkpoint_every=2,
+        compact_shift=int(os.environ.get("KSPEC_PROD_SHIFT") or 2),
         progress=lambda d, n, t: print(
             f"#   level {d}: +{n:,} -> {t:,} ({time.perf_counter()-t0:.0f}s)",
             flush=True,
