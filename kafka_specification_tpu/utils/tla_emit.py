@@ -492,10 +492,49 @@ class FunV:
         return self._fn(IVal.of(i))
 
 
+# Trace-local cache of leaf state-tensor reads, installed by
+# Emitter.memo_scope: (cache dict, pin list) or None.  The value-protocol
+# wrappers (RecV/FunV/KeyedSetV) are lazy, so the SAME state element is
+# re-read — and re-emits its whole index-op chain — every time a guard or
+# update touches it through a fresh wrapper; the Emitter-level CSE caches
+# the wrappers, not the tensor ops behind their lambdas.  XLA's own CSE
+# recovers only part of this (measured: the optimized flagship expand
+# program stays ~2.4x the hand one).  Keys use concrete ints directly and
+# id() for traced index values; pins keep id()'d objects alive so a
+# recycled address can never alias a distinct read.  Sound because kernels
+# only ever READ from the kernel-input state dict (updates materialize
+# into a fresh dict), so within one memo scope a (state, field, idx) read
+# is a pure function.  Module-global (not per-Emitter) on the standing
+# assumption that tracing is single-threaded in-process — parallelism in
+# this framework is multiprocess.
+_LEAF_MEMO = None
+
+
 def _leaf_tensor(field: str, state: dict, idx: tuple):
+    raws = [k.val if isinstance(k, IVal) else k for k in idx]
+    memo = _LEAF_MEMO
+    if memo is not None:
+        cache, pins = memo
+        key = tuple(
+            [id(state), field]
+            + [
+                int(r) if isinstance(r, (int, np.integer)) else ("t", id(r))
+                for r in raws
+            ]
+        )
+        hit = cache.get(key, cache)
+        if hit is not cache:
+            # a hit's id()-keyed parts necessarily name the pinned (alive)
+            # originals, so no re-pin is needed
+            return hit
     v = state[field]
-    for k in idx:
-        v = v[k.val if isinstance(k, IVal) else k]
+    for r in raws:
+        v = v[r]
+    if memo is not None:
+        cache[key] = v
+        # pin every id()-keyed object at entry creation: as long as the
+        # entry exists, its key ids can never be recycled addresses
+        pins.append((state, [r for r in raws if not isinstance(r, (int, np.integer))]))
     return v
 
 
@@ -707,15 +746,19 @@ class Emitter:
 
         @contextlib.contextmanager
         def scope():
+            global _LEAF_MEMO
             old = self._memo
             old_pins = getattr(self, "_memo_pins", None)
+            old_leaf = _LEAF_MEMO
             self._memo = {}
             self._memo_pins = []
+            _LEAF_MEMO = ({}, [])
             try:
                 yield
             finally:
                 self._memo = old
                 self._memo_pins = old_pins
+                _LEAF_MEMO = old_leaf
 
         return scope()
 
