@@ -127,6 +127,23 @@ class AdaptiveCompact:
                 self.floor[ai] = max(self.floor[ai], nxt[ai])
         return nxt
 
+    def compile_fallback(self, bucket: int):
+        """Shared response to an escalated per-action program failing to
+        COMPILE (XLA:CPU's LLVM has been seen OOMing on the 27-action
+        mixed product's escalated step): escalation is purely a
+        performance knob, so pin adaptation off for the rest of the run
+        and return the uniform attempt to retry the chunk with — the
+        uniform overflow ladder (shift-1 ... full lattice) keeps results
+        exact at every density.  One copy for both engines (the same
+        rationale as this class itself)."""
+        self.on = False
+        self.active = False
+        return (
+            self.shift
+            if self.shift > 0 and bucket >= self.gate
+            else None
+        )
+
 
 @dataclass
 class Violation:
@@ -954,6 +971,7 @@ def check(
     # widths with learned floors — lives in AdaptiveCompact, shared with
     # the sharded engine (docs/PROFILE_5R.md has the measurements).
     adapt = AdaptiveCompact(model.actions, compact_shift, bucket_gate=4096)
+    adaptive_fallback = False
     squeeze_full = False
 
     while frontier_np.shape[0] > 0:
@@ -1025,38 +1043,53 @@ def check(
             attempt_sq_full = squeeze_full
             t_attempt = time.perf_counter()
             while True:
-                step = step_builder.get(
-                    bucket,
-                    vcap,
-                    check_invariants,
-                    with_merge=visited_backend == "device",
-                    compact=compact_arg,
-                    squeeze_full=attempt_sq_full,
-                )
-                (
-                    out,
-                    out_parent,
-                    out_act,
-                    new_n,
-                    vhi_n,
-                    vlo_n,
-                    vn_n,
-                    viol_any,
-                    viol_idx,
-                    dl_any,
-                    dl_idx,
-                    act_en,
-                    out_hi,
-                    out_lo,
-                    overflow,
-                    act_guard,
-                ) = step(
-                    jnp.asarray(_pad_rows(piece, bucket)),
-                    jnp.arange(bucket) < fp_n,
-                    vhi,
-                    vlo,
-                    vn,
-                )
+                try:
+                    step = step_builder.get(
+                        bucket,
+                        vcap,
+                        check_invariants,
+                        with_merge=visited_backend == "device",
+                        compact=compact_arg,
+                        squeeze_full=attempt_sq_full,
+                    )
+                    (
+                        out,
+                        out_parent,
+                        out_act,
+                        new_n,
+                        vhi_n,
+                        vlo_n,
+                        vn_n,
+                        viol_any,
+                        viol_idx,
+                        dl_any,
+                        dl_idx,
+                        act_en,
+                        out_hi,
+                        out_lo,
+                        overflow,
+                        act_guard,
+                    ) = step(
+                        jnp.asarray(_pad_rows(piece, bucket)),
+                        jnp.arange(bucket) < fp_n,
+                        vhi,
+                        vlo,
+                        vn,
+                    )
+                except Exception as e:  # noqa: BLE001 — XLA compile/run
+                    # escalated per-action program failed to compile/run
+                    # (policy + rationale: AdaptiveCompact.compile_fallback)
+                    if not isinstance(compact_arg, (list, tuple)):
+                        raise
+                    print(
+                        "[engine] adaptive compact step failed "
+                        f"({type(e).__name__}); falling back to the "
+                        "uniform compact path for the rest of the run",
+                        file=sys.stderr,
+                    )
+                    compact_arg = adapt.compile_fallback(bucket)
+                    adaptive_fallback = True
+                    continue
                 ovf = np.asarray(overflow)
                 if compact_arg is None or not ovf.any():
                     vhi, vlo, vn = vhi_n, vlo_n, vn_n
@@ -1155,16 +1188,23 @@ def check(
                     # — fall back to the jnp HBM probe, loudly, and keep
                     # checking per iteration (a mid-run rehash can cross
                     # the threshold).
-                    use_p = False
+                    use_p = use_p_hbm = False
                     if step_builder.use_pallas:
                         # lazy import: the default (non-pallas) path must
                         # not depend on jax.experimental.pallas at all
                         from ..ops import pallas_hashset as pallas_hs
 
                         use_p = pallas_hs.fits_vmem(ht_hi.shape[0])
+                        # beyond the VMEM gate: the HBM-resident DMA
+                        # kernel (opt-in until a hardware window profiles
+                        # its per-slot descriptor overhead)
+                        use_p_hbm = not use_p and (
+                            os.environ.get("KSPEC_PALLAS_HBM") == "1"
+                        )
                     if (
                         step_builder.use_pallas
                         and not use_p
+                        and not use_p_hbm
                         and not pallas_vmem_noted
                     ):
                         pallas_vmem_noted = True
@@ -1172,11 +1212,25 @@ def check(
                             "[kspec] KSPEC_USE_PALLAS: table capacity "
                             f"{ht_hi.shape[0]} exceeds the VMEM-staged "
                             f"kernel's limit ({pallas_hs.MAX_VMEM_CAP}); "
-                            "falling back to the jnp HBM probe path",
+                            "falling back to the jnp HBM probe path "
+                            "(KSPEC_PALLAS_HBM=1 selects the HBM-resident "
+                            "DMA kernel instead)",
                             file=sys.stderr,
                             flush=True,
                         )
-                    if use_p:
+                    if use_p_hbm:
+                        ht_hi, ht_lo, m, _ni, ovf = (
+                            pallas_hs.probe_insert_pallas_hbm(
+                                ht_hi,
+                                ht_lo,
+                                out_hi,
+                                out_lo,
+                                valid,
+                                interpret=jax.default_backend() == "cpu",
+                            )
+                        )
+                        ht_claim = None
+                    elif use_p:
                         # KSPEC_PALLAS_GROUP: interleaved probe chains per
                         # round (memory-level parallelism; winners
                         # bit-identical — ops/pallas_hashset)
@@ -1325,6 +1379,7 @@ def check(
             "lanes": K,
             "visited_backend": visited_backend,
             "adaptive_active": adapt.active,
+            "adaptive_compile_fallback": adaptive_fallback,
         }
     )
     if host_set is not None:

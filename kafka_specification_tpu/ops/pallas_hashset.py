@@ -40,6 +40,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from . import hashset
 from .hashset import SENT
@@ -49,14 +50,20 @@ from .hashset import SENT
 # 8 MiB of table, leaving headroom for the batch block, outputs and
 # compiler scratch.  Beyond this the pallas_call simply fails to fit —
 # callers must take the jnp probe path (HBM-resident table) instead; the
-# engine gates on fits_vmem() and falls back loudly (engine/bfs).  An
-# HBM-resident variant (memory_space=ANY + explicit DMA) would lift this.
+# engine gates on fits_vmem() and falls back loudly (engine/bfs), or —
+# with KSPEC_PALLAS_HBM=1 — routes to probe_insert_pallas_hbm, whose
+# table stays in HBM (pl.ANY + per-slot DMA) and has no such gate.
 MAX_VMEM_CAP = 1 << 20
 
 
 def fits_vmem(cap: int) -> bool:
-    """True when a cap-slot table can be VMEM-staged by this kernel."""
-    return cap <= MAX_VMEM_CAP
+    """True when a cap-slot table can be VMEM-staged by this kernel.
+    KSPEC_PALLAS_VMEM_CAP overrides the limit (scripts/tpu_window.py
+    shrinks it to force the HBM-resident kernel on small workloads)."""
+    import os
+
+    lim = int(os.environ.get("KSPEC_PALLAS_VMEM_CAP", MAX_VMEM_CAP))
+    return cap <= lim
 
 
 def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
@@ -74,7 +81,7 @@ def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
     def row_body(i, ovf):
         qh = q_hi_ref[i]
         ql = q_lo_ref[i]
-        v = valid_ref[i]
+        v = valid_ref[i] != 0
         # same slotting as hashset.probe_insert (full avalanche on both
         # lanes so exact64 packs spread uniformly)
         pos0 = (hashset._fmix32(ql ^ hashset._fmix32(qh)) & mask).astype(
@@ -100,11 +107,11 @@ def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
         pos, pending, isnew = jax.lax.fori_loop(
             0, max_probes, probe_body, (pos0, v, jnp.bool_(False))
         )
-        is_new_ref[i] = isnew
+        is_new_ref[i] = jnp.where(isnew, jnp.int32(1), jnp.int32(0))
         return ovf | pending
 
     ovf = jax.lax.fori_loop(0, block, row_body, jnp.bool_(False))
-    ovf_ref[0] = ovf
+    ovf_ref[0] = jnp.where(ovf, jnp.int32(1), jnp.int32(0))
 
 
 def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
@@ -146,7 +153,7 @@ def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
             )
             for g in range(group)
         ]
-        pend0 = [valid_ref[base + g] for g in range(group)]
+        pend0 = [valid_ref[base + g] != 0 for g in range(group)]
 
         def probe_round(_p, carry):
             pos, pending, isnew = carry
@@ -190,7 +197,9 @@ def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
             ),
         )
         for g in range(group):
-            is_new_ref[base + g] = isnew[g]
+            is_new_ref[base + g] = jnp.where(
+                isnew[g], jnp.int32(1), jnp.int32(0)
+            )
         for g in range(group):
             ovf = ovf | pending[g]
         return ovf
@@ -198,7 +207,150 @@ def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
     ovf = jax.lax.fori_loop(
         0, block // group, group_body, jnp.bool_(False)
     )
-    ovf_ref[0] = ovf
+    ovf_ref[0] = jnp.where(ovf, jnp.int32(1), jnp.int32(0))
+
+
+def _kernel_hbm(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
+                t_hi_any, t_lo_any, is_new_ref, ovf_ref,
+                s_rhi, s_rlo, s_whi, s_wlo, sem):
+    """HBM-resident probe: the table never enters VMEM (round-5 item —
+    lifts the MAX_VMEM_CAP gate for real workloads, where
+    cap = pow2(4*states) blows the VMEM-staged kernel).
+
+    The table lanes ride in `pl.ANY` memory space (HBM on hardware);
+    every probe is an explicit single-slot DMA into a VMEM scratch, and
+    every commit a single-slot DMA back (unconditional write-back of
+    either the claim or the unchanged value — the sequential grid makes
+    the read-modify-write race-free, same argument as the row-serial
+    kernel).  The hi/lo lanes' DMAs are started together so the two
+    loads overlap.  Winners/membership are bit-identical to the VMEM
+    kernels and the jnp path (same probe order); per-element DMA is the
+    correctness-first formulation — a block-granular double-buffered
+    variant is the staged next step once a hardware window profiles the
+    descriptor overhead."""
+    block = q_hi_ref.shape[0]
+    cap = t_hi_any.shape[0]
+    mask = jnp.uint32(cap - 1)
+    sent = jnp.uint32(SENT)
+
+    def row_body(i, ovf):
+        qh = q_hi_ref[i]
+        ql = q_lo_ref[i]
+        v = valid_ref[i] != 0
+        pos0 = (hashset._fmix32(ql ^ hashset._fmix32(qh)) & mask).astype(
+            jnp.int32
+        )
+
+        def probe_body(_p, carry):
+            pos, pending, isnew = carry
+            r_hi = pltpu.make_async_copy(
+                t_hi_any.at[pl.ds(pos, 1)], s_rhi, sem.at[0]
+            )
+            r_lo = pltpu.make_async_copy(
+                t_lo_any.at[pl.ds(pos, 1)], s_rlo, sem.at[1]
+            )
+            r_hi.start()
+            r_lo.start()
+            r_hi.wait()
+            r_lo.wait()
+            cur_hi = s_rhi[0]
+            cur_lo = s_rlo[0]
+            match = pending & (cur_hi == qh) & (cur_lo == ql)
+            empty = pending & (cur_hi == sent) & (cur_lo == sent)
+            s_whi[0] = jnp.where(empty, qh, cur_hi)
+            s_wlo[0] = jnp.where(empty, ql, cur_lo)
+            w_hi = pltpu.make_async_copy(
+                s_whi, t_hi_any.at[pl.ds(pos, 1)], sem.at[2]
+            )
+            w_lo = pltpu.make_async_copy(
+                s_wlo, t_lo_any.at[pl.ds(pos, 1)], sem.at[3]
+            )
+            w_hi.start()
+            w_lo.start()
+            w_hi.wait()
+            w_lo.wait()
+            isnew = isnew | empty
+            advance = pending & ~match & ~empty
+            pos = jnp.where(advance, (pos + 1) & jnp.int32(cap - 1), pos)
+            return pos, advance, isnew
+
+        pos, pending, isnew = jax.lax.fori_loop(
+            0, max_probes, probe_body, (pos0, v, jnp.bool_(False))
+        )
+        is_new_ref[i] = jnp.where(isnew, jnp.int32(1), jnp.int32(0))
+        return ovf | pending
+
+    ovf = jax.lax.fori_loop(0, block, row_body, jnp.bool_(False))
+    ovf_ref[0] = jnp.where(ovf, jnp.int32(1), jnp.int32(0))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_probes", "block_rows", "interpret"),
+)
+def probe_insert_pallas_hbm(
+    t_hi,
+    t_lo,
+    q_hi,
+    q_lo,
+    valid,
+    max_probes: int = 32,
+    block_rows: int = 4096,
+    interpret: bool = False,
+):
+    """HBM-resident insert-or-find (no table-size VMEM gate); same
+    contract and return shape as probe_insert_pallas."""
+    import math
+
+    cap = t_hi.shape[0]
+    m = q_hi.shape[0]
+    block = math.gcd(m, block_rows)
+    grid = (m // block,)
+    # bool arrays have a different (wider) rank-1 tiling quantum on real
+    # TPU than the 128 the engine's 256-aligned buffers guarantee, and
+    # the (1,)-block ovf output violates rank-1 tiling outright (first
+    # hardware window, TPU_WINDOW.json) — so flags cross the pallas_call
+    # boundary as int32 (ovf via SMEM) and convert at this wrapper.
+    t_hi2, t_lo2, is_new, ovf = pl.pallas_call(
+        functools.partial(_kernel_hbm, max_probes),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((cap,), jnp.uint32),
+            jax.ShapeDtypeStruct((cap,), jnp.uint32),
+            jax.ShapeDtypeStruct((m,), jnp.int32),
+            jax.ShapeDtypeStruct((grid[0],), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1,), jnp.uint32),
+            pltpu.VMEM((1,), jnp.uint32),
+            pltpu.VMEM((1,), jnp.uint32),
+            pltpu.VMEM((1,), jnp.uint32),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )(q_hi, q_lo, jnp.asarray(valid, jnp.int32), t_hi, t_lo)
+    is_new = is_new != 0
+    return (
+        t_hi2,
+        t_lo2,
+        is_new,
+        jnp.sum(is_new, dtype=jnp.int32),
+        jnp.any(ovf != 0),
+    )
 
 
 @functools.partial(
@@ -255,21 +407,26 @@ def probe_insert_pallas(
             pl.BlockSpec((cap,), lambda i: (0,)),
             pl.BlockSpec((cap,), lambda i: (0,)),
             pl.BlockSpec((block,), lambda i: (i,)),
-            pl.BlockSpec((1,), lambda i: (i,)),
+            # real-TPU rank-1 tiling rejects a (1,)-block vector output,
+            # and bool tiles wider than the 128-quantum the engine's
+            # 256-aligned buffers guarantee (first hardware window,
+            # TPU_WINDOW.json) — flags are int32, ovf lives in SMEM
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((cap,), jnp.uint32),
             jax.ShapeDtypeStruct((cap,), jnp.uint32),
-            jax.ShapeDtypeStruct((m,), jnp.bool_),
-            jax.ShapeDtypeStruct((grid[0],), jnp.bool_),
+            jax.ShapeDtypeStruct((m,), jnp.int32),
+            jax.ShapeDtypeStruct((grid[0],), jnp.int32),
         ],
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
-    )(q_hi, q_lo, valid, t_hi, t_lo)
+    )(q_hi, q_lo, jnp.asarray(valid, jnp.int32), t_hi, t_lo)
+    is_new = is_new != 0
     return (
         t_hi2,
         t_lo2,
         is_new,
         jnp.sum(is_new, dtype=jnp.int32),
-        jnp.any(ovf),
+        jnp.any(ovf != 0),
     )

@@ -24,6 +24,7 @@ v5e-8 pod slice — XLA inserts the ICI collectives.
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Optional
 
@@ -495,6 +496,7 @@ def check_sharded(
     # deterministic — the shard_map operands stay in lockstep).  The
     # sharded bucket gate stays at this engine's historical 1024.
     adapt = AdaptiveCompact(model.actions, compact_shift, bucket_gate=1024)
+    adaptive_fallback = False
 
     def _shard_density(act_guard_np, took):
         """Per-state guard density for the policy: max over shards of
@@ -759,44 +761,60 @@ def check_sharded(
                             )
 
                 key = (bucket, vcap, ca, exchange, W)
-                if key not in steps:
-                    steps[key] = _make_sharded_step(
-                        model,
-                        mesh,
-                        bucket,
-                        vcap,
-                        compact=ca,
-                        exchange=exchange,
-                        dest_w=W,
-                        with_merge=visited_backend == "device",
-                        hash_table=visited_backend == "device-hash",
+                try:
+                    if key not in steps:
+                        steps[key] = _make_sharded_step(
+                            model,
+                            mesh,
+                            bucket,
+                            vcap,
+                            compact=ca,
+                            exchange=exchange,
+                            dest_w=W,
+                            with_merge=visited_backend == "device",
+                            hash_table=visited_backend == "device-hash",
+                        )
+                    (
+                        out,
+                        out_parent,
+                        out_act,
+                        new_n,
+                        vhi_n,
+                        vlo_n,
+                        vn_n,
+                        viol_any,
+                        viol_idx,
+                        dl_any,
+                        dl_idx,
+                        act_en,
+                        ovf_expand,
+                        act_guard,
+                        ovf_dest,
+                        ovf_probe,
+                        out_hi,
+                        out_lo,
+                    ) = steps[key](
+                        put_global(frontier.reshape(D * bucket, K), shard1),
+                        put_global(fvalid.reshape(D * bucket), shard1),
+                        dev_vhi,
+                        dev_vlo,
+                        dev_vn,
                     )
-                (
-                    out,
-                    out_parent,
-                    out_act,
-                    new_n,
-                    vhi_n,
-                    vlo_n,
-                    vn_n,
-                    viol_any,
-                    viol_idx,
-                    dl_any,
-                    dl_idx,
-                    act_en,
-                    ovf_expand,
-                    act_guard,
-                    ovf_dest,
-                    ovf_probe,
-                    out_hi,
-                    out_lo,
-                ) = steps[key](
-                    put_global(frontier.reshape(D * bucket, K), shard1),
-                    put_global(fvalid.reshape(D * bucket), shard1),
-                    dev_vhi,
-                    dev_vlo,
-                    dev_vn,
-                )
+                except Exception as e:  # noqa: BLE001 — XLA compile/run
+                    # escalated per-action program failed to compile/run
+                    # (policy + rationale: AdaptiveCompact.compile_fallback)
+                    if not isinstance(ca, (list, tuple)):
+                        raise
+                    print(
+                        "[sharded] adaptive compact step failed "
+                        f"({type(e).__name__}); falling back to the "
+                        "uniform compact path for the rest of the run",
+                        file=sys.stderr,
+                    )
+                    steps.pop(key, None)
+                    attempt = adapt.compile_fallback(bucket)
+                    adaptive_fallback = True
+                    continue
                 if ca is not None:
                     ovf_np = fetch_global(ovf_expand)  # [D, n_actions]
                     if ovf_np.any():
@@ -1003,6 +1021,7 @@ def check_sharded(
             "visited_backend": visited_backend,
             "exchange": exchange,
             "adaptive_active": adapt.active,
+            "adaptive_compile_fallback": adaptive_fallback,
             **(
                 {
                     "host_fpset_sizes": [

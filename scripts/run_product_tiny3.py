@@ -43,7 +43,7 @@ def main():
     ap.add_argument("--chunk-size", type=int, default=131072)
     ap.add_argument(
         "--base",
-        choices=["tiny", "2r", "mixed", "mixed107"],
+        choices=["tiny", "2r", "mixed", "mixed107", "mixed464"],
         default="tiny",
         help="base factor: tiny = Kip320 (2r,L2,R1,E1) = 277 states; "
         "2r = Kip320 (2r,L2,R2,E2) = 5,973 states (5,973^2 = 35,676,729 "
@@ -54,25 +54,38 @@ def main():
         "mixed107 = 2r^2 x IdSequence(MaxId=1) "
         "(5,973^2 x 3 = 107,030,187 — a mixed-base decade past the "
         "round-4 35.7M, sized to land inside a round; TypeOk only, the "
-        "partitions must agree on invariant names)",
+        "partitions must agree on invariant names); "
+        "mixed464 = 2r^2 x IdSequence(MaxId=11) "
+        "(5,973^2 x 13 = 463,797,477 — the half-billion exact product in "
+        "the kernel shape the 107M run proved sustains ~20k states/sec; "
+        "the tiny^2 x 2r shape degraded to ~9k/s and cannot finish in a "
+        "round from scratch on this box)",
     )
     args = ap.parse_args()
 
-    if args.base == "mixed107":
+    if args.base in ("mixed107", "mixed464"):
         from kafka_specification_tpu.models import id_sequence
+        max_id = 1 if args.base == "mixed107" else 11
+        chain = max_id + 2
         cfg_2r = Config(2, 2, 2, 2)
         tot_2r = oracle_bfs(kip320.make_oracle(cfg_2r), keep_level_sets=False).total
-        print(f"# base Kip320 2r: {tot_2r} states (oracle); IdSequence(1): 3", flush=True)
+        print(
+            f"# base Kip320 2r: {tot_2r} states (oracle); "
+            f"IdSequence({max_id}): {chain}",
+            flush=True,
+        )
         model = product_models(
             [
                 kip320.make_model(cfg_2r, invariants=("TypeOk",)),
                 kip320.make_model(cfg_2r, invariants=("TypeOk",)),
-                id_sequence.make_model(1),
+                id_sequence.make_model(max_id),
             ],
-            name="Kip320 2r^2 x IdSeq1 (mixed product)",
+            name=f"Kip320 2r^2 x IdSeq{max_id} (mixed product)",
         )
-        golden = tot_2r * tot_2r * 3
-        workload = "Kip320 2r^2 x IdSequence(1) mixed product exhaustive"
+        golden = tot_2r * tot_2r * chain
+        workload = (
+            f"Kip320 2r^2 x IdSequence({max_id}) mixed product exhaustive"
+        )
     elif args.base == "mixed":
         # heterogeneous partitions: two TINY factors and one 2r factor
         # (product_models) — closed form |tiny|^2 * |2r|

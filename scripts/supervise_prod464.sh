@@ -1,0 +1,20 @@
+#!/bin/bash
+# Supervisor for the half-billion exact product run (round-5 verdict item 5).
+# Restarts on crash; the engine resumes from the level-synchronous checkpoint
+# in KSPEC_PROD_CKPT (engine/bfs.py checkpoint_every=2).
+cd "$(dirname "$0")/.."
+export KSPEC_PROD_CKPT="${KSPEC_PROD_CKPT:-$PWD/.prod464_ckpt}"
+export KSPEC_ADAPTIVE_COMPACT=0   # uniform compact path: the known-good config
+LOG="${1:-RUNPROD464_r5.log}"
+for attempt in $(seq 1 40); do
+  echo "# supervisor attempt $attempt $(date -u)" >> "$LOG"
+  python scripts/run_product_tiny3.py --base mixed464 >> "$LOG" 2>&1
+  rc=$?
+  echo "# supervisor: attempt $attempt exited rc=$rc $(date -u)" >> "$LOG"
+  if [ $rc -eq 0 ]; then
+    echo "# supervisor: run complete" >> "$LOG"
+    exit 0
+  fi
+  sleep 5
+done
+exit 1

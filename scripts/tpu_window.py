@@ -49,6 +49,8 @@ def _child():
     def stage(name, t0):
         record["stages"][name] = round(time.perf_counter() - t0, 1)
         print(f"# stage {name}: {record['stages'][name]}s", flush=True)
+        _write(record)  # persist after EVERY stage: a hard parent
+        # timeout (SIGKILL, no finally) must not lose banked results
 
     try:
         _run_stages(record, stage)
@@ -119,66 +121,119 @@ def _run_stages(record, stage):
     }
     stage("bench_kip320_3r", t0)
 
-    # Pallas fingerprint kernel on real hardware (interpret=False path)
-    t0 = time.perf_counter()
-    os.environ["KSPEC_USE_PALLAS"] = "1"
-    try:
-        res_p = check(frl.make_model(3, 4, 2), min_bucket=4096)
+    # Every remaining stage runs under its own guard: the first hardware
+    # window (TPU_WINDOW.json, 2026-07-31) died at ONE failing pallas
+    # lowering and lost every stage behind it — a window is too rare to
+    # let one stage's crash discard the rest.
+    def guard(name, fn):
+        t0 = time.perf_counter()
+        try:
+            fn(t0)
+        except Exception as e:  # deliberate aborts (SystemExit,
+            # KeyboardInterrupt) still stop the whole kit via _child()
+            record.setdefault("stage_errors", {})[name] = (
+                f"{type(e).__name__}: {e}"[:500]
+            )
+            print(f"# stage {name} FAILED: {type(e).__name__}", flush=True)
+            _write(record)
+
+    def _pallas_fingerprint(t0):
+        os.environ["KSPEC_USE_PALLAS"] = "1"
+        try:
+            res_p = check(frl.make_model(3, 4, 2), min_bucket=4096)
+        finally:
+            os.environ.pop("KSPEC_USE_PALLAS", None)
         record["pallas"] = {"states": res_p.total, "ok": res_p.total == 29791}
         stage("pallas_fingerprint", t0)
-        # Pallas hash-probe kernel (ops/pallas_hashset) through the
-        # device-hash backend — the ACTUAL TPU dedup kernel, profiled on
-        # hardware for the first time in any window that reaches here.
-        # group=1 pins the row-serial formulation (the engine default is
-        # the grouped kernel, measured next)
-        t0 = time.perf_counter()
-        os.environ["KSPEC_PALLAS_GROUP"] = "1"
-        res_hp = check(
-            frl.make_model(3, 4, 2, force_hashed=True),
-            min_bucket=4096,
-            visited_backend="device-hash",
-        )
-        record["pallas_hash_probe"] = {
-            "states": res_hp.total,
-            "ok": res_hp.total == 29791,
-            "states_per_sec": round(res_hp.states_per_sec, 1),
-        }
-        stage("pallas_hash_probe", t0)
-        # grouped (interleaved-chain) probe variant: same winners, G
-        # loads in flight per round — the serial-vs-MLP comparison THE
-        # hardware profile exists to answer (ops/pallas_hashset
-        # _kernel_grouped; KSPEC_PALLAS_GROUP routes the engine)
-        t0 = time.perf_counter()
-        os.environ["KSPEC_PALLAS_GROUP"] = "8"
-        res_hg = check(
-            frl.make_model(3, 4, 2, force_hashed=True),
-            min_bucket=4096,
-            visited_backend="device-hash",
-        )
-        record["pallas_hash_probe_grouped"] = {
-            "states": res_hg.total,
-            "ok": res_hg.total == 29791,
-            "states_per_sec": round(res_hg.states_per_sec, 1),
-        }
-    finally:
-        os.environ.pop("KSPEC_USE_PALLAS", None)
-        os.environ.pop("KSPEC_PALLAS_GROUP", None)
-    stage("pallas_hash_probe_grouped", t0)
+
+    # Pallas hash-probe kernel (ops/pallas_hashset) through the
+    # device-hash backend — the ACTUAL TPU dedup kernel.  group=1 pins
+    # the row-serial formulation; group=8 the interleaved-chain variant
+    # (the serial-vs-MLP comparison the hardware profile exists to
+    # answer); the hbm variant keeps the table out of VMEM entirely
+    # (per-slot DMA — its descriptor overhead is the open question).
+    def _probe(groups_env, name):
+        def run(t0):
+            os.environ["KSPEC_USE_PALLAS"] = "1"
+            os.environ.update(groups_env)
+            try:
+                res_hp = check(
+                    frl.make_model(3, 4, 2, force_hashed=True),
+                    min_bucket=4096,
+                    visited_backend="device-hash",
+                )
+            finally:
+                os.environ.pop("KSPEC_USE_PALLAS", None)
+                for k in groups_env:
+                    os.environ.pop(k, None)
+            record[name] = {
+                "states": res_hp.total,
+                "ok": res_hp.total == 29791,
+                "states_per_sec": round(res_hp.states_per_sec, 1),
+            }
+            stage(name, t0)
+
+        return run
+
+    guard("pallas_fingerprint", _pallas_fingerprint)
+    guard(
+        "pallas_hash_probe",
+        _probe({"KSPEC_PALLAS_GROUP": "1"}, "pallas_hash_probe"),
+    )
+    guard(
+        "pallas_hash_probe_grouped",
+        _probe({"KSPEC_PALLAS_GROUP": "8"}, "pallas_hash_probe_grouped"),
+    )
+    guard(
+        "pallas_hash_probe_hbm",
+        _probe(
+            {"KSPEC_PALLAS_GROUP": "1", "KSPEC_PALLAS_HBM": "1",
+             "KSPEC_PALLAS_VMEM_CAP": "16"},
+            "pallas_hash_probe_hbm",
+        ),
+    )
 
     # sharded engine on the chip (mesh of all real devices; 1 on this box)
-    t0 = time.perf_counter()
-    from kafka_specification_tpu.parallel.sharded import check_sharded
+    def _sharded(t0):
+        from kafka_specification_tpu.parallel.sharded import check_sharded
 
-    res_s = check_sharded(
-        kip320.make_model(Config(2, 2, 2, 2)), store_trace=False
-    )
-    record["sharded"] = {
-        "devices": jax.device_count(),
-        "states": res_s.total,
-        "ok": res_s.ok,
-        "states_per_sec": round(res_s.states_per_sec, 1),
-    }
-    stage("sharded_kip320_2r", t0)
+        res_s = check_sharded(
+            kip320.make_model(Config(2, 2, 2, 2)), store_trace=False
+        )
+        record["sharded"] = {
+            "devices": jax.device_count(),
+            "states": res_s.total,
+            "ok": res_s.ok,
+            "states_per_sec": round(res_s.states_per_sec, 1),
+        }
+        stage("sharded_kip320_2r", t0)
+
+    guard("sharded_kip320_2r", _sharded)
+
+    # LAST (can eat the remaining budget without losing anything above):
+    # the E3 constants at 9.99M states — large enough levels to amortize
+    # the ~1s/level tunnel dispatch overhead the 3r profile exposed
+    # (TPU_PROFILE.jsonl: level_ms ~1200 at step_ms ~460 on tiny levels)
+    def _e3(t0):
+        res_e3 = check(
+            kip320.make_model(Config(3, 2, 2, 3)),
+            store_trace=False,
+            min_bucket=131072,
+            chunk_size=131072,
+            visited_capacity_hint=11_000_000,
+            visited_backend="device-hash",
+        )
+        record["bench_e3"] = {
+            "workload": "Kip320 3r E3 exhaustive (9,985,570 states), "
+            "device-hash backend",
+            "states": res_e3.total,
+            "ok": res_e3.ok and res_e3.total == 9_985_570,
+            "seconds": round(res_e3.seconds, 1),
+            "states_per_sec": round(res_e3.states_per_sec, 1),
+        }
+        stage("bench_e3", t0)
+
+    guard("bench_e3", _e3)
 
 
 def _write(record):

@@ -123,3 +123,41 @@ def test_multiple_initial_states():
     # reachable: 0..7 from the three seeds
     assert res.total == 8
     assert res.ok
+
+
+def test_adaptive_compile_fallback_exact(monkeypatch):
+    """An escalated per-action compact program that fails to compile must
+    not kill the run: the engine falls back loudly to the uniform path
+    and stays exact (XLA:CPU's LLVM has been seen OOMing on the 27-action
+    mixed product's escalated step — TODO.md known gap, now handled).
+
+    The escalated state is injected (widths_for returns a per-action
+    tuple while adaptation is on) so the test doesn't depend on a model
+    dense enough to overflow organically; the organic uniform-overflow ->
+    escalate path is covered by tests/test_sharded.py's escalation test
+    and the policy unit test."""
+    from kafka_specification_tpu.engine import bfs as bfs_mod
+    from kafka_specification_tpu.models import finite_replicated_log as frl
+
+    orig_get = bfs_mod._Step.get
+    orig_wf = bfs_mod.AdaptiveCompact.widths_for
+
+    def tuple_widths(self, bucket):
+        if self.on:  # pre-fallback: pretend a prior chunk escalated
+            return tuple(256 for _ in self.actions)
+        return orig_wf(self, bucket)
+
+    def failing_get(self, bucket, vcap, *args, **kw):
+        if isinstance(kw.get("compact"), (list, tuple)):
+            raise RuntimeError("synthetic XLA compile failure")
+        return orig_get(self, bucket, vcap, *args, **kw)
+
+    monkeypatch.setattr(bfs_mod.AdaptiveCompact, "widths_for", tuple_widths)
+    monkeypatch.setattr(bfs_mod._Step, "get", failing_get)
+    model = frl.make_model(2, 2, 2)
+    res = check(
+        model, store_trace=False, compact_shift=2, visited_backend="host"
+    )
+    assert res.ok and res.total == 49
+    assert res.stats["adaptive_compile_fallback"] is True
+    assert res.stats["adaptive_active"] is False

@@ -176,3 +176,62 @@ def test_engine_pallas_grouped_exact(monkeypatch):
         model, min_bucket=32, store_trace=False, visited_backend="device-hash"
     )
     assert res.ok and res.total == 49
+
+
+def test_pallas_hbm_probe_matches_jnp():
+    """The HBM-resident probe kernel (table in pl.ANY, per-slot DMA):
+    identical is_new winners and membership vs the jnp path, interpret
+    mode on CPU — same fixture as the VMEM-staged kernel's test."""
+    from kafka_specification_tpu.ops import hashset
+    from kafka_specification_tpu.ops.pallas_hashset import (
+        probe_insert_pallas_hbm,
+    )
+
+    rng = np.random.default_rng(7)
+    cap = 1 << 12
+    m = 1024
+    base = rng.integers(0, 2**32, size=(m, 2), dtype=np.uint32)
+    dup_idx = rng.integers(0, m // 2, size=m // 4)
+    base[m // 2 : m // 2 + m // 4] = base[dup_idx]
+    seeded = base[: m // 8]
+    valid = rng.random(m) < 0.9
+
+    t_hi0, t_lo0 = hashset.table_from_pairs(
+        seeded[:, 0], seeded[:, 1], min_cap=cap
+    )
+    jh, jl, _claim, j_new, j_n, j_ovf = hashset.probe_insert(
+        t_hi0, t_lo0, jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]),
+        jnp.asarray(valid),
+    )
+    ph, plo, p_new, p_n, p_ovf = probe_insert_pallas_hbm(
+        t_hi0, t_lo0, jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]),
+        jnp.asarray(valid), block_rows=256, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(p_new), np.asarray(j_new))
+    assert int(p_n) == int(j_n)
+    assert not bool(j_ovf) and not bool(p_ovf)
+
+    def live(h, l):
+        h, l = np.asarray(h), np.asarray(l)
+        keep = ~((h == hashset.SENT) & (l == hashset.SENT))
+        return set(zip(h[keep].tolist(), l[keep].tolist()))
+
+    assert live(ph, plo) == live(jh, jl)
+
+
+def test_engine_pallas_hbm_beyond_vmem_gate_exact(monkeypatch):
+    """KSPEC_PALLAS_HBM=1 routes tables beyond the VMEM gate through the
+    HBM-resident DMA kernel instead of the jnp fallback — full BFS stays
+    exact (gate shrunk so every insert takes the HBM kernel)."""
+    import kafka_specification_tpu.ops.pallas_hashset as ph
+    from kafka_specification_tpu.engine.bfs import check
+    from kafka_specification_tpu.models import finite_replicated_log as frl
+
+    monkeypatch.setenv("KSPEC_USE_PALLAS", "1")
+    monkeypatch.setenv("KSPEC_PALLAS_HBM", "1")
+    monkeypatch.setattr(ph, "MAX_VMEM_CAP", 16)
+    model = frl.make_model(2, 2, 2, force_hashed=True)
+    res = check(
+        model, min_bucket=32, store_trace=False, visited_backend="device-hash"
+    )
+    assert res.ok and res.total == 49

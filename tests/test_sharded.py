@@ -264,3 +264,40 @@ def test_sharded_adaptive_escalation_exact(exchange):
     )
     assert res.ok and res.total == 5973
     assert res.stats["adaptive_active"] is True
+
+
+@pytest.mark.slow
+def test_sharded_adaptive_compile_fallback_exact(monkeypatch):
+    """Sharded twin of test_engine.test_adaptive_compile_fallback_exact:
+    a failing escalated step pins adaptation off and the run completes
+    exactly on the uniform path.  Escalated state is injected via
+    widths_for (same rationale as the engine test)."""
+    from kafka_specification_tpu.engine import bfs as bfs_mod
+    from kafka_specification_tpu.parallel import sharded as sh_mod
+
+    orig_make = sh_mod._make_sharded_step
+    orig_wf = bfs_mod.AdaptiveCompact.widths_for
+
+    def tuple_widths(self, bucket):
+        if self.on:  # pre-fallback: pretend a prior chunk escalated
+            return tuple(256 for _ in self.actions)
+        return orig_wf(self, bucket)
+
+    def failing_make(model, mesh, bucket, vcap, compact=None, **kw):
+        if isinstance(compact, (list, tuple)):
+            raise RuntimeError("synthetic XLA compile failure")
+        return orig_make(model, mesh, bucket, vcap, compact=compact, **kw)
+
+    monkeypatch.setattr(bfs_mod.AdaptiveCompact, "widths_for", tuple_widths)
+    monkeypatch.setattr(sh_mod, "_make_sharded_step", failing_make)
+    model = kip320.make_model(Config(2, 2, 1, 1))
+    res = check_sharded(
+        model,
+        min_bucket=8192,  # per-shard bucket 1024 -> compact active
+        chunk_size=2048,
+        store_trace=False,
+        exchange="all_to_all",
+    )
+    assert res.ok and res.total == 277
+    assert res.stats["adaptive_compile_fallback"] is True
+    assert res.stats["adaptive_active"] is False
