@@ -116,6 +116,7 @@ def _child_main():
                 "value": round(res.states_per_sec, 1),
                 "unit": "states/sec",
                 "vs_baseline": round(res.states_per_sec / oracle_sps, 2),
+                "platform": platform,
             }
         )
     )
@@ -169,17 +170,70 @@ def _run_child(platform: str, timeout: int):
     return True, p.stdout
 
 
+def _probe_default() -> bool:
+    """Bounded gate before the expensive default-platform attempt: a
+    wedged axon tunnel hangs PJRT init indefinitely, so prove the
+    platform initializes and runs one computation inside a short child
+    (the scripts/tpu_window.py pattern) before spending the full bench
+    budget on it.  Exit 0 = accelerator live; anything else = skip."""
+    env = dict(os.environ)
+    env["KSPEC_BENCH_PROBE"] = "1"
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                timeout=int(os.environ.get("KSPEC_TPU_PROBE_TIMEOUT", "120")),
+                capture_output=True,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        print("# default-platform probe timed out (tunnel wedged)",
+              file=sys.stderr)
+        return False
+
+
 def main():
+    if os.environ.get("KSPEC_BENCH_PROBE"):
+        from kafka_specification_tpu.utils.platform_guard import (
+            platform_ready_probe,
+        )
+
+        raise SystemExit(0 if platform_ready_probe() != "cpu" else 4)
     if os.environ.get(_CHILD_ENV):
         _child_main()
         return
-    ok, out = _run_child("default", _TPU_TIMEOUT)
-    if not ok:
-        print("# falling back to CPU", file=sys.stderr)
-        ok, out = _run_child("cpu", _CPU_TIMEOUT)
-    if not ok:
+    # Measure BOTH venues when the accelerator is reachable and report
+    # the faster one: the flagship is only 737k states, so through the
+    # remote tunnel the per-level dispatch latency (~1.2s/level,
+    # TPU_PROFILE.jsonl) can make the chip the slower venue for THIS
+    # workload even when it is perfectly healthy — a checking session
+    # should run where it finishes first, and the headline says which
+    # venue that was ("platform" field).  TPU_WINDOW.json holds the
+    # dedicated hardware numbers either way.
+    candidates = []
+    if _probe_default():
+        ok, out = _run_child("default", _TPU_TIMEOUT)
+        if ok:
+            candidates.append(out)
+    else:
+        print("# default platform not live — CPU only", file=sys.stderr)
+    ok, out = _run_child("cpu", _CPU_TIMEOUT)
+    if ok:
+        candidates.append(out)
+    if not candidates:
         raise SystemExit("both default-platform and CPU bench attempts failed")
-    sys.stdout.write(out)
+    parsed = [(json.loads(c.strip().splitlines()[-1]), c) for c in candidates]
+    parsed.sort(key=lambda p: -p[0]["value"])
+    if len(parsed) == 2:
+        loser = parsed[1][0]
+        print(
+            f"# slower venue: {loser['platform']} at {loser['value']} "
+            f"{loser['unit']} (not the headline)",
+            file=sys.stderr,
+        )
+    sys.stdout.write(parsed[0][1])
 
 
 if __name__ == "__main__":

@@ -67,18 +67,26 @@ def fits_vmem(cap: int) -> bool:
 
 
 def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
-            t_hi_ref, t_lo_ref, is_new_ref, ovf_ref):
+            t_hi_ref, t_lo_ref, is_new_ref):
     """One batch block: probe/insert each row serially (see module doc).
 
     _ti/_tl are the aliased input views of the table; all access goes
     through the output refs (same memory) so grid steps see each other's
-    inserts."""
+    inserts.
+
+    is_new_ref is int32 and TERNARY: 0 = seen / invalid, 1 = new
+    (this row claimed the slot), 2 = probe-budget overflow (row still
+    pending after max_probes).  Real-TPU rank-1 tiling rejects both a
+    (1,)-block scalar output and bool blocks at the engine's 256-row
+    alignment (first hardware windows, TPU_WINDOW.json), so the
+    overflow flag rides in the one well-tiled output instead of its own
+    lane, and the wrapper splits the encoding."""
     block = q_hi_ref.shape[0]
     cap = t_hi_ref.shape[0]
     mask = jnp.uint32(cap - 1)
     sent = jnp.uint32(SENT)
 
-    def row_body(i, ovf):
+    def row_body(i, carry):
         qh = q_hi_ref[i]
         ql = q_lo_ref[i]
         v = valid_ref[i] != 0
@@ -95,9 +103,11 @@ def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
             match = pending & (cur_hi == qh) & (cur_lo == ql)
             empty = pending & (cur_hi == sent) & (cur_lo == sent)
             # sequential claim: first (lowest-index) claimant wins; the
-            # masked store keeps the slot unchanged for non-claimants
-            t_hi_ref[pos] = jnp.where(empty, qh, cur_hi)
-            t_lo_ref[pos] = jnp.where(empty, ql, cur_lo)
+            # masked store keeps the slot unchanged for non-claimants.
+            # (1,)-slice stores, not scalar stores: real-TPU lowering
+            # rejects scalar stores to VMEM (hardware window 2)
+            t_hi_ref[pl.ds(pos, 1)] = jnp.where(empty, qh, cur_hi)[None]
+            t_lo_ref[pl.ds(pos, 1)] = jnp.where(empty, ql, cur_lo)[None]
             isnew = isnew | empty
             advance = pending & ~match & ~empty
             pos = jnp.where(advance, (pos + 1) & jnp.int32(cap - 1), pos)
@@ -107,15 +117,16 @@ def _kernel(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
         pos, pending, isnew = jax.lax.fori_loop(
             0, max_probes, probe_body, (pos0, v, jnp.bool_(False))
         )
-        is_new_ref[i] = jnp.where(isnew, jnp.int32(1), jnp.int32(0))
-        return ovf | pending
+        is_new_ref[pl.ds(i, 1)] = jnp.where(
+            pending, jnp.int32(2), jnp.where(isnew, jnp.int32(1), jnp.int32(0))
+        )[None]
+        return carry
 
-    ovf = jax.lax.fori_loop(0, block, row_body, jnp.bool_(False))
-    ovf_ref[0] = jnp.where(ovf, jnp.int32(1), jnp.int32(0))
+    jax.lax.fori_loop(0, block, row_body, 0)
 
 
 def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
-                    _tl, t_hi_ref, t_lo_ref, is_new_ref, ovf_ref):
+                    _tl, t_hi_ref, t_lo_ref, is_new_ref):
     """Interleaved probe: G independent row chains in flight per round.
 
     TPU Pallas has no vector gather over VMEM (dynamic indexing is scalar
@@ -143,7 +154,7 @@ def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
     mask = jnp.uint32(cap - 1)
     sent = jnp.uint32(SENT)
 
-    def group_body(gi, ovf):
+    def group_body(gi, carry):
         base = gi * group
         qh = [q_hi_ref[base + g] for g in range(group)]
         ql = [q_lo_ref[base + g] for g in range(group)]
@@ -175,8 +186,8 @@ def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
                 empty = pending[g] & (ch == sent) & (cl == sent)
                 sh = jnp.where(empty, qh[g], ch)
                 sl = jnp.where(empty, ql[g], cl)
-                t_hi_ref[pos[g]] = sh
-                t_lo_ref[pos[g]] = sl
+                t_hi_ref[pl.ds(pos[g], 1)] = sh[None]
+                t_lo_ref[pl.ds(pos[g], 1)] = sl[None]
                 writes.append((pos[g], sh, sl))
                 nnew[g] = isnew[g] | empty
                 advance = pending[g] & ~match & ~empty
@@ -197,21 +208,20 @@ def _kernel_grouped(max_probes, group, q_hi_ref, q_lo_ref, valid_ref, _ti,
             ),
         )
         for g in range(group):
-            is_new_ref[base + g] = jnp.where(
-                isnew[g], jnp.int32(1), jnp.int32(0)
-            )
-        for g in range(group):
-            ovf = ovf | pending[g]
-        return ovf
+            # ternary encoding (see _kernel): 2 = still pending after
+            # max_probes rounds = probe-budget overflow
+            is_new_ref[pl.ds(base + g, 1)] = jnp.where(
+                pending[g],
+                jnp.int32(2),
+                jnp.where(isnew[g], jnp.int32(1), jnp.int32(0)),
+            )[None]
+        return carry
 
-    ovf = jax.lax.fori_loop(
-        0, block // group, group_body, jnp.bool_(False)
-    )
-    ovf_ref[0] = jnp.where(ovf, jnp.int32(1), jnp.int32(0))
+    jax.lax.fori_loop(0, block // group, group_body, 0)
 
 
 def _kernel_hbm(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
-                t_hi_any, t_lo_any, is_new_ref, ovf_ref,
+                t_hi_any, t_lo_any, is_new_ref,
                 s_rhi, s_rlo, s_whi, s_wlo, sem):
     """HBM-resident probe: the table never enters VMEM (round-5 item —
     lifts the MAX_VMEM_CAP gate for real workloads, where
@@ -233,7 +243,7 @@ def _kernel_hbm(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
     mask = jnp.uint32(cap - 1)
     sent = jnp.uint32(SENT)
 
-    def row_body(i, ovf):
+    def row_body(i, carry):
         qh = q_hi_ref[i]
         ql = q_lo_ref[i]
         v = valid_ref[i] != 0
@@ -257,8 +267,8 @@ def _kernel_hbm(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
             cur_lo = s_rlo[0]
             match = pending & (cur_hi == qh) & (cur_lo == ql)
             empty = pending & (cur_hi == sent) & (cur_lo == sent)
-            s_whi[0] = jnp.where(empty, qh, cur_hi)
-            s_wlo[0] = jnp.where(empty, ql, cur_lo)
+            s_whi[:] = jnp.where(empty, qh, cur_hi)[None]
+            s_wlo[:] = jnp.where(empty, ql, cur_lo)[None]
             w_hi = pltpu.make_async_copy(
                 s_whi, t_hi_any.at[pl.ds(pos, 1)], sem.at[2]
             )
@@ -277,11 +287,12 @@ def _kernel_hbm(max_probes, q_hi_ref, q_lo_ref, valid_ref, _ti, _tl,
         pos, pending, isnew = jax.lax.fori_loop(
             0, max_probes, probe_body, (pos0, v, jnp.bool_(False))
         )
-        is_new_ref[i] = jnp.where(isnew, jnp.int32(1), jnp.int32(0))
-        return ovf | pending
+        is_new_ref[pl.ds(i, 1)] = jnp.where(
+            pending, jnp.int32(2), jnp.where(isnew, jnp.int32(1), jnp.int32(0))
+        )[None]
+        return carry
 
-    ovf = jax.lax.fori_loop(0, block, row_body, jnp.bool_(False))
-    ovf_ref[0] = jnp.where(ovf, jnp.int32(1), jnp.int32(0))
+    jax.lax.fori_loop(0, block, row_body, 0)
 
 
 @functools.partial(
@@ -306,12 +317,12 @@ def probe_insert_pallas_hbm(
     m = q_hi.shape[0]
     block = math.gcd(m, block_rows)
     grid = (m // block,)
-    # bool arrays have a different (wider) rank-1 tiling quantum on real
-    # TPU than the 128 the engine's 256-aligned buffers guarantee, and
-    # the (1,)-block ovf output violates rank-1 tiling outright (first
-    # hardware window, TPU_WINDOW.json) — so flags cross the pallas_call
-    # boundary as int32 (ovf via SMEM) and convert at this wrapper.
-    t_hi2, t_lo2, is_new, ovf = pl.pallas_call(
+    # real-TPU rank-1 tiling rejects a (1,)-block scalar output and bool
+    # blocks at the engine's 256-row alignment (hardware windows 1-2,
+    # TPU_WINDOW.json) — so flags cross the pallas_call boundary as ONE
+    # ternary int32 lane (0 = seen, 1 = new, 2 = probe overflow) and the
+    # wrapper splits the encoding.
+    t_hi2, t_lo2, is_new3 = pl.pallas_call(
         functools.partial(_kernel_hbm, max_probes),
         grid=grid,
         in_specs=[
@@ -325,13 +336,11 @@ def probe_insert_pallas_hbm(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((block,), lambda i: (i,)),
-            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((cap,), jnp.uint32),
             jax.ShapeDtypeStruct((cap,), jnp.uint32),
             jax.ShapeDtypeStruct((m,), jnp.int32),
-            jax.ShapeDtypeStruct((grid[0],), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((1,), jnp.uint32),
@@ -343,13 +352,13 @@ def probe_insert_pallas_hbm(
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
     )(q_hi, q_lo, jnp.asarray(valid, jnp.int32), t_hi, t_lo)
-    is_new = is_new != 0
+    is_new = is_new3 == 1
     return (
         t_hi2,
         t_lo2,
         is_new,
         jnp.sum(is_new, dtype=jnp.int32),
-        jnp.any(ovf != 0),
+        jnp.any(is_new3 == 2),
     )
 
 
@@ -393,7 +402,12 @@ def probe_insert_pallas(
         kern = functools.partial(_kernel_grouped, max_probes, group)
     else:
         kern = functools.partial(_kernel, max_probes)
-    t_hi2, t_lo2, is_new, ovf = pl.pallas_call(
+    # real-TPU rank-1 tiling rejects a (1,)-block scalar output and bool
+    # blocks at the engine's 256-row alignment (hardware windows 1-2,
+    # TPU_WINDOW.json) — so flags cross the pallas_call boundary as ONE
+    # ternary int32 lane (0 = seen, 1 = new, 2 = probe overflow) and the
+    # wrapper splits the encoding.
+    t_hi2, t_lo2, is_new3 = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -407,26 +421,20 @@ def probe_insert_pallas(
             pl.BlockSpec((cap,), lambda i: (0,)),
             pl.BlockSpec((cap,), lambda i: (0,)),
             pl.BlockSpec((block,), lambda i: (i,)),
-            # real-TPU rank-1 tiling rejects a (1,)-block vector output,
-            # and bool tiles wider than the 128-quantum the engine's
-            # 256-aligned buffers guarantee (first hardware window,
-            # TPU_WINDOW.json) — flags are int32, ovf lives in SMEM
-            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((cap,), jnp.uint32),
             jax.ShapeDtypeStruct((cap,), jnp.uint32),
             jax.ShapeDtypeStruct((m,), jnp.int32),
-            jax.ShapeDtypeStruct((grid[0],), jnp.int32),
         ],
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
     )(q_hi, q_lo, jnp.asarray(valid, jnp.int32), t_hi, t_lo)
-    is_new = is_new != 0
+    is_new = is_new3 == 1
     return (
         t_hi2,
         t_lo2,
         is_new,
         jnp.sum(is_new, dtype=jnp.int32),
-        jnp.any(ovf != 0),
+        jnp.any(is_new3 == 2),
     )

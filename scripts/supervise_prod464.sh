@@ -8,8 +8,9 @@ export KSPEC_ADAPTIVE_COMPACT=0   # uniform compact path: the known-good config
 LOG="${1:-RUNPROD464_r5.log}"
 for attempt in $(seq 1 40); do
   echo "# supervisor attempt $attempt $(date -u)" >> "$LOG"
-  python scripts/run_product_tiny3.py --base mixed464 >> "$LOG" 2>&1
-  rc=$?
+  python scripts/run_product_tiny3.py --base mixed464 2>&1 \
+    | grep --line-buffered -v cpu_aot_loader >> "$LOG"
+  rc=${PIPESTATUS[0]}
   echo "# supervisor: attempt $attempt exited rc=$rc $(date -u)" >> "$LOG"
   if [ $rc -eq 0 ]; then
     echo "# supervisor: run complete" >> "$LOG"

@@ -1,0 +1,74 @@
+"""Minimal on-chip smoke for the Pallas probe kernels (iteration tool).
+
+The full window kit spends ~4 min on benches before reaching the probe
+stages; when iterating on a LOWERING error this script goes straight
+there: the shared dedup fixture (ops/probe_fixture — one definition of
+"same winners as the jnp path", also used by tests/test_pallas.py), all
+three kernels, non-interpret.  Exits non-zero if any kernel errors or
+diverges, with everything banked in TPU_SMOKE.json.
+
+Usage:  python scripts/tpu_probe_smoke.py        (on the live tunnel)
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main():
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache")
+    )
+    from kafka_specification_tpu.ops.pallas_hashset import (
+        probe_insert_pallas,
+        probe_insert_pallas_hbm,
+    )
+    from kafka_specification_tpu.ops.probe_fixture import (
+        assert_same_winners,
+        make_probe_case,
+    )
+
+    record = {"started": time.time(), "platform": jax.devices()[0].platform}
+    print(f"# platform: {record['platform']}", flush=True)
+    case = make_probe_case(seed=11)
+
+    def run(name, fn):
+        t0 = time.perf_counter()
+        try:
+            th, tl, p_new, p_n, _ovf = fn()
+            assert_same_winners(case, th, tl, p_new, p_n)
+            record[name] = {
+                "ok": True,
+                "seconds": round(time.perf_counter() - t0, 2),
+            }
+            print(f"# {name}: ok ({record[name]['seconds']}s)", flush=True)
+        except Exception as e:  # noqa: BLE001 — bank the lowering error
+            record[name] = {
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:600],
+            }
+            print(f"# {name} FAILED: {type(e).__name__}", flush=True)
+
+    args = (case["t_hi0"], case["t_lo0"], case["q_hi"], case["q_lo"],
+            case["valid"])
+    run("serial", lambda: probe_insert_pallas(*args, block_rows=256))
+    run("grouped", lambda: probe_insert_pallas(
+        *args, block_rows=256, group=8))
+    run("hbm", lambda: probe_insert_pallas_hbm(*args, block_rows=256))
+
+    with open(os.path.join(_REPO, "TPU_SMOKE.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+    failed = [k for k, v in record.items()
+              if isinstance(v, dict) and not v.get("ok", False)]
+    print(json.dumps(record), flush=True)
+    raise SystemExit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,42 +44,21 @@ def test_engine_with_pallas_fingerprints_matches_golden(monkeypatch):
 def test_pallas_hash_probe_matches_jnp():
     """The Pallas open-addressing probe (sequential-grid row-serial form)
     against hashset.probe_insert: identical is_new winners, identical
-    membership, on a batch with in-batch duplicates and pre-seeded
-    entries (interpret mode on CPU)."""
-    from kafka_specification_tpu.ops import hashset
+    membership, on the shared fixture (ops/probe_fixture — in-batch
+    duplicates, pre-seeded entries, invalid rows; interpret on CPU)."""
     from kafka_specification_tpu.ops.pallas_hashset import probe_insert_pallas
-
-    rng = np.random.default_rng(5)
-    cap = 1 << 12
-    m = 1024
-    # ~25% in-batch duplicates + some rows colliding with pre-seeded fps
-    base = rng.integers(0, 2**32, size=(m, 2), dtype=np.uint32)
-    dup_idx = rng.integers(0, m // 2, size=m // 4)
-    base[m // 2 : m // 2 + m // 4] = base[dup_idx]
-    seeded = base[: m // 8]  # already in the table
-    valid = rng.random(m) < 0.9
-
-    t_hi0, t_lo0 = hashset.table_from_pairs(seeded[:, 0], seeded[:, 1], min_cap=cap)
-
-    jh, jl, _claim, j_new, j_n, j_ovf = hashset.probe_insert(
-        t_hi0, t_lo0, jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]),
-        jnp.asarray(valid),
+    from kafka_specification_tpu.ops.probe_fixture import (
+        assert_same_winners,
+        make_probe_case,
     )
+
+    case = make_probe_case(seed=5)
     ph, plo, p_new, p_n, p_ovf = probe_insert_pallas(
-        t_hi0, t_lo0, jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]),
-        jnp.asarray(valid), block_rows=256, interpret=True,
+        case["t_hi0"], case["t_lo0"], case["q_hi"], case["q_lo"],
+        case["valid"], block_rows=256, interpret=True,
     )
-    # winners bit-identical (lowest-index row per distinct new fingerprint)
-    np.testing.assert_array_equal(np.asarray(p_new), np.asarray(j_new))
-    assert int(p_n) == int(j_n)
-    assert not bool(j_ovf) and not bool(p_ovf)
-    # membership identical: the live fingerprint SETS agree (slot layout
-    # may legally differ in mixed collision chains)
-    def live(h, l):
-        h, l = np.asarray(h), np.asarray(l)
-        keep = ~((h == hashset.SENT) & (l == hashset.SENT))
-        return set(zip(h[keep].tolist(), l[keep].tolist()))
-    assert live(ph, plo) == live(jh, jl)
+    assert not bool(p_ovf)
+    assert_same_winners(case, ph, plo, p_new, p_n)
 
 
 def test_engine_device_hash_with_pallas_probe_matches_golden(monkeypatch):
@@ -181,42 +160,23 @@ def test_engine_pallas_grouped_exact(monkeypatch):
 def test_pallas_hbm_probe_matches_jnp():
     """The HBM-resident probe kernel (table in pl.ANY, per-slot DMA):
     identical is_new winners and membership vs the jnp path, interpret
-    mode on CPU — same fixture as the VMEM-staged kernel's test."""
-    from kafka_specification_tpu.ops import hashset
+    mode on CPU — same shared fixture as the VMEM-staged kernel's test
+    (ops/probe_fixture), different seed."""
     from kafka_specification_tpu.ops.pallas_hashset import (
         probe_insert_pallas_hbm,
     )
-
-    rng = np.random.default_rng(7)
-    cap = 1 << 12
-    m = 1024
-    base = rng.integers(0, 2**32, size=(m, 2), dtype=np.uint32)
-    dup_idx = rng.integers(0, m // 2, size=m // 4)
-    base[m // 2 : m // 2 + m // 4] = base[dup_idx]
-    seeded = base[: m // 8]
-    valid = rng.random(m) < 0.9
-
-    t_hi0, t_lo0 = hashset.table_from_pairs(
-        seeded[:, 0], seeded[:, 1], min_cap=cap
+    from kafka_specification_tpu.ops.probe_fixture import (
+        assert_same_winners,
+        make_probe_case,
     )
-    jh, jl, _claim, j_new, j_n, j_ovf = hashset.probe_insert(
-        t_hi0, t_lo0, jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]),
-        jnp.asarray(valid),
-    )
+
+    case = make_probe_case(seed=7)
     ph, plo, p_new, p_n, p_ovf = probe_insert_pallas_hbm(
-        t_hi0, t_lo0, jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]),
-        jnp.asarray(valid), block_rows=256, interpret=True,
+        case["t_hi0"], case["t_lo0"], case["q_hi"], case["q_lo"],
+        case["valid"], block_rows=256, interpret=True,
     )
-    np.testing.assert_array_equal(np.asarray(p_new), np.asarray(j_new))
-    assert int(p_n) == int(j_n)
-    assert not bool(j_ovf) and not bool(p_ovf)
-
-    def live(h, l):
-        h, l = np.asarray(h), np.asarray(l)
-        keep = ~((h == hashset.SENT) & (l == hashset.SENT))
-        return set(zip(h[keep].tolist(), l[keep].tolist()))
-
-    assert live(ph, plo) == live(jh, jl)
+    assert not bool(p_ovf)
+    assert_same_winners(case, ph, plo, p_new, p_n)
 
 
 def test_engine_pallas_hbm_beyond_vmem_gate_exact(monkeypatch):
