@@ -179,19 +179,29 @@ def _probe_default() -> bool:
     env = dict(os.environ)
     env["KSPEC_BENCH_PROBE"] = "1"
     try:
-        return (
-            subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env,
-                timeout=int(os.environ.get("KSPEC_TPU_PROBE_TIMEOUT", "120")),
-                capture_output=True,
-            ).returncode
-            == 0
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            timeout=int(os.environ.get("KSPEC_TPU_PROBE_TIMEOUT", "120")),
+            capture_output=True,
+            text=True,
         )
     except subprocess.TimeoutExpired:
         print("# default-platform probe timed out (tunnel wedged)",
               file=sys.stderr)
         return False
+    if p.returncode == 0:
+        return True
+    if p.returncode != 4:
+        # rc 4 is the deliberate "platform is CPU" exit; anything else is
+        # the probe child CRASHING — distinguish it from tunnel health so
+        # a broken probe doesn't silently demote the headline to CPU
+        print(
+            f"# default-platform probe crashed (rc={p.returncode}); "
+            f"stderr tail: {(p.stderr or '')[-300:]}",
+            file=sys.stderr,
+        )
+    return False
 
 
 def main():

@@ -81,6 +81,22 @@ class AdaptiveCompact:
         self.hw = np.zeros(len(actions), np.float64)
         self.floor = np.zeros(len(actions), np.int64)
         self.on = os.environ.get("KSPEC_ADAPTIVE_COMPACT", "1") != "0"
+        # Wide-model guard (TODO round-5 finding): a fully escalated
+        # program on the 27-action mixed product reproducibly OOMs
+        # XLA:CPU's LLVM at compile, while the uniform-shift program with
+        # the SAME pipeline count compiles fine — the blowup tracks how
+        # far the escalated shapes stray from the uniform ones, not the
+        # pipeline count itself.  Above this many actions, escalation
+        # widens ONLY the actions whose measured need exceeds their
+        # uniform buffer and pins every other action at (approximately —
+        # tuple widths are 256-rounded, and the tuple form skips the
+        # uniform path's pre-sort squeeze) its uniform width.  This
+        # brings the escalated program's buffer shapes much closer to
+        # the compiling uniform ones; it is a heuristic, not a shape
+        # guarantee — compile_fallback remains the backstop.  Narrow
+        # models (the 9-action flagship, where full adaptation is
+        # profiled and wins) are unaffected.
+        self.max_pipe = int(os.environ.get("KSPEC_ADAPTIVE_MAX_PIPE", "16"))
         self.active = False
 
     def widths_for(self, bucket: int):
@@ -90,10 +106,20 @@ class AdaptiveCompact:
             return None
         if not (self.on and self.active and self.hw.any()):
             return self.shift
+        hybrid = len(self.actions) > self.max_pipe
+        uni_rows = max(1, bucket >> self.shift)
         out = []
         for a, hw, floor in zip(self.actions, self.hw, self.floor):
             w = _next_pow2(max(256, int(1.35 * hw * bucket) + 1, int(floor)))
-            out.append(min(w, bucket * a.n_choices))
+            w = min(w, bucket * a.n_choices)
+            if hybrid:
+                # pre-apply norm_widths' 256-rounding so the width stated
+                # here is the width the program actually runs at
+                w_uni = min(uni_rows * a.n_choices, bucket * a.n_choices)
+                w_uni = -(-w_uni // 256) * 256
+                if w <= w_uni:
+                    w = w_uni
+            out.append(w)
         return tuple(out)
 
     def observe(self, density: np.ndarray):

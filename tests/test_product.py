@@ -47,6 +47,28 @@ def test_product_kafka_variant_matches_oracle():
     assert res.total == 353 * 353
 
 
+@pytest.mark.slow
+def test_wide_product_hybrid_escalation_exact():
+    """Wide-model escalation guard (round-5 LLVM-OOM finding): a product
+    model with more actions than KSPEC_ADAPTIVE_MAX_PIPE escalates in
+    hybrid mode — only needy actions leave the uniform width — and the
+    count stays exact.  18 actions (2 x Kip320 tiny) > the default cap
+    of 16; an undersized shift forces the uniform attempt to overflow."""
+    base = kip320.make_model(Config(2, 2, 1, 1), invariants=("TypeOk",))
+    model = product_model(base, 2)
+    assert len(model.actions) == 18
+    res = check(
+        model,
+        min_bucket=8192,  # >= the 4096 compact gate from level 1
+        compact_shift=6,  # 8192>>6 = 128 rows/action-choice: overflows
+        store_trace=False,
+        visited_backend="host",
+    )
+    assert res.ok
+    assert res.total == 277 * 277
+    assert res.stats["adaptive_active"] is True  # escalation really fired
+
+
 def test_mixed_base_product_closed_form():
     """product_models (heterogeneous partitions, round-5): the reachable
     set of Kip320-tiny x IdSequence is exactly 277 * 4 — partitions with

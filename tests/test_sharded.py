@@ -243,6 +243,38 @@ def test_adaptive_compact_policy_unit():
     assert ad.widths_for(4096)[0] == 16384
 
 
+def test_adaptive_compact_wide_model_hybrid_unit():
+    """Wide-model guard (KSPEC_ADAPTIVE_MAX_PIPE): above the pipeline
+    cap, escalation widens only the actions whose measured need exceeds
+    their uniform buffer and pins every other action at the exact
+    uniform width, keeping the program shape-adjacent to the
+    known-compiling uniform one (round-5 LLVM-OOM finding, TODO.md)."""
+    import numpy as np
+
+    from kafka_specification_tpu.engine.bfs import AdaptiveCompact
+
+    class A:  # minimal action stub
+        def __init__(self, n):
+            self.n_choices = n
+
+    acts = [A(4) for _ in range(3)]
+    ad = AdaptiveCompact(acts, compact_shift=2, bucket_gate=1024)
+    ad.max_pipe = 2  # force wide-model mode for the 3-action stub
+    nxt = ad.escalate(2, np.array([True, False, False]), 4096,
+                      np.array([1.0, 0.01, 0.01]))
+    # dense action escalates past its uniform width (4096>>2)*4 = 4096
+    assert nxt[0] == 8192
+    # sparse actions: measured need (256) <= uniform width -> pinned at
+    # uniform 4096 (shape adjacency over padding savings in this mode)
+    assert nxt[1] == nxt[2] == 4096
+    # under the cap the round-5 behavior is unchanged: sparse actions
+    # shrink to their measured pow2 width
+    ad2 = AdaptiveCompact(acts, compact_shift=2, bucket_gate=1024)
+    nxt2 = ad2.escalate(2, np.array([True, False, False]), 4096,
+                        np.array([1.0, 0.01, 0.01]))
+    assert nxt2[0] == 8192 and nxt2[1] == nxt2[2] == 256
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("exchange", ["all_to_all", "all_gather"])
 def test_sharded_adaptive_escalation_exact(exchange):
