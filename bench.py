@@ -37,6 +37,9 @@ _CHILD_ENV = "KSPEC_BENCH_CHILD"
 # fallback mid-benchmark
 _TPU_TIMEOUT = int(os.environ.get("KSPEC_BENCH_TPU_TIMEOUT", "2400"))
 _CPU_TIMEOUT = int(os.environ.get("KSPEC_BENCH_CPU_TIMEOUT", "2700"))
+# probe child's deliberate "platform is CPU" exit (shared by the probe
+# branch in main() and the crash-vs-CPU distinction in _probe_default)
+_PROBE_RC_CPU = 4
 
 
 def _child_main():
@@ -192,7 +195,7 @@ def _probe_default() -> bool:
         return False
     if p.returncode == 0:
         return True
-    if p.returncode != 4:
+    if p.returncode != _PROBE_RC_CPU:
         # rc 4 is the deliberate "platform is CPU" exit; anything else is
         # the probe child CRASHING — distinguish it from tunnel health so
         # a broken probe doesn't silently demote the headline to CPU
@@ -210,7 +213,9 @@ def main():
             platform_ready_probe,
         )
 
-        raise SystemExit(0 if platform_ready_probe() != "cpu" else 4)
+        raise SystemExit(
+            0 if platform_ready_probe() != "cpu" else _PROBE_RC_CPU
+        )
     if os.environ.get(_CHILD_ENV):
         _child_main()
         return

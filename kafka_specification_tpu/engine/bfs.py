@@ -57,6 +57,12 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
+def _round256(w: int) -> int:
+    """Round up to the fingerprint-block alignment (single source of
+    truth for widths_for and norm_widths — round-5 advisor item)."""
+    return -(-w // 256) * 256
+
+
 class AdaptiveCompact:
     """Per-action compact-buffer sizing policy, shared by the single-device
     engine and the sharded engine (round-5 review item: one policy, two
@@ -115,8 +121,9 @@ class AdaptiveCompact:
             if hybrid:
                 # pre-apply norm_widths' 256-rounding so the width stated
                 # here is the width the program actually runs at
-                w_uni = min(uni_rows * a.n_choices, bucket * a.n_choices)
-                w_uni = -(-w_uni // 256) * 256
+                w_uni = _round256(
+                    min(uni_rows * a.n_choices, bucket * a.n_choices)
+                )
                 if w <= w_uni:
                     w = w_uni
             out.append(w)
@@ -248,7 +255,7 @@ class _Step:
         # fingerprint blocks (round-5 advisor item).  The alignment
         # invariant is enforced HERE, where the widths are created.
         return tuple(
-            min(-256 * (-max(1, int(w)) // 256), bucket * a.n_choices)
+            min(_round256(max(1, int(w))), bucket * a.n_choices)
             for w, a in zip(compact, acts)
         )
 

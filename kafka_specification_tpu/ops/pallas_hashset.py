@@ -31,6 +31,17 @@ Design — sequential grid, row-serial probing:
 Bit-identity with the jnp path is pinned by tests/test_pallas.py in
 interpret mode on CPU; KSPEC_USE_PALLAS=1 routes the engine's
 device-hash backend through this kernel (engine/bfs).
+
+Hardware status (round-5 window 3, scripts/tpu_mosaic_ladder.py +
+TPU_MOSAIC_LADDER.json): this container's TPU tunnel routes every
+Mosaic kernel with DATA-DEPENDENT VMEM addressing — even a single
+dynamic (1,)-slice access with no loop — to a "chipless" AOT compile
+helper whose libtpu init dies (subprocess exit 1), while vector /
+static-index kernels compile and run on the chip.  A hash probe is
+irreducibly data-dependent addressing, so these kernels cannot compile
+through THIS tunnel in any formulation; the jnp probe_insert
+(ops/hashset) is the production device-hash path on hardware and is
+what every banked TPU bench used.
 """
 
 from __future__ import annotations

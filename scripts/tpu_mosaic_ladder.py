@@ -1,0 +1,113 @@
+"""Mosaic lowering ladder: which Pallas construct the tunnel can compile.
+
+Round-5 window 3 found the reworked probe kernels (ops/pallas_hashset)
+failing with `remote_compile HTTP 500: tpu_compile_helper subprocess
+exit code 1` while the vectorized fingerprint kernel compiled and ran
+fine in the same window.  This ladder isolates the boundary with
+single-construct kernels, from pure vector ops down to one dynamic
+(1,)-slice access, and banks one JSON line per rung in
+TPU_MOSAIC_LADDER.json.
+
+Finding (2026-07-31 live window): every kernel whose VMEM addressing is
+data-DEPENDENT — even a single `o_ref[pl.ds(pos, 1)]` with a traced
+`pos` and no loop — is routed to the terminal's "chipless" TpuAotCompiler
+helper, whose libtpu init dies (`TPU_ACCELERATOR_TYPE` unset,
+`TPU_WORKER_HOSTNAMES` garbage inside the env-cleared helper;
+subprocess exit 1).  Static indexing, fori_loop with vector bodies, and
+all pure vector kernels compile and run.  A hash probe is irreducibly
+data-dependent addressing, so the Pallas probe kernels cannot compile
+through THIS tunnel regardless of formulation — the blocker is the
+terminal's remote-compile helper environment, not the kernels (they
+remain interpret-pinned bit-identical to the jnp path, which is the
+production device-hash backend and runs fine on the chip).
+
+Usage:  python scripts/tpu_mosaic_ladder.py   (on a live tunnel)
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main():
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache")
+    )
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def k_vec(x_ref, o_ref):  # pure vector op
+        o_ref[:] = x_ref[:] * 3 + 7
+
+    def k_loop_vec(x_ref, o_ref):  # fori_loop, vector body
+        def body(i, acc):
+            return acc + x_ref[:]
+
+        o_ref[:] = jax.lax.fori_loop(0, 4, body, jnp.zeros_like(x_ref))
+
+    def k_static_scalar(x_ref, o_ref):  # static scalar index
+        o_ref[:] = x_ref[:]
+        o_ref[pl.ds(0, 1)] = (x_ref[0] + 1)[None]
+
+    def k_dyn_read(x_ref, o_ref):  # dynamic (1,)-slice READ only
+        pos = (x_ref[0] % 7).astype(jnp.int32)
+        o_ref[:] = x_ref[:] + x_ref[pl.ds(pos, 1)][0]
+
+    def k_dyn_slice(x_ref, o_ref):  # dynamic (1,)-slice read+write
+        pos = (x_ref[0] % 7).astype(jnp.int32)
+        o_ref[:] = x_ref[:]
+        o_ref[pl.ds(pos, 1)] = x_ref[pl.ds(pos, 1)] + 1
+
+    def k_scalar_loop(x_ref, o_ref):  # the probe shape: scalar loop
+        def body(i, c):
+            v = x_ref[i]
+            o_ref[pl.ds(i, 1)] = (v + 1)[None]
+            return c
+
+        jax.lax.fori_loop(0, x_ref.shape[0], body, 0)
+
+    rungs = [
+        ("vec", k_vec),
+        ("loop_vec", k_loop_vec),
+        ("static_scalar", k_static_scalar),
+        ("dyn_read", k_dyn_read),
+        ("dyn_slice", k_dyn_slice),
+        ("scalar_loop", k_scalar_loop),
+    ]
+    record = {
+        "started": time.time(),
+        "platform": jax.devices()[0].platform,
+        "rungs": {},
+    }
+    print(f"# platform: {record['platform']}", flush=True)
+    x = jnp.arange(256, dtype=jnp.uint32)
+    for name, k in rungs:
+        t0 = time.perf_counter()
+        try:
+            pl.pallas_call(
+                k, out_shape=jax.ShapeDtypeStruct((256,), jnp.uint32)
+            )(x).block_until_ready()
+            record["rungs"][name] = {
+                "ok": True,
+                "seconds": round(time.perf_counter() - t0, 2),
+            }
+        except Exception as e:  # noqa: BLE001 — banking the failure mode
+            record["rungs"][name] = {
+                "ok": False,
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        print(f"# {name}: {record['rungs'][name]}", flush=True)
+    with open(os.path.join(_REPO, "TPU_MOSAIC_LADDER.json"), "w") as f:
+        json.dump(record, f, indent=1)
+    ok = all(r["ok"] for r in record["rungs"].values())
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -246,8 +246,8 @@ def test_adaptive_compact_policy_unit():
 def test_adaptive_compact_wide_model_hybrid_unit():
     """Wide-model guard (KSPEC_ADAPTIVE_MAX_PIPE): above the pipeline
     cap, escalation widens only the actions whose measured need exceeds
-    their uniform buffer and pins every other action at the exact
-    uniform width, keeping the program shape-adjacent to the
+    their uniform buffer and pins every other action at the 256-rounded
+    uniform width, keeping the program's shapes close to the
     known-compiling uniform one (round-5 LLVM-OOM finding, TODO.md)."""
     import numpy as np
 
