@@ -13,13 +13,18 @@ The probe gate inside tpu_window.py means a wedged tunnel costs ~120s per
 attempt, so a 30-min cadence burns <7% of a core.
 
 Return-code legend (from tpu_window.py):
-  0  full window run completed (results in TPU_WINDOW.json)
+  0  full window run completed (results in TPU_WINDOW.json) — or, under
+     KSPEC_TPU_WINDOW_PROBE=1, a liveness probe succeeded (logged with
+     outcome "live-probe" and "probe_only": true; nothing is banked)
   4  platform probe came back CPU — no TPU visible
   5  probe or window timed out — tunnel wedged in PJRT init
   other  child crashed mid-window (partial results still banked)
 
 Usage:  nohup python scripts/tpu_sentry.py >/dev/null 2>&1 &
         KSPEC_SENTRY_PERIOD=900 KSPEC_SENTRY_HOURS=12 python scripts/tpu_sentry.py
+        # liveness-only cadence (no ~20-min kit re-runs; tpu_window.py
+        # honors the inherited flag at its parent level):
+        KSPEC_TPU_WINDOW_PROBE=1 nohup python scripts/tpu_sentry.py &
 """
 
 import json
@@ -37,24 +42,38 @@ _OUTCOME = {0: "live", 4: "cpu-only", 5: "wedged"}
 
 def _attempt(n):
     t0 = time.time()
+    probe_only = bool(os.environ.get("KSPEC_TPU_WINDOW_PROBE"))
+    # the child inherits KSPEC_TPU_WINDOW_PROBE and tpu_window.py honors
+    # it at its parent level (probe gate only, nothing banked); scale the
+    # backstop to the probe budget in that mode so a wedge that defeats
+    # the child's own timeout doesn't stall the liveness log for 35 min
+    backstop = (
+        int(os.environ.get("KSPEC_TPU_PROBE_TIMEOUT", "120")) + 300
+        if probe_only
+        else int(os.environ.get("KSPEC_TPU_WINDOW_TIMEOUT", "1800")) + 300
+    )
     try:
         rc = subprocess.run(
             [sys.executable, os.path.join(_REPO, "scripts", "tpu_window.py")],
             cwd=_REPO,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
-            timeout=int(os.environ.get("KSPEC_TPU_WINDOW_TIMEOUT", "1800"))
-            + 300,
+            timeout=backstop,
         ).returncode
     except subprocess.TimeoutExpired:
         rc = 6  # parent-level backstop; tpu_window's own timeouts failed
+    outcome = _OUTCOME.get(rc, f"crashed({rc})")
+    if probe_only and rc == 0:
+        outcome = "live-probe"
     line = {
         "attempt": n,
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
         "seconds": round(time.time() - t0, 1),
         "rc": rc,
-        "outcome": _OUTCOME.get(rc, f"crashed({rc})"),
+        "outcome": outcome,
     }
+    if probe_only:
+        line["probe_only"] = True
     with open(_LOG, "a") as fh:
         fh.write(json.dumps(line) + "\n")
     return rc

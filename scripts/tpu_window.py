@@ -237,6 +237,8 @@ def _run_stages(record, stage):
 
 
 def _write(record):
+    if os.environ.get("KSPEC_TPU_WINDOW_PROBE"):
+        return  # a liveness probe must never clobber banked window results
     with open(_OUT, "w") as fh:
         json.dump(record, fh, indent=1)
 
@@ -270,6 +272,10 @@ def main():
     rc = attempt(int(os.environ.get("KSPEC_TPU_PROBE_TIMEOUT", "120")), True)
     if rc != 0:
         raise SystemExit(rc)
+    if os.environ.get("KSPEC_TPU_WINDOW_PROBE"):
+        # probe-only requested at the PARENT level (sentry liveness mode):
+        # the tunnel is proven live; skip the ~20-min full kit
+        raise SystemExit(0)
     raise SystemExit(attempt(_TIMEOUT, False))
 
 
