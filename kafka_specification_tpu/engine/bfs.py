@@ -25,7 +25,6 @@ is run with TLC's deadlock check disabled for the same reason).
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
@@ -39,6 +38,10 @@ import numpy as np
 from ..models.base import Model
 from ..ops import dedup, hashset
 from ..ops.fingerprint import fingerprint_lanes
+from ..resilience.checkpoints import CheckpointStore
+from ..resilience.faults import FaultPlan
+from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..resilience.retry import ChunkRetryHandler
 
 # insert-or-find on the device hash table; table + claim lattice donated so
 # XLA updates them in place instead of copying O(capacity) per chunk
@@ -116,7 +119,18 @@ class AdaptiveCompact:
         uni_rows = max(1, bucket >> self.shift)
         out = []
         for a, hw, floor in zip(self.actions, self.hw, self.floor):
-            w = _next_pow2(max(256, int(1.35 * hw * bucket) + 1, int(floor)))
+            need = _next_pow2(max(256, int(1.35 * hw * bucket) + 1))
+            if hybrid:
+                # hybrid floors are doubled 256-multiples of (possibly
+                # non-pow2) pinned uniform widths — re-rounding them
+                # through _next_pow2 could run up to ~2x wider than the
+                # intended doubling, drifting further from the
+                # uniform-adjacent shapes this mode exists to preserve
+                # (round-5 advisor item): size from the floor with
+                # _round256 instead
+                w = max(need, _round256(int(floor)))
+            else:
+                w = max(need, _next_pow2(int(floor)))
             w = min(w, bucket * a.n_choices)
             if hybrid:
                 # pre-apply norm_widths' 256-rounding so the width stated
@@ -699,24 +713,6 @@ def _pad_rows(arr: np.ndarray, n: int, fill=0):
     return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
 
 
-def atomic_savez(path: str, **arrays):
-    """np.savez to a tmp file + atomic rename (shared checkpoint writer)."""
-    np.savez(path + ".tmp.npz", **arrays)
-    os.replace(path + ".tmp.npz", path)
-
-
-def load_validated_snapshot(path: str, ident: str):
-    """Load a checkpoint and verify its identity stamp (shared)."""
-    snap = np.load(path)
-    found = str(snap["ident"]) if "ident" in snap else "<none>"
-    if found != ident:
-        raise ValueError(
-            f"checkpoint at {path} was written by a different "
-            f"model/config:\n  checkpoint: {found}\n  this run:   {ident}"
-        )
-    return snap
-
-
 def walk_trace(trace_store, actions, decode_row, inv_name, depth, idx) -> Violation:
     """Parent-pointer counterexample reconstruction, shared by both engines.
 
@@ -748,6 +744,7 @@ def check(
     collect_levels: Optional[list] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
+    checkpoint_keep: int = 3,
     check_deadlock: bool = False,
     stats_path: Optional[str] = None,
     visited_backend: str = "device",
@@ -805,18 +802,38 @@ def check(
     crash loses at most checkpoint_every-1 levels of work) and a run restarts
     from the last saved level if a checkpoint exists — the natural fit for a
     level-synchronous engine (SURVEY.md §5 "Checkpoint / resume"; TLC keeps
-    this externally).  Checkpointed runs don't retain parent-pointer traces
-    across restarts, so store_trace is forced off.
+    this externally).  Checkpoints are hardened (resilience.checkpoints):
+    every array is checksummed into an in-file manifest, the newest
+    `checkpoint_keep` generations rotate under atomic promotes, and a
+    corrupt/truncated newest generation falls back automatically to the
+    newest verifying one instead of aborting the run.  Checkpointed runs
+    don't retain parent-pointer traces across restarts, so store_trace is
+    forced off — a violation found after a resume reports the violating
+    state with an EMPTY trace (known trace-loss limitation: re-deriving the
+    path would need a re-walk from the init states; docs/resilience.md).
+
+    Fault injection (resilience.faults): a `KSPEC_FAULT` plan exercises the
+    recovery paths deterministically — level-boundary / checkpoint-write
+    crashes, checkpoint corruption, transient backend errors (retried with
+    bounded exponential backoff; count in result.stats["transient_retries"])
+    and the escalated-compile OOM (degrades to the uniform compact path;
+    recorded in result.stats["degradations"]).
     """
     spec = model.spec
     step_builder = _Step(model)
     K, C = spec.num_lanes, step_builder.C
 
-    ckpt_path = None
+    fault = FaultPlan.from_env()
+    chunk_retry = ChunkRetryHandler.from_env("[engine]")
+    ckpt_store = None  # built once ckpt_ident is known
+    # newest durably checkpointed level (None = not checkpointing):
+    # level-crash faults defer until the target level is checkpointed so
+    # a supervised restart converges (FaultPlan.crash)
+    last_ckpt_depth = None
     if checkpoint_dir is not None:
         store_trace = False
-        os.makedirs(checkpoint_dir, exist_ok=True)
-        ckpt_path = os.path.join(checkpoint_dir, "bfs_checkpoint.npz")
+        last_ckpt_depth = 0
+        checkpoint_every = max(1, int(checkpoint_every))
 
     inits = [
         {k: np.asarray(v, np.int32) for k, v in s.items()} for s in model.init_states()
@@ -937,9 +954,17 @@ def check(
         f"inv={inv_names}|dl={check_deadlock}|"
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
     )
-    if ckpt_path is not None:
-        if os.path.exists(ckpt_path):
-            snap = load_validated_snapshot(ckpt_path, ckpt_ident)
+    if checkpoint_dir is not None:
+        ckpt_store = CheckpointStore(
+            checkpoint_dir,
+            "bfs_checkpoint.npz",
+            ident=ckpt_ident,
+            keep=checkpoint_keep,
+            fault_plan=fault,
+        )
+        loaded = ckpt_store.load()
+        if loaded is not None:
+            snap, _, _gen = loaded
             frontier_np = snap["frontier"]
             if host_set is not None:
                 from ..native import FpSet
@@ -964,6 +989,10 @@ def check(
             levels = snap["levels"].tolist()
             total = int(snap["total"])
             depth = int(snap["depth"])
+            last_ckpt_depth = depth
+            # crash faults at or below the resume level count as fired
+            # (a supervised restart must converge, not crash-loop)
+            fault.set_start_depth(depth)
 
     def _save_checkpoint():
         # only the live prefix of the visited set is saved (the sentinel
@@ -983,15 +1012,15 @@ def check(
                 "vlo": np.asarray(vlo[:n]),
                 "vn": n,
             }
-        atomic_savez(
-            ckpt_path,
-            ident=ckpt_ident,
-            frontier=frontier_np,
-            vcap=vcap,
-            levels=np.asarray(levels),
-            total=total,
-            depth=depth,
-            **extra,
+        ckpt_store.save(
+            depth,
+            dict(
+                frontier=frontier_np,
+                vcap=vcap,
+                levels=np.asarray(levels),
+                total=total,
+                **extra,
+            ),
         )
 
     chunk = _next_pow2(max(min_bucket, chunk_size))
@@ -1008,6 +1037,8 @@ def check(
     squeeze_full = False
 
     while frontier_np.shape[0] > 0:
+        # level-boundary fault injection point (resilience.faults)
+        fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
         if max_depth is not None and depth >= max_depth:
             break
         if max_states is not None and total >= max_states:
@@ -1075,8 +1106,14 @@ def check(
             compact_arg = adapt.widths_for(bucket)
             attempt_sq_full = squeeze_full
             t_attempt = time.perf_counter()
+            chunk_retry.reset_chunk()
             while True:
                 try:
+                    injected = fault.chunk_error(
+                        escalated=isinstance(compact_arg, (list, tuple))
+                    )
+                    if injected is not None:
+                        raise injected
                     step = step_builder.get(
                         bucket,
                         vcap,
@@ -1110,16 +1147,24 @@ def check(
                         vn,
                     )
                 except Exception as e:  # noqa: BLE001 — XLA compile/run
-                    # escalated per-action program failed to compile/run
-                    # (policy + rationale: AdaptiveCompact.compile_fallback)
-                    if not isinstance(compact_arg, (list, tuple)):
-                        raise
-                    print(
-                        "[engine] adaptive compact step failed "
-                        f"({type(e).__name__}); falling back to the "
-                        "uniform compact path for the rest of the run",
-                        file=sys.stderr,
-                    )
+                    # known failure ladder — one policy for both engines
+                    # (resilience.retry.ChunkRetryHandler): transient
+                    # errors re-run the same attempt after bounded backoff
+                    # (the chunk commits nothing until its results are
+                    # read back, so a re-run is exact); a failed ESCALATED
+                    # compile degrades to the uniform path
+                    # (AdaptiveCompact.compile_fallback); anything else —
+                    # including an exhausted transient budget — re-raises
+                    # for the supervisor's restart layer
+                    if (
+                        chunk_retry.handle(
+                            e,
+                            escalated=isinstance(compact_arg, (list, tuple)),
+                            depth=depth,
+                        )
+                        == "retry"
+                    ):
+                        continue
                     compact_arg = adapt.compile_fallback(bucket)
                     adaptive_fallback = True
                     continue
@@ -1355,24 +1400,26 @@ def check(
             total += new_n
         if collect_stats:
             enabled_total = int(lvl_act_en.sum())
-            rec = {
-                "depth": depth,
-                "frontier": f_total,
-                "enabled_candidates": enabled_total,
-                "new": new_n,
-                "duplicates": enabled_total - new_n,
-                "total": total,
-                "level_ms": round((time.perf_counter() - t_level) * 1e3, 1),
-                "step_ms": round(prof_step * 1e3, 1),
-                "host_ms": round(prof_host_s * 1e3, 1),
-                "action_enablement": {
+            # heartbeat-enveloped (kind/ts/unix): the per-level stats
+            # stream doubles as the supervisor's liveness signal
+            rec = heartbeat_record(
+                "level",
+                depth=depth,
+                frontier=f_total,
+                enabled_candidates=enabled_total,
+                new=new_n,
+                duplicates=enabled_total - new_n,
+                total=total,
+                level_ms=round((time.perf_counter() - t_level) * 1e3, 1),
+                step_ms=round(prof_step * 1e3, 1),
+                host_ms=round(prof_host_s * 1e3, 1),
+                action_enablement={
                     a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
                 },
-            }
+            )
             result_stats.setdefault("levels", []).append(rec)
             if stats_path is not None:
-                with open(stats_path, "a") as fh:
-                    fh.write(json.dumps(rec) + "\n")
+                append_jsonl(stats_path, rec)
         if collect_levels is not None and new_n:
             collect_levels.append(next_frontier)
         if store_trace:
@@ -1381,8 +1428,9 @@ def check(
             progress(depth, new_n, total)
 
         frontier_np = next_frontier
-        if ckpt_path is not None and depth % checkpoint_every == 0:
+        if ckpt_store is not None and depth % checkpoint_every == 0:
             _save_checkpoint()
+            last_ckpt_depth = depth
 
     if violation is None and check_invariants and model.invariants and frontier_np.shape[0]:
         # the loop was cut (max_depth/max_states) before the remaining
@@ -1413,6 +1461,8 @@ def check(
             "visited_backend": visited_backend,
             "adaptive_active": adapt.active,
             "adaptive_compile_fallback": adaptive_fallback,
+            "transient_retries": chunk_retry.retries_total,
+            "degradations": chunk_retry.degradations,
         }
     )
     if host_set is not None:

@@ -24,7 +24,6 @@ v5e-8 pod slice — XLA inserts the ICI collectives.
 
 from __future__ import annotations
 
-import sys
 import time
 from typing import Optional
 
@@ -33,18 +32,41 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax >= 0.5 promotes shard_map to the top level; the replicated-value
+# checking flag was separately renamed check_rep -> check_vma.  Feature-
+# detect BOTH independently (there are versions with a top-level shard_map
+# that still takes check_rep), so the engine runs across the whole window.
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # older jax: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+_sm_params = _inspect.signature(_shard_map).parameters
+_SHARD_MAP_KW = (
+    {"check_vma": False}
+    if "check_vma" in _sm_params
+    else {"check_rep": False}
+    if "check_rep" in _sm_params
+    else {}
+)
+del _inspect, _sm_params
+
 from ..engine.bfs import (
     AdaptiveCompact,
     CheckResult,
     Violation,
     _next_pow2,
     _Step,
-    atomic_savez,
-    load_validated_snapshot,
     walk_trace,
 )
 from ..models.base import Model
 from ..ops import dedup, hashset
+from ..resilience.checkpoints import CheckpointStore
+from ..resilience.faults import FaultPlan
+from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..resilience.retry import ChunkRetryHandler
 from .multihost import (
     fetch_global,
     is_coordinator,
@@ -309,12 +331,12 @@ def _make_sharded_step(
             out_lo,
         )
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
         out_specs=tuple([P("d")] * 18),
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )
     return jax.jit(sharded)
 
@@ -331,6 +353,7 @@ def check_sharded(
     store_trace: bool = True,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
+    checkpoint_keep: int = 3,
     stats_path: Optional[str] = None,
     compact_shift: int = 2,
     exchange: str = "all_to_all",
@@ -350,6 +373,14 @@ def check_sharded(
     checkpoint_every-1 levels); a run restarts from the last saved level
     (store_trace forced off, as in engine.check).  A checkpoint binds to
     (model, constants, invariant selection, deadlock flag, mesh size).
+    Checkpoints are hardened as in engine.check (resilience.checkpoints):
+    per-array checksums, keep-last-`checkpoint_keep` rotation with atomic
+    promote, automatic fallback to the newest verifying generation, and —
+    for the per-host FpSet part files — a cross-shard level-consistency
+    check: a generation whose parts disagree with the main file's level
+    (crash between the part and main writes) is treated as torn and
+    skipped.  Fault injection (`KSPEC_FAULT`) and transient-error retry
+    mirror engine.check, with the injection point at the exchange step.
 
     compact_shift: two-phase expansion (see engine.check) — guards sweep the
     full lattice, update/pack/sort/exchange run at 1/2^shift of it.  0
@@ -506,7 +537,13 @@ def check_sharded(
         )[:, None]
         return dens.max(axis=0)
 
-    ckpt_path = None
+    fault = FaultPlan.from_env()
+    chunk_retry = ChunkRetryHandler.from_env("[sharded]")
+    ckpt_store = None
+    # newest durably checkpointed level (None = not checkpointing):
+    # level-crash faults defer until the target level is checkpointed so
+    # a supervised restart converges (FaultPlan.crash)
+    last_ckpt_depth = None
     inv_names = ",".join(sorted(i.name for i in model.invariants))
     ckpt_ident = (
         f"{model.name}|lanes={spec.num_lanes}|D={D}|"
@@ -515,13 +552,27 @@ def check_sharded(
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
     )
     if checkpoint_dir is not None:
-        import os
-
         store_trace = False
-        os.makedirs(checkpoint_dir, exist_ok=True)
-        ckpt_path = os.path.join(checkpoint_dir, "sharded_checkpoint.npz")
-        if os.path.exists(ckpt_path):
-            snap = load_validated_snapshot(ckpt_path, ckpt_ident)
+        last_ckpt_depth = 0
+        checkpoint_every = max(1, int(checkpoint_every))
+        ckpt_store = CheckpointStore(
+            checkpoint_dir,
+            "sharded_checkpoint.npz",
+            ident=ckpt_ident,
+            keep=checkpoint_keep,
+            fault_plan=fault,
+        )
+        # per-host FpSet part files: each process verifies its own part
+        # against the main file's level (cross-shard consistency — a torn
+        # generation falls back instead of resuming a spliced state)
+        my_parts = (
+            (f"host{my_proc}",)
+            if visited_backend == "host" and is_multiprocess()
+            else ()
+        )
+        loaded = ckpt_store.load(parts=my_parts)
+        if loaded is not None:
+            snap, part_arrays, _gen = loaded
             plens = snap["pending_lens"]
             flat = snap["pending"]
             pending, at = [], 0
@@ -532,18 +583,7 @@ def check_sharded(
                 from ..native import FpSet
 
                 if is_multiprocess():
-                    # per-host part file written by this same process rank
-                    part = load_validated_snapshot(
-                        f"{ckpt_path}.host{my_proc}", ckpt_ident
-                    )
-                    if int(part["depth"]) != int(snap["depth"]):
-                        raise ValueError(
-                            f"torn checkpoint: host part {my_proc} is at "
-                            f"level {int(part['depth'])} but the main "
-                            f"checkpoint is at level {int(snap['depth'])} "
-                            f"(crash mid-checkpoint?) — refusing to resume; "
-                            f"delete {checkpoint_dir} and restart"
-                        )
+                    part = part_arrays[f"host{my_proc}"]
                     fps_flat, lens = part["host_fps"], part["host_lens"]
                 else:
                     fps_flat, lens = snap["host_fps"], snap["host_lens"]
@@ -582,6 +622,26 @@ def check_sharded(
             levels = snap["levels"].tolist()
             total = int(snap["total"])
             depth = int(snap["depth"])
+            last_ckpt_depth = depth
+            # crash faults at or below the resume level count as fired
+            fault.set_start_depth(depth)
+        if is_multiprocess():
+            # split-brain guard: each process verifies its own part files,
+            # so per-host corruption could make hosts fall back to
+            # DIFFERENT generations — resuming the replicated lockstep
+            # loop at mismatched depths would desync the collectives.
+            # All processes vote their resume level (0 = fresh start) and
+            # must agree exactly.  (64Ki levels is far beyond any real
+            # diameter; the vote is one cheap allgather.)
+            vote = np.zeros(1 << 16, bool)
+            vote[min(depth, vote.size - 1)] = True
+            if or_across_processes(vote).sum() != 1:
+                raise ValueError(
+                    "checkpoint resume disagreement: processes verified "
+                    "different checkpoint generations (per-host part "
+                    "corruption?) — restore or delete "
+                    f"{checkpoint_dir} and restart"
+                )
 
     shard1 = NamedSharding(mesh, P("d"))
     dev_vhi = put_global(vhi, shard1)
@@ -603,13 +663,15 @@ def check_sharded(
                 # parts one level ahead of (or behind) the main file, and
                 # resuming such a torn pair would silently skip the
                 # re-expanded frontier's subtrees — the depth cross-check
-                # on load refuses it instead.
-                atomic_savez(
-                    f"{ckpt_path}.host{my_proc}",
-                    ident=ckpt_ident,
-                    depth=depth,
-                    host_fps=np.concatenate(dumps),
-                    host_lens=np.asarray([len(x) for x in dumps]),
+                # on load skips that generation (falling back to an older
+                # consistent one) instead.
+                ckpt_store.save(
+                    depth,
+                    dict(
+                        host_fps=np.concatenate(dumps),
+                        host_lens=np.asarray([len(x) for x in dumps]),
+                    ),
+                    part=f"host{my_proc}",
                 )
                 extra = {}
             else:
@@ -640,18 +702,18 @@ def check_sharded(
             }
         if not is_coordinator():
             return  # one writer per job; all processes hold identical state
-        atomic_savez(
-            ckpt_path,
-            ident=ckpt_ident,
-            pending=np.concatenate(pending)
-            if any(p.shape[0] for p in pending)
-            else np.empty((0, K), np.uint32),
-            pending_lens=np.asarray([p.shape[0] for p in pending]),
-            vcap=vcap,
-            levels=np.asarray(levels),
-            total=total,
-            depth=depth,
-            **extra,
+        ckpt_store.save(
+            depth,
+            dict(
+                pending=np.concatenate(pending)
+                if any(p.shape[0] for p in pending)
+                else np.empty((0, K), np.uint32),
+                pending_lens=np.asarray([p.shape[0] for p in pending]),
+                vcap=vcap,
+                levels=np.asarray(levels),
+                total=total,
+                **extra,
+            ),
         )
 
     def decode_row(row):
@@ -671,6 +733,10 @@ def check_sharded(
 
     cut = False
     while any(p.shape[0] for p in pending):
+        # level-boundary fault injection point (resilience.faults); the
+        # plan derives from the replicated env, so every process raises
+        # (or not) in lockstep
+        fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
         if max_depth is not None and depth >= max_depth:
             cut = True
             break
@@ -714,6 +780,7 @@ def check_sharded(
             # dense or skew-routed chunk must not pin the whole remaining
             # run to a wider shape (the compiled steps stay cached).
             attempt, w_try = adapt.widths_for(bucket), w_extra
+            chunk_retry.reset_chunk()
             while True:
                 if isinstance(attempt, int):
                     ca = _norm_shift(bucket, attempt) or None
@@ -762,6 +829,13 @@ def check_sharded(
 
                 key = (bucket, vcap, ca, exchange, W)
                 try:
+                    # exchange-step fault injection point (the jitted step
+                    # below carries the all_to_all/all_gather exchange)
+                    injected = fault.chunk_error(
+                        escalated=isinstance(ca, (list, tuple))
+                    )
+                    if injected is not None:
+                        raise injected
                     if key not in steps:
                         steps[key] = _make_sharded_step(
                             model,
@@ -801,16 +875,26 @@ def check_sharded(
                         dev_vn,
                     )
                 except Exception as e:  # noqa: BLE001 — XLA compile/run
-                    # escalated per-action program failed to compile/run
-                    # (policy + rationale: AdaptiveCompact.compile_fallback)
-                    if not isinstance(ca, (list, tuple)):
-                        raise
-                    print(
-                        "[sharded] adaptive compact step failed "
-                        f"({type(e).__name__}); falling back to the "
-                        "uniform compact path for the rest of the run",
-                        file=sys.stderr,
-                    )
+                    # one failure policy for both engines (resilience
+                    # .retry.ChunkRetryHandler): transient -> bounded-
+                    # backoff re-run of the same attempt (the functional
+                    # step committed nothing); failed ESCALATED compile ->
+                    # uniform fallback; else re-raise.  Transient retry is
+                    # single-process only: a REAL transient error is
+                    # per-host, and one host re-issuing the collective
+                    # while its peers don't would desync the replicated
+                    # lockstep loop — multi-process jobs surface it to the
+                    # supervisor's restart-from-checkpoint layer instead.
+                    if (
+                        chunk_retry.handle(
+                            e,
+                            escalated=isinstance(ca, (list, tuple)),
+                            depth=depth,
+                            retry_transient=not is_multiprocess(),
+                        )
+                        == "retry"
+                    ):
+                        continue
                     steps.pop(key, None)
                     attempt = adapt.compile_fallback(bucket)
                     adaptive_fallback = True
@@ -939,24 +1023,24 @@ def check_sharded(
             levels.append(n_new)
             total += n_new
         if stats_path is not None and is_coordinator():
-            import json
-
             enabled_total = int(lvl_act_en.sum())
-            rec = {
-                "depth": depth,
-                "frontier": int(prev_base[-1]),
-                "enabled_candidates": enabled_total,
-                "new": n_new,
-                "duplicates": enabled_total - n_new,
-                "total": total,
-                "level_ms": round((time.perf_counter() - t_level) * 1e3, 1),
-                "shard_new": lvl_new_per_shard.tolist(),
-                "action_enablement": {
+            # heartbeat-enveloped (kind/ts/unix): the per-level stats
+            # stream doubles as the supervisor's liveness signal
+            rec = heartbeat_record(
+                "level",
+                depth=depth,
+                frontier=int(prev_base[-1]),
+                enabled_candidates=enabled_total,
+                new=n_new,
+                duplicates=enabled_total - n_new,
+                total=total,
+                level_ms=round((time.perf_counter() - t_level) * 1e3, 1),
+                shard_new=lvl_new_per_shard.tolist(),
+                action_enablement={
                     a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
                 },
-            }
-            with open(stats_path, "a") as fh:
-                fh.write(json.dumps(rec) + "\n")
+            )
+            append_jsonl(stats_path, rec)
         if progress:
             progress(depth, n_new, total)
         pending = [
@@ -965,8 +1049,9 @@ def check_sharded(
             else np.empty((0, K), np.uint32)
             for d in range(D)
         ]
-        if ckpt_path is not None and depth % checkpoint_every == 0:
+        if ckpt_store is not None and depth % checkpoint_every == 0:
             _save_checkpoint()
+            last_ckpt_depth = depth
         if store_trace:
             trace_store.append(
                 (
@@ -1022,6 +1107,8 @@ def check_sharded(
             "exchange": exchange,
             "adaptive_active": adapt.active,
             "adaptive_compile_fallback": adaptive_fallback,
+            "transient_retries": chunk_retry.retries_total,
+            "degradations": chunk_retry.degradations,
             **(
                 {
                     "host_fpset_sizes": [

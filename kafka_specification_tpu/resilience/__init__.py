@@ -1,0 +1,44 @@
+"""Resilience subsystem: fault injection, hardened checkpoints, retries.
+
+Long-horizon exhaustive searches (the 10.7-hour half-billion-state product
+runs, RUNPROD464_r5.log) treat a crash as a restartable event, not a lost
+run.  This package supplies the four pieces the engines and the supervisor
+share:
+
+- `faults`      — deterministic fault injection (`KSPEC_FAULT` env grammar)
+                  so every recovery path below is testable in tier-1 on CPU;
+- `checkpoints` — checksummed, keep-last-K rotating checkpoint store with
+                  atomic promote and automatic fallback to the newest
+                  verifying generation on load corruption;
+- `retry`       — error classification (transient backend error vs the
+                  reproducible wide-product compile OOM vs fatal) and a
+                  bounded exponential-backoff policy;
+- `heartbeat`   — the shared JSONL heartbeat envelope ({kind, ts, unix})
+                  written by the engines' per-level stats streams and
+                  consumed by the supervisor's stall detector;
+- `supervisor`  — the auto-resume run loop behind scripts/resilient_run.py
+                  (spawn, watch heartbeat, kill on stall, restart from
+                  checkpoint with a bounded budget and jittered backoff).
+
+Nothing in this package imports jax: the supervisor and the TPU-window
+sentry run in parents that must never touch a possibly-wedged accelerator
+tunnel.
+"""
+
+from .checkpoints import CheckpointCorrupt, CheckpointStore
+from .faults import FaultPlan, InjectedCrash, InjectedFault, corrupt_file
+from .heartbeat import append_jsonl, heartbeat_record
+from .retry import RetryPolicy, classify
+
+__all__ = [
+    "CheckpointCorrupt",
+    "CheckpointStore",
+    "FaultPlan",
+    "InjectedCrash",
+    "InjectedFault",
+    "RetryPolicy",
+    "append_jsonl",
+    "classify",
+    "corrupt_file",
+    "heartbeat_record",
+]

@@ -1,0 +1,239 @@
+"""Hardened checkpoint store: checksums, keep-last-K rotation, fallback.
+
+The seed engines wrote one `*.npz` per run whose only defense was an
+identity stamp — a truncated or bit-rotted file aborted a 10-hour run.
+`CheckpointStore` adds three guarantees:
+
+- **Integrity**: every array in a checkpoint is CRC32-summed into a JSON
+  manifest stored inside the npz (`__manifest__`).  Loads recompute and
+  compare; the zip layer's own CRCs catch most torn writes, the manifest
+  catches anything that slips through (and self-describes the format).
+- **Keep-last-K rotation with atomic promote**: the newest generation
+  always lives at the legacy filename (`<base>.npz`), older generations at
+  `<base>.1.npz` ... `<base>.<K-1>.npz`.  A save writes a tmp file, shifts
+  the existing generations up, then `os.replace`s the tmp into place — a
+  crash at any point leaves at most one generation torn.
+- **Automatic fallback**: `load()` walks generations newest -> oldest and
+  returns the first one that verifies (checksums AND cross-file level
+  consistency for per-shard part files).  Only if every present generation
+  fails does it raise `CheckpointCorrupt` — a run never silently restarts
+  from scratch while checkpoint data exists on disk.
+
+Identity mismatches (a checkpoint from a different model/config/mesh) are
+NOT corruption and still raise ValueError immediately: falling back past a
+deliberate config change would silently resume the wrong search.
+
+Per-shard part files (the sharded engine's per-host FpSet dumps) rotate in
+lockstep with the main file — all processes checkpoint at the same levels —
+and each generation is cross-checked: main and every part must record the
+same `depth`, else that generation is treated as torn and skipped.
+
+Legacy (pre-manifest) checkpoints load with the identity check only, so
+existing checkpoint directories keep working.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from .faults import FaultPlan
+
+MANIFEST_KEY = "__manifest__"
+
+
+class CheckpointCorrupt(Exception):
+    """No on-disk checkpoint generation passed verification."""
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def build_manifest(arrays: dict) -> dict:
+    """name -> {crc32, dtype, shape} for every array in a checkpoint."""
+    man = {}
+    for k, v in arrays.items():
+        a = np.asarray(v)
+        man[k] = {"crc32": _crc(a), "dtype": str(a.dtype), "shape": list(a.shape)}
+    return man
+
+
+class CheckpointStore:
+    def __init__(
+        self,
+        directory: str,
+        basename: str,
+        ident: str,
+        keep: int = 3,
+        fault_plan: Optional[FaultPlan] = None,
+    ):
+        if not basename.endswith(".npz"):
+            raise ValueError(f"basename must end in .npz, got {basename!r}")
+        self.directory = directory
+        self.basename = basename
+        self.ident = ident
+        self.keep = max(1, int(keep))
+        self.fault_plan = fault_plan
+        os.makedirs(directory, exist_ok=True)
+
+    # --- paths ---------------------------------------------------------
+    def path(self, gen: int = 0, part: Optional[str] = None) -> str:
+        """Generation `gen` (0 = newest) of the main file or a part file.
+
+        gen 0 keeps the legacy names (`<base>.npz`, `<base>.npz.<part>`) so
+        pre-rotation directories and tooling stay compatible."""
+        stem = self.basename[: -len(".npz")]
+        name = self.basename if gen == 0 else f"{stem}.{gen}.npz"
+        if part is not None:
+            name += f".{part}"
+        return os.path.join(self.directory, name)
+
+    # --- save ----------------------------------------------------------
+    def save(self, depth: int, arrays: dict, part: Optional[str] = None) -> str:
+        """Checksummed write + rotate + atomic promote; returns the path.
+
+        `depth` is stamped into the file (and must match across the main
+        file and every part of a generation for a load to accept it)."""
+        arrays = dict(arrays)
+        arrays["ident"] = self.ident
+        arrays["depth"] = depth
+        path = self.path(0, part)
+        tmp = path + ".tmp.npz"
+        # uncompressed (live fingerprints are high-entropy; zlib only burns
+        # time — same rationale as the seed writer)
+        np.savez(tmp, **{MANIFEST_KEY: json.dumps(build_manifest(arrays))}, **arrays)
+        if self.fault_plan is not None:
+            # torn-write rehearsal point: tmp written, nothing promoted
+            self.fault_plan.crash("ckpt", depth)
+        # shift existing generations up (newest-first so each replace's
+        # target is the already-vacated slot); generation keep-1 falls off
+        for g in range(self.keep - 1, 0, -1):
+            src = self.path(g - 1, part)
+            if os.path.exists(src):
+                os.replace(src, self.path(g, part))
+        os.replace(tmp, path)
+        if self.fault_plan is not None and self.fault_plan.should_corrupt(depth):
+            from .faults import corrupt_file
+
+            corrupt_file(path)
+        return path
+
+    # --- load ----------------------------------------------------------
+    def _verify(self, path: str) -> dict:
+        """Load `path` into a plain dict, checking the manifest checksums.
+
+        Raises CheckpointCorrupt on any read/CRC/manifest failure.  A
+        legacy file (no manifest) loads unchecked."""
+        try:
+            with np.load(path, allow_pickle=False) as snap:
+                arrays = {k: snap[k] for k in snap.files}
+        except Exception as e:  # zipfile/np errors: torn or rotted file
+            raise CheckpointCorrupt(f"{path}: unreadable ({e})") from e
+        man_raw = arrays.pop(MANIFEST_KEY, None)
+        if man_raw is None:
+            return arrays  # legacy pre-manifest checkpoint
+        try:
+            manifest = json.loads(str(man_raw))
+        except ValueError as e:
+            raise CheckpointCorrupt(f"{path}: bad manifest ({e})") from e
+        if set(manifest) != set(arrays):
+            raise CheckpointCorrupt(
+                f"{path}: manifest/content mismatch "
+                f"({sorted(set(manifest) ^ set(arrays))})"
+            )
+        for k, meta in manifest.items():
+            if _crc(arrays[k]) != meta["crc32"]:
+                raise CheckpointCorrupt(f"{path}: checksum mismatch on {k!r}")
+        return arrays
+
+    def _check_ident(self, path: str, arrays: dict) -> None:
+        found = str(arrays["ident"]) if "ident" in arrays else "<none>"
+        if found != self.ident:
+            raise ValueError(
+                f"checkpoint at {path} was written by a different "
+                f"model/config:\n  checkpoint: {found}\n  this run:   {self.ident}"
+            )
+
+    def generations(self) -> list:
+        """Generation indices present on disk (main files), newest first."""
+        return [g for g in range(self.keep) if os.path.exists(self.path(g))]
+
+    def _find_part(self, part: str, depth, errors: list):
+        """Newest verifying generation of `part` at level `depth`, or None.
+
+        Parts are matched to the main file BY LEVEL, not by generation
+        index: part and main chains rotate at slightly different moments
+        (every process promotes its part before the coordinator promotes
+        the main file), so a crash in between skews the chains by one —
+        pairing by index would make every generation look torn and defeat
+        fallback entirely."""
+        for pg in range(self.keep):
+            path = self.path(pg, part)
+            if not os.path.exists(path):
+                continue
+            try:
+                pa = self._verify(path)
+            except CheckpointCorrupt as e:
+                errors.append(str(e))
+                continue
+            self._check_ident(path, pa)
+            if "depth" not in pa or int(pa["depth"]) == depth:
+                return pa
+        return None
+
+    def load(self, parts: tuple = ()) -> Optional[tuple]:
+        """Newest verifying generation -> (main_arrays, {part: arrays}, gen).
+
+        Walks main generations newest -> oldest; a generation is accepted
+        only when the main file verifies and every requested part has a
+        verifying copy AT THE SAME LEVEL (the cross-shard level-consistency
+        check — a crash between part and main writes must not splice two
+        different levels; the part may live at a different generation
+        index, see _find_part).  Returns None when no checkpoint exists at
+        all; raises CheckpointCorrupt when files exist but none verify;
+        raises ValueError on an identity mismatch (never falls back past
+        it)."""
+        gens = self.generations()
+        if not gens:
+            return None
+        errors = []
+        for g in gens:
+            try:
+                main = self._verify(self.path(g))
+            except CheckpointCorrupt as e:
+                errors.append(str(e))
+                continue
+            self._check_ident(self.path(g), main)
+            depth = int(main["depth"]) if "depth" in main else None
+            part_arrays = {}
+            torn = False
+            for p in parts:
+                pa = self._find_part(p, depth, errors)
+                if pa is None:
+                    errors.append(
+                        f"generation {g}: no verifying part {p!r} at "
+                        f"level {depth} (crash mid-checkpoint?)"
+                    )
+                    torn = True
+                    break
+                part_arrays[p] = pa
+            if torn:
+                continue
+            if errors:
+                import sys
+
+                print(
+                    f"[checkpoint] newest generation(s) failed verification; "
+                    f"resuming from generation {g} (level {depth}):\n  "
+                    + "\n  ".join(errors),
+                    file=sys.stderr,
+                )
+            return main, part_arrays, g
+        raise CheckpointCorrupt(
+            "no checkpoint generation verified:\n  " + "\n  ".join(errors)
+        )

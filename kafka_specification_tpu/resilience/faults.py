@@ -1,0 +1,198 @@
+"""Deterministic fault injection (`KSPEC_FAULT` env grammar).
+
+The engines call into an active `FaultPlan` at their recovery-relevant
+boundaries, so every recovery path (crash -> resume, corrupt checkpoint ->
+fallback, transient backend error -> retry, escalated-compile OOM ->
+uniform fallback) is drivable from a tier-1 CPU test or a supervised
+production rehearsal — no real hardware failure needed.
+
+Grammar (comma-separated specs in `KSPEC_FAULT` or `--fault`):
+
+    crash@level:N             raise InjectedCrash at the level-N boundary
+    crash@ckpt:N              raise InjectedCrash mid-checkpoint-write at
+                              level N (after the tmp write, BEFORE the
+                              atomic promote — the torn-write rehearsal)
+    corrupt_ckpt              corrupt the newest checkpoint right after its
+                              first write (checksum-fallback rehearsal)
+    corrupt_ckpt@ckpt:N       same, after the write at level N
+    compile_oom               the next escalated (per-action-tuple) chunk
+                              step raises an LLVM-OOM-shaped error once
+                              (the reproducible wide-product XLA:CPU
+                              failure, TODO.md)
+    transient_device_err:N    the next N chunk/exchange step executions
+                              raise a transient-classified backend error
+
+Crash faults fire only when the run *started* below the target level
+(`FaultPlan.set_start_depth` is called by the engines after a checkpoint
+resume), and on a checkpointing run a `crash@level:N` additionally defers
+until a checkpoint at or past level N exists — so a supervised restart
+always resumes at or past the target and converges instead of
+crash-looping, for any `checkpoint_every`.  `crash@ckpt:N` is the
+exception — a resume from the previous good generation starts below N
+again and would re-fire; it is meant for in-process torn-write tests, not
+supervised runs.
+
+Budgeted faults (`compile_oom`, `transient_device_err:N`) are consumed
+in-process and do not persist across restarts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+ENV_VAR = "KSPEC_FAULT"
+
+# markers chosen so retry.classify() routes the injected error down the
+# same branch a real backend error of that family would take
+TRANSIENT_MARKER = "DATA_LOSS: injected transient device error (KSPEC_FAULT)"
+OOM_MARKER = "LLVM ERROR: out of memory (injected by KSPEC_FAULT=compile_oom)"
+
+
+class InjectedFault(RuntimeError):
+    """Base class for all deliberately injected failures."""
+
+
+class InjectedCrash(InjectedFault):
+    """An injected hard crash (the process is expected to die)."""
+
+
+@dataclass
+class _Spec:
+    kind: str  # crash | corrupt_ckpt | compile_oom | transient_device_err
+    point: Optional[str]  # level | ckpt | None
+    arg: Optional[int]  # level number (crash/corrupt) — None = first
+    budget: int  # remaining firings
+
+
+def _parse_token(tok: str) -> _Spec:
+    name, _, count = tok.partition(":") if "@" not in tok else (tok, "", "")
+    if "@" in tok:
+        name, _, rest = tok.partition("@")
+        point, _, arg = rest.partition(":")
+        if not arg:
+            raise ValueError(f"fault {tok!r}: '@{point}' needs ':<level>'")
+        try:
+            level = int(arg)
+        except ValueError:
+            raise ValueError(f"fault {tok!r}: level must be an integer")
+        if level < 1:
+            # crash faults fire only when the run STARTED below the target
+            # level (start_depth < N), so level 0 could never fire — reject
+            # it instead of silently rehearsing nothing
+            raise ValueError(f"fault {tok!r}: level must be >= 1")
+        if name == "crash" and point in ("level", "ckpt"):
+            return _Spec("crash", point, level, 1)
+        if name == "corrupt_ckpt" and point == "ckpt":
+            return _Spec("corrupt_ckpt", "ckpt", level, 1)
+        raise ValueError(f"unknown fault {tok!r}")
+    if name == "corrupt_ckpt":
+        if count:
+            raise ValueError(f"fault {tok!r}: use corrupt_ckpt@ckpt:<level>")
+        return _Spec("corrupt_ckpt", "ckpt", None, 1)
+    if name == "compile_oom":
+        return _Spec("compile_oom", None, None, int(count) if count else 1)
+    if name == "transient_device_err":
+        return _Spec(
+            "transient_device_err", None, None, int(count) if count else 1
+        )
+    raise ValueError(
+        f"unknown fault {tok!r} (grammar: crash@level:N, crash@ckpt:N, "
+        f"corrupt_ckpt[@ckpt:N], compile_oom, transient_device_err:N)"
+    )
+
+
+class FaultPlan:
+    """A parsed set of faults plus their remaining budgets.
+
+    Engines construct one per run via `FaultPlan.from_env()`; an unset env
+    yields an empty plan whose hooks are all no-ops.
+    """
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec or ""
+        self.start_depth = 0
+        self.specs = [
+            _parse_token(t.strip())
+            for t in self.spec.split(",")
+            if t.strip()
+        ]
+
+    @classmethod
+    def from_env(cls, env_var: str = ENV_VAR) -> "FaultPlan":
+        return cls(os.environ.get(env_var, ""))
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def set_start_depth(self, depth: int) -> None:
+        """Record the depth a resumed run starts from: crash faults at or
+        below it are considered already-fired (restart convergence)."""
+        self.start_depth = int(depth)
+
+    def crash(self, point: str, depth: int, ckpt_depth=None) -> None:
+        """Raise InjectedCrash if a crash fault matches this (point, depth).
+
+        `ckpt_depth` (level boundaries only): the newest durably
+        checkpointed level, or None when the run isn't checkpointing.
+        With checkpointing, a level crash is DEFERRED until a checkpoint
+        at or past the target level exists — otherwise checkpoint_every>1
+        would resume below the target and re-fire forever (e.g. crash@
+        level:7 with saves only at even levels).  The crash then fires at
+        the first level boundary where resuming cannot re-trigger it, so
+        a supervised restart always converges."""
+        for s in self.specs:
+            if s.kind != "crash" or s.point != point or s.budget <= 0:
+                continue
+            if self.start_depth >= s.arg:
+                continue  # resumed at/past the target: counts as fired
+            if point == "level":
+                if depth < s.arg:
+                    continue
+                if ckpt_depth is not None and ckpt_depth < s.arg:
+                    continue  # not durably past the target yet: defer
+            elif depth != s.arg:
+                continue
+            s.budget -= 1
+            raise InjectedCrash(
+                f"injected crash at {point}:{depth} (KSPEC_FAULT)"
+            )
+
+    def chunk_error(self, escalated: bool) -> Optional[Exception]:
+        """Error to inject into the next chunk/exchange step, or None.
+
+        compile_oom fires only on escalated (per-action width tuple)
+        attempts — matching the real failure mode it rehearses, and the
+        only attempt shape for which the engines have a compile fallback.
+        """
+        for s in self.specs:
+            if s.kind == "transient_device_err" and s.budget > 0:
+                s.budget -= 1
+                return RuntimeError(TRANSIENT_MARKER)
+            if s.kind == "compile_oom" and s.budget > 0 and escalated:
+                s.budget -= 1
+                return RuntimeError(OOM_MARKER)
+        return None
+
+    def should_corrupt(self, depth: int) -> bool:
+        """True if the checkpoint just written at `depth` must be corrupted."""
+        for s in self.specs:
+            if s.kind == "corrupt_ckpt" and s.budget > 0:
+                if s.arg is None or s.arg == depth:
+                    s.budget -= 1
+                    return True
+        return False
+
+
+def corrupt_file(path: str, n_bytes: int = 64) -> None:
+    """Flip a run of bytes in the middle of `path` (simulated bit rot).
+
+    Lands inside an npz member's compressed/stored data, so both the zip
+    CRC and the manifest checksums must catch it on the next load."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(max(0, size // 2 - n_bytes // 2))
+        chunk = fh.read(n_bytes)
+        fh.seek(max(0, size // 2 - n_bytes // 2))
+        fh.write(bytes(b ^ 0xFF for b in chunk))

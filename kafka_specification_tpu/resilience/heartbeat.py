@@ -1,0 +1,39 @@
+"""Shared JSONL heartbeat envelope.
+
+Every record written by the observability/liveness streams — the engines'
+per-level stats lines, the TPU-window sentry's per-attempt lines, and the
+supervisor's own event log — carries the same envelope so one consumer
+(the supervisor's stall detector, or a human with `tail -f | jq`) can read
+any of them:
+
+    {"kind": "<stream>", "ts": "<UTC ISO-8601>", "unix": <float seconds>, ...}
+
+`kind` values in use: "level" (engine per-level stats), "sentry" (TPU
+sentry attempts), "supervisor" (resilient_run events).  Stream-specific
+fields ride alongside.
+
+Must stay jax-free: imported by parents that never touch the accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def heartbeat_record(kind: str, t: float = None, **fields) -> dict:
+    """Envelope a record; `t` overrides the stamped time (e.g. a consumer
+    that needs event-START semantics stamps the start, not now)."""
+    if t is None:
+        t = time.time()
+    return {
+        "kind": kind,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t)),
+        "unix": round(t, 3),
+        **fields,
+    }
+
+
+def append_jsonl(path: str, record: dict) -> None:
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record) + "\n")

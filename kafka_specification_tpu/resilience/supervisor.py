@@ -1,0 +1,198 @@
+"""Supervised auto-resume runner (the library behind scripts/resilient_run.py).
+
+Replaces the round-5 bash supervisor (`scripts/supervise_prod464.sh`) with
+a watchdog that actually observes progress instead of only exit codes:
+
+- spawns the run as a child process (stdout/stderr to a per-attempt log
+  when `log_dir` is set),
+- watches the heartbeat JSONL file the child appends to (the engines'
+  `stats_path` per-level stream) — any growth counts as progress,
+- kills the child (SIGTERM, then SIGKILL) when the heartbeat stalls past
+  `stall_timeout` seconds — the wedged-tunnel mode that has eaten whole
+  rounds hangs without exiting, which a bash `for` loop never notices,
+- restarts from the engine checkpoint with a bounded restart budget and
+  jittered exponential backoff (thundering-herd hygiene even for one box),
+- appends one heartbeat-enveloped JSONL event per transition (start /
+  stall-kill / exit / complete / give-up) to the event log.
+
+The child is responsible for its own resume: engines resume automatically
+from `checkpoint_dir` (hardened, checksummed, keep-last-K — see
+`resilience.checkpoints`), so a restart is exactly "run the same command
+again".
+
+Must stay jax-free (the parent never touches a possibly-wedged tunnel).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .heartbeat import append_jsonl, heartbeat_record
+
+
+@dataclass
+class SupervisorConfig:
+    cmd: list
+    heartbeat: Optional[str] = None  # JSONL the child appends to
+    events: str = "RESILIENT_EVENTS.jsonl"
+    log_dir: Optional[str] = None  # per-attempt child logs
+    stall_timeout: float = 1800.0  # no heartbeat growth for this long -> kill
+    max_restarts: int = 8  # restarts, not attempts (attempts = 1 + this)
+    backoff_base: float = 5.0
+    backoff_cap: float = 300.0
+    jitter: float = 0.25
+    poll: float = 0.5
+    term_grace: float = 10.0  # SIGTERM -> SIGKILL grace
+    env: Optional[dict] = None
+    rng: random.Random = field(default_factory=random.Random, repr=False)
+
+    def backoff(self, restart: int) -> float:
+        d = min(self.backoff_base * 2.0 ** (restart - 1), self.backoff_cap)
+        return d * (1.0 + self.jitter * self.rng.random())
+
+
+STALL_RC = -97  # synthetic rc recorded for a stall-killed attempt
+
+
+def _hb_size(path: Optional[str]) -> int:
+    if not path:
+        return 0
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def _run_attempt(cfg: SupervisorConfig, attempt: int) -> int:
+    """One child run: returns its exit code, or STALL_RC if stall-killed."""
+    log_fh = None
+    if cfg.log_dir is not None:
+        os.makedirs(cfg.log_dir, exist_ok=True)
+        log_fh = open(
+            os.path.join(cfg.log_dir, f"attempt-{attempt:02d}.log"), "wb"
+        )
+    try:
+        # own session/process group: a stall-kill must take down the whole
+        # tree (the command may be a shell wrapper whose wedged grandchild
+        # would otherwise survive, keep the accelerator, and race the
+        # restarted attempt on the checkpoint directory)
+        child = subprocess.Popen(
+            cfg.cmd,
+            stdout=log_fh or None,
+            stderr=subprocess.STDOUT if log_fh else None,
+            env=cfg.env,
+            start_new_session=True,
+        )
+
+        def signal_tree(sig):
+            try:
+                os.killpg(child.pid, sig)  # pgid == pid (new session)
+            except (OSError, ProcessLookupError):
+                try:
+                    child.send_signal(sig)
+                except (OSError, ProcessLookupError):
+                    pass
+
+        last_progress = time.monotonic()
+        hb_size = _hb_size(cfg.heartbeat)
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                return rc
+            if cfg.heartbeat is None:
+                # no heartbeat stream configured: the stall detector is
+                # off (a constant size would read as an eternal stall and
+                # kill every healthy child) — only child exits matter
+                time.sleep(cfg.poll)
+                continue
+            size = _hb_size(cfg.heartbeat)
+            if size != hb_size:
+                hb_size = size
+                last_progress = time.monotonic()
+            if time.monotonic() - last_progress > cfg.stall_timeout:
+                append_jsonl(
+                    cfg.events,
+                    heartbeat_record(
+                        "supervisor",
+                        event="stall-kill",
+                        attempt=attempt,
+                        stall_timeout=cfg.stall_timeout,
+                        heartbeat=cfg.heartbeat,
+                    ),
+                )
+                signal_tree(signal.SIGTERM)
+                try:
+                    child.wait(timeout=cfg.term_grace)
+                except subprocess.TimeoutExpired:
+                    signal_tree(signal.SIGKILL)
+                    child.wait()
+                return STALL_RC
+            time.sleep(cfg.poll)
+    finally:
+        if log_fh is not None:
+            log_fh.close()
+
+
+def supervise(cfg: SupervisorConfig) -> int:
+    """Run cfg.cmd to success or budget exhaustion; returns the final rc."""
+    rc = None
+    for attempt in range(1, cfg.max_restarts + 2):
+        append_jsonl(
+            cfg.events,
+            heartbeat_record(
+                "supervisor", event="start", attempt=attempt, cmd=cfg.cmd
+            ),
+        )
+        t0 = time.time()
+        rc = _run_attempt(cfg, attempt)
+        append_jsonl(
+            cfg.events,
+            heartbeat_record(
+                "supervisor",
+                event="exit",
+                attempt=attempt,
+                rc=rc,
+                seconds=round(time.time() - t0, 1),
+            ),
+        )
+        if rc == 0:
+            append_jsonl(
+                cfg.events,
+                heartbeat_record("supervisor", event="complete", attempt=attempt),
+            )
+            return 0
+        if attempt > cfg.max_restarts:
+            break
+        delay = cfg.backoff(attempt)
+        append_jsonl(
+            cfg.events,
+            heartbeat_record(
+                "supervisor",
+                event="restart",
+                attempt=attempt,
+                backoff_s=round(delay, 2),
+            ),
+        )
+        time.sleep(delay)
+    append_jsonl(
+        cfg.events,
+        heartbeat_record(
+            "supervisor",
+            event="give-up",
+            attempts=cfg.max_restarts + 1,
+            rc=rc,
+        ),
+    )
+    print(
+        f"[supervisor] giving up after {cfg.max_restarts + 1} attempts "
+        f"(last rc={rc}); see {cfg.events}",
+        file=sys.stderr,
+    )
+    return rc if rc not in (0, None) else 1

@@ -246,7 +246,53 @@ def main(argv=None):
         "--checkpoint", help="directory for level-synchronous checkpoint/resume"
     )
     pc.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=1,
+        help="persist a checkpoint every N BFS levels (default 1)",
+    )
+    pc.add_argument(
+        "--checkpoint-keep",
+        type=int,
+        default=3,
+        help="rotated checkpoint generations to keep (default 3; corrupt "
+        "newest falls back to the next verifying one)",
+    )
+    pc.add_argument(
         "--stats", help="append per-level JSONL stats (e.g. PROGRESS.jsonl)"
+    )
+    pc.add_argument(
+        "--fault",
+        metavar="PLAN",
+        help="deterministic fault injection plan (sets KSPEC_FAULT; e.g. "
+        "'crash@level:7', 'corrupt_ckpt', 'transient_device_err:2' — "
+        "grammar in docs/resilience.md)",
+    )
+    pc.add_argument(
+        "--resilient",
+        action="store_true",
+        help="run under the auto-resume supervisor: spawn the check as a "
+        "child, watch the --stats heartbeat, kill on stall, restart from "
+        "--checkpoint with a bounded budget (scripts/resilient_run.py is "
+        "the standalone form)",
+    )
+    pc.add_argument(
+        "--stall-timeout",
+        type=float,
+        default=1800.0,
+        help="[--resilient] kill the child after this many seconds "
+        "without heartbeat growth (default 1800)",
+    )
+    pc.add_argument(
+        "--max-restarts",
+        type=int,
+        default=8,
+        help="[--resilient] restart budget (default 8)",
+    )
+    pc.add_argument(
+        "--events",
+        help="[--resilient] supervisor JSONL event log (default: "
+        "<checkpoint>/supervisor_events.jsonl)",
     )
     pc.add_argument(
         "--visited-backend",
@@ -340,6 +386,26 @@ def main(argv=None):
     except (OSError, ValueError) as e:
         print(f"error: cannot parse {args.cfg}: {e}", file=sys.stderr)
         return 2
+
+    if args.cmd == "check" and (args.checkpoint_every < 1 or args.checkpoint_keep < 1):
+        print(
+            "error: --checkpoint-every and --checkpoint-keep must be >= 1",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.cmd == "check" and args.fault:
+        from ..resilience.faults import FaultPlan
+
+        try:
+            FaultPlan(args.fault)  # validate the grammar before running
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        os.environ["KSPEC_FAULT"] = args.fault
+
+    if args.cmd == "check" and args.resilient:
+        return _run_resilient(args, argv if argv is not None else sys.argv[1:])
 
     if args.cmd in ("check", "simulate"):
         if (
@@ -461,6 +527,52 @@ def main(argv=None):
 
 
 
+def _run_resilient(args, argv) -> int:
+    """`check --resilient`: re-run this command under the supervisor.
+
+    The child is this same CLI minus --resilient; engines resume from
+    --checkpoint automatically, so a restart is just a re-run."""
+    from ..resilience.supervisor import SupervisorConfig, supervise
+
+    # strip the flag AND its argparse prefix abbreviations ("--resil" also
+    # sets args.resilient; letting it through would make every child spawn
+    # its own supervisor recursively)
+    child_argv = [
+        a
+        for a in argv
+        if not (a.startswith("--re") and "--resilient".startswith(a))
+    ]
+    if not args.checkpoint:
+        print(
+            "warning: --resilient without --checkpoint — a restarted run "
+            "starts over from the initial states",
+            file=sys.stderr,
+        )
+    if not args.stats:
+        print(
+            "warning: --resilient without --stats — no heartbeat stream, "
+            "so the stall detector only sees child exits",
+            file=sys.stderr,
+        )
+    events = args.events or (
+        os.path.join(args.checkpoint, "supervisor_events.jsonl")
+        if args.checkpoint
+        else "RESILIENT_EVENTS.jsonl"
+    )
+    if args.checkpoint:
+        os.makedirs(args.checkpoint, exist_ok=True)
+    cfg = SupervisorConfig(
+        cmd=[sys.executable, "-m", "kafka_specification_tpu.utils.cli"]
+        + child_argv,
+        heartbeat=args.stats,
+        events=events,
+        stall_timeout=args.stall_timeout,
+        max_restarts=args.max_restarts,
+        env=dict(os.environ),
+    )
+    return supervise(cfg)
+
+
 def _kernel_source(args, module) -> bool:
     """Resolve check/simulate kernel source: True = emitted (the default
     when the reference corpus is on disk), False = hand-translated.
@@ -515,6 +627,8 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
             check_deadlock=tlc_cfg.check_deadlock,
             store_trace=not args.no_trace,
             checkpoint_dir=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_keep=args.checkpoint_keep,
             stats_path=args.stats,
             visited_backend=args.visited_backend,
             **chunk_kw,
@@ -530,6 +644,8 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
             min_bucket=args.min_bucket,
             progress=progress,
             checkpoint_dir=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_keep=args.checkpoint_keep,
             check_deadlock=tlc_cfg.check_deadlock,
             stats_path=args.stats,
             visited_backend=args.visited_backend,

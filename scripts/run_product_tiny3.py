@@ -128,6 +128,9 @@ def main():
         min_bucket=4096,
         checkpoint_dir=os.environ.get("KSPEC_PROD_CKPT") or None,
         checkpoint_every=2,
+        # per-level heartbeat stream for the supervisor's stall detector
+        # (scripts/resilient_run.py --preset prod464 sets this)
+        stats_path=os.environ.get("KSPEC_PROD_STATS") or None,
         compact_shift=int(os.environ.get("KSPEC_PROD_SHIFT") or 2),
         progress=lambda d, n, t: print(
             f"#   level {d}: +{n:,} -> {t:,} ({time.perf_counter()-t0:.0f}s)",

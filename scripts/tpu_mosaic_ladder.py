@@ -86,6 +86,15 @@ def main():
         "rungs": {},
     }
     print(f"# platform: {record['platform']}", flush=True)
+
+    def _bank():
+        # persist after EVERY rung (tpu_window.py's per-stage banking
+        # pattern): the libtpu AOT helper failure this ladder probes can
+        # hard-kill the parent, and a window is too rare to lose the
+        # rungs that already ran (round-5 advisor item)
+        with open(os.path.join(_REPO, "TPU_MOSAIC_LADDER.json"), "w") as f:
+            json.dump(record, f, indent=1)
+
     x = jnp.arange(256, dtype=jnp.uint32)
     for name, k in rungs:
         t0 = time.perf_counter()
@@ -103,8 +112,7 @@ def main():
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
             }
         print(f"# {name}: {record['rungs'][name]}", flush=True)
-    with open(os.path.join(_REPO, "TPU_MOSAIC_LADDER.json"), "w") as f:
-        json.dump(record, f, indent=1)
+        _bank()
     ok = all(r["ok"] for r in record["rungs"].values())
     return 0 if ok else 3
 

@@ -27,13 +27,21 @@ Usage:  nohup python scripts/tpu_sentry.py >/dev/null 2>&1 &
         KSPEC_TPU_WINDOW_PROBE=1 nohup python scripts/tpu_sentry.py &
 """
 
-import json
 import os
 import subprocess
 import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# the shared heartbeat envelope (kind/ts/unix) the resilient_run
+# supervisor consumes — jax-free import, safe in this tunnel-shy parent
+from kafka_specification_tpu.resilience.heartbeat import (  # noqa: E402
+    append_jsonl,
+    heartbeat_record,
+)
+
 _LOG = os.path.join(_REPO, "TPU_SENTRY.jsonl")
 _PERIOD = int(os.environ.get("KSPEC_SENTRY_PERIOD", "1800"))
 _HOURS = float(os.environ.get("KSPEC_SENTRY_HOURS", "12"))
@@ -65,17 +73,21 @@ def _attempt(n):
     outcome = _OUTCOME.get(rc, f"crashed({rc})")
     if probe_only and rc == 0:
         outcome = "live-probe"
-    line = {
-        "attempt": n,
-        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
-        "seconds": round(time.time() - t0, 1),
-        "rc": rc,
-        "outcome": outcome,
-    }
+    # same JSONL heartbeat schema the supervisor consumes
+    # (resilience.heartbeat): kind + ts + unix envelope, fields alongside.
+    # ts keeps the ATTEMPT-START semantics this log has always had
+    # (consumers infer window-open times from it)
+    line = heartbeat_record(
+        "sentry",
+        t=t0,
+        attempt=n,
+        seconds=round(time.time() - t0, 1),
+        rc=rc,
+        outcome=outcome,
+    )
     if probe_only:
         line["probe_only"] = True
-    with open(_LOG, "a") as fh:
-        fh.write(json.dumps(line) + "\n")
+    append_jsonl(_LOG, line)
     return rc
 
 
