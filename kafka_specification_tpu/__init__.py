@@ -25,6 +25,9 @@ Layout:
     engine/    BFS checker, trace reconstruction, checkpointing, stats
     parallel/  mesh-sharded frontier (ICI collectives; multi-host via DCN)
     oracle/    slow set-semantics reference interpreter (golden source)
+    storage/   out-of-core tier: bloom-gated fingerprint runs on disk,
+               spilled frontier segments, on-disk parent log (--mem-budget)
+    resilience/ fault injection, hardened checkpoints, retry, supervisor
     utils/     TLC-compatible .cfg parsing, TLA+ front-end, CLI
 """
 

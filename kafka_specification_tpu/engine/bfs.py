@@ -25,6 +25,7 @@ is run with TLC's deadlock check disabled for the same reason).
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -713,6 +714,29 @@ def _pad_rows(arr: np.ndarray, n: int, fill=0):
     return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
 
 
+# --- frontier adapters: the level loop runs identically over an in-RAM
+# array or a disk-spilled FrontierReader (storage/frontier) — same global
+# offsets, same chunk boundaries, hence bit-identical counts and traces
+def _f_rows(f) -> int:
+    return f.shape[0] if isinstance(f, np.ndarray) else f.rows
+
+
+def _f_chunks(f, chunk: int):
+    if isinstance(f, np.ndarray):
+        for s in range(0, f.shape[0], chunk):
+            yield s, f[s : s + chunk]
+    else:
+        yield from f.iter_chunks(chunk)
+
+
+def _f_row(f, i: int) -> np.ndarray:
+    return f[i] if isinstance(f, np.ndarray) else f.row(i)
+
+
+def _f_all(f) -> np.ndarray:
+    return f if isinstance(f, np.ndarray) else f.read_all()
+
+
 def walk_trace(trace_store, actions, decode_row, inv_name, depth, idx) -> Violation:
     """Parent-pointer counterexample reconstruction, shared by both engines.
 
@@ -751,6 +775,9 @@ def check(
     chunk_size: int = 32768,
     visited_capacity_hint: Optional[int] = None,
     compact_shift: int = 2,
+    mem_budget=None,
+    spill_dir: Optional[str] = None,
+    store: str = "auto",
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -814,14 +841,43 @@ def check(
 
     Fault injection (resilience.faults): a `KSPEC_FAULT` plan exercises the
     recovery paths deterministically — level-boundary / checkpoint-write
-    crashes, checkpoint corruption, transient backend errors (retried with
-    bounded exponential backoff; count in result.stats["transient_retries"])
-    and the escalated-compile OOM (degrades to the uniform compact path;
-    recorded in result.stats["degradations"]).
+    crashes, mid-merge disk-tier crashes (`crash@merge:N`), checkpoint
+    corruption, transient backend errors (retried with bounded exponential
+    backoff; count in result.stats["transient_retries"]) and the
+    escalated-compile OOM (degrades to the uniform compact path; recorded
+    in result.stats["degradations"]).
+
+    Out-of-core storage (storage/): `store` = "auto" | "ram" | "disk".
+    "disk" (or "auto" with a `mem_budget`) activates the disk tier for
+    state spaces that outgrow RAM: the host FpSet is bounded at
+    `mem_budget` bytes and spills sorted, bloom-gated fingerprint runs to
+    `spill_dir` (periodic k-way merge; lookups touch disk only on probable
+    hits), the frontier spills to chunked segments consumed in discovery
+    order, and parent pointers go to an append-only on-disk log so
+    counterexample traces are reconstructed from the log — including after
+    a checkpoint resume (this retires the empty-trace-after-resume
+    limitation for this engine).  The disk tier implies
+    visited_backend="host" (the disk tier spills the host level of the
+    hierarchy; device backends stay the in-HBM hot path) and is
+    bit-identical to the in-RAM path: same counts, depths, and trace
+    values (tests/test_storage.py forces tiny budgets to prove it).
+    Checkpoints record the storage manifest (run names + frontier segment
+    offsets) instead of re-serializing state — the disk tier itself is the
+    durable state.
     """
     spec = model.spec
     step_builder = _Step(model)
     K, C = spec.num_lanes, step_builder.C
+
+    from ..storage import resolve_store
+
+    use_disk = resolve_store(store, mem_budget)
+    want_trace = store_trace
+    if use_disk:
+        # the disk tier spills the HOST level of the hierarchy; traces
+        # ride the on-disk parent log instead of the in-RAM trace store
+        visited_backend = "host"
+        store_trace = False
 
     fault = FaultPlan.from_env()
     chunk_retry = ChunkRetryHandler.from_env("[engine]")
@@ -863,11 +919,52 @@ def check(
 
     t0 = time.perf_counter()
     hi0, lo0 = fingerprint_lanes(jnp.asarray(init_packed), spec.exact64)
+    disk = None
+    ephemeral_spill = None
     if visited_backend == "host":
-        from ..native import FpSet
+        if use_disk:
+            from ..storage import (
+                DEFAULT_MEM_BUDGET,
+                DiskTierStore,
+                parse_mem_budget,
+            )
 
-        host_set = FpSet()
-        host_set.insert(_u64(hi0, lo0))
+            budget = (
+                parse_mem_budget(mem_budget)
+                if mem_budget is not None
+                else DEFAULT_MEM_BUDGET
+            )
+            sd = spill_dir or (
+                os.path.join(checkpoint_dir, "spill") if checkpoint_dir else None
+            )
+            if sd is None:
+                import tempfile
+
+                # anonymous spill space: removed after a completed run (a
+                # crashed one cannot be resumed without a checkpoint, so
+                # its temp data is dead weight either way)
+                sd = tempfile.mkdtemp(prefix="kspec-spill-")
+                ephemeral_spill = sd
+            disk = DiskTierStore(
+                sd,
+                budget,
+                lanes=K,
+                gc_barrier=checkpoint_keep if checkpoint_dir else 0,
+                seg_rows=int(
+                    os.environ.get("KSPEC_SPILL_SEG_ROWS", str(1 << 18))
+                ),
+                runs_per_merge=int(
+                    os.environ.get("KSPEC_SPILL_RUNS_PER_MERGE", "8")
+                ),
+                fault_plan=fault,
+                trace=want_trace or checkpoint_dir is not None,
+            )
+            host_set = disk.fpset  # init fps inserted at start_fresh/resume
+        else:
+            from ..native import FpSet
+
+            host_set = FpSet()
+            host_set.insert(_u64(hi0, lo0))
         vcap = 64  # placeholder shapes; the device never holds the visited set
         vhi = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
         vlo = jnp.full(vcap, 0xFFFFFFFF, jnp.uint32)
@@ -918,8 +1015,24 @@ def check(
         s = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(packed_row)).items()}
         return model.decode(s) if model.decode else s
 
+    def _drop_ephemeral_spill():
+        if ephemeral_spill is not None:
+            import shutil
+
+            shutil.rmtree(ephemeral_spill, ignore_errors=True)
+
     def build_violation(inv_name, depth, idx):
+        if disk is not None and disk.has_trace(depth):
+            # reconstruct from the on-disk parent log: O(depth) single-
+            # record reads through the mmap'd level segments — this is
+            # what makes traces survive checkpoint/resume
+            return walk_trace(
+                disk.plog.view(), model.actions, decode_state, inv_name, depth, idx
+            )
         return walk_trace(trace_store, model.actions, decode_state, inv_name, depth, idx)
+
+    def have_trace(depth) -> bool:
+        return store_trace or (disk is not None and disk.has_trace(depth))
 
     # invariants on init states
     if check_invariants and model.invariants:
@@ -935,6 +1048,7 @@ def check(
                     state=decode_state(init_packed[idx]),
                     trace=[("<init>", decode_state(init_packed[idx]))],
                 )
+                _drop_ephemeral_spill()
                 return CheckResult(
                     model.name, levels, total, 0, viol, dt, total / max(dt, 1e-9)
                 )
@@ -953,7 +1067,9 @@ def check(
         f"{model.name}|lanes={spec.num_lanes}|backend={visited_backend}|"
         f"inv={inv_names}|dl={check_deadlock}|"
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
+        + ("|store=disk" if use_disk else "")
     )
+    resumed = False
     if checkpoint_dir is not None:
         ckpt_store = CheckpointStore(
             checkpoint_dir,
@@ -964,14 +1080,25 @@ def check(
         )
         loaded = ckpt_store.load()
         if loaded is not None:
+            resumed = True
             snap, _, _gen = loaded
-            frontier_np = snap["frontier"]
-            if host_set is not None:
+            if disk is not None:
+                # the checkpoint references the disk tier, it does not
+                # contain it: reopen the manifest's runs + frontier
+                # segments IN PLACE (host_set aliases disk.fpset),
+                # re-seed the budget-bounded hot set
+                disk.resume(
+                    json.loads(str(snap["spill_manifest"])), snap["host_fps"]
+                )
+                frontier_np = disk.pending()
+            elif host_set is not None:
+                frontier_np = snap["frontier"]
                 from ..native import FpSet
 
                 host_set = FpSet(initial_capacity=max(64, 2 * len(snap["host_fps"])))
                 host_set.insert(snap["host_fps"])
             elif ht_hi is not None:
+                frontier_np = snap["frontier"]
                 live_hi = snap["hash_hi"]
                 live_lo = snap["hash_lo"]
                 hash_n = live_hi.shape[0]
@@ -980,6 +1107,7 @@ def check(
                 )
                 ht_claim = None
             else:
+                frontier_np = snap["frontier"]
                 vcap = int(snap["vcap"])
                 n = int(snap["vn"])
                 pad = np.full(vcap - n, 0xFFFFFFFF, np.uint32)
@@ -994,11 +1122,36 @@ def check(
             # (a supervised restart must converge, not crash-loop)
             fault.set_start_depth(depth)
 
+    if disk is not None and not resumed:
+        # fresh out-of-core run: the spill directory namespace belongs to
+        # this run (stale runs must not pre-seed the visited set)
+        disk.start_fresh(init_packed, np.asarray(_u64(hi0, lo0)))
+        frontier_np = disk.pending()
+
     def _save_checkpoint():
         # only the live prefix of the visited set is saved (the sentinel
         # padding is rebuilt on resume from vcap/vn); uncompressed — live
         # fingerprints are high-entropy and zlib only burns time
         n = int(vn)
+        if disk is not None:
+            # the disk tier IS the durable state: record the run manifest
+            # + frontier-segment offsets + the (budget-bounded) hot dump,
+            # never the runs/segments themselves
+            ckpt_store.save(
+                depth,
+                dict(
+                    spill_manifest=json.dumps(disk.manifest()),
+                    host_fps=disk.fpset.hot_dump(),
+                    vcap=vcap,
+                    levels=np.asarray(levels),
+                    total=total,
+                ),
+            )
+            # a new durable generation exists: advance the deferred-
+            # deletion barrier (merged-away runs / consumed frontier
+            # segments older than every retained generation get unlinked)
+            disk.on_checkpoint_saved()
+            return
         if host_set is not None:
             extra = {"host_fps": host_set.dump()}
         elif ht_hi is not None:
@@ -1036,14 +1189,14 @@ def check(
     adaptive_fallback = False
     squeeze_full = False
 
-    while frontier_np.shape[0] > 0:
+    while _f_rows(frontier_np) > 0:
         # level-boundary fault injection point (resilience.faults)
         fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
         if max_depth is not None and depth >= max_depth:
             break
         if max_states is not None and total >= max_states:
             break
-        f_total = frontier_np.shape[0]
+        f_total = _f_rows(frontier_np)
         t_level = time.perf_counter()
         # A frontier larger than `chunk` is streamed through the same
         # compiled step in chunk_size pieces: cross-chunk duplicates are
@@ -1059,6 +1212,8 @@ def check(
         # cache-friendly sweep per chunk instead of u64 packing + novelty
         # mask + masked gathers + per-level concatenate.  Growth copies
         # only the filled prefix (amortized O(level)).
+        if disk is not None:
+            disk.begin_level(depth + 1)
         use_arena = host_set is not None and host_set.native
         if use_arena:
             a_cap = max(1 << 14, int(1.5 * f_total))
@@ -1067,8 +1222,7 @@ def check(
             a_act = np.empty(a_cap, np.int32)
             a_w = 0
         prof_step = prof_host_s = 0.0
-        for start in range(0, f_total, chunk):
-            piece = frontier_np[start : start + chunk]
+        for start, piece in _f_chunks(frontier_np, chunk):
             fp_n = piece.shape[0]
             bucket = _next_pow2(max(fp_n, min_bucket))
             M = bucket * C
@@ -1238,16 +1392,27 @@ def check(
                     )
                     a_w += w
                     lvl_new += w
-                else:  # numpy-set fallback (no native toolchain)
+                else:  # tiered disk store, or no native toolchain
                     rows = np.asarray(out[:nn])
                     mask = host_set.insert(
                         _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
                     )
-                    lvl_rows.append(rows[mask])
-                    lvl_parent.append(
-                        np.asarray(out_parent[:nn])[mask] + start
-                    )
-                    lvl_act.append(np.asarray(out_act[:nn])[mask])
+                    if disk is not None:
+                        # novel rows stream straight to the spilled
+                        # frontier + parent log in discovery order (int64
+                        # parents: level-global indices can pass 2^31 at
+                        # the scales this tier exists for)
+                        disk.append(
+                            rows[mask],
+                            np.asarray(out_parent[:nn], np.int64)[mask] + start,
+                            np.asarray(out_act[:nn])[mask],
+                        )
+                    else:
+                        lvl_rows.append(rows[mask])
+                        lvl_parent.append(
+                            np.asarray(out_parent[:nn])[mask] + start
+                        )
+                        lvl_act.append(np.asarray(out_act[:nn])[mask])
                     lvl_new += int(mask.sum())
             elif ht_hi is not None and nn:
                 # device-hash backend: insert-or-find on the HBM table; a
@@ -1356,13 +1521,15 @@ def check(
 
         if verdict is not None:
             kind, idx, inv_name = verdict
-            if store_trace:
+            if disk is not None:
+                disk.abort_level()  # partial next-level writer: discard
+            if have_trace(depth):
                 violation = build_violation(inv_name, depth, idx)
             else:
                 violation = Violation(
                     invariant=inv_name,
                     depth=depth,
-                    state=decode_state(frontier_np[idx]),
+                    state=decode_state(_f_row(frontier_np, idx)),
                     trace=[],
                 )
             break
@@ -1380,6 +1547,12 @@ def check(
                 next_frontier = next_frontier.copy()
                 level_parent = level_parent.copy()
                 level_act = level_act.copy()
+        elif disk is not None:
+            # publish the level: segments + parent-log frame become the
+            # pending frontier; the consumed level's segments go behind
+            # the checkpoint-generation deletion barrier
+            next_frontier = disk.end_level()
+            level_parent = level_act = None  # trace lives in the log
         else:
             next_frontier = (
                 np.concatenate(lvl_rows)
@@ -1421,7 +1594,7 @@ def check(
             if stats_path is not None:
                 append_jsonl(stats_path, rec)
         if collect_levels is not None and new_n:
-            collect_levels.append(next_frontier)
+            collect_levels.append(_f_all(next_frontier))
         if store_trace:
             trace_store.append((next_frontier, level_parent, level_act))
         if progress:
@@ -1432,21 +1605,21 @@ def check(
             _save_checkpoint()
             last_ckpt_depth = depth
 
-    if violation is None and check_invariants and model.invariants and frontier_np.shape[0]:
+    if violation is None and check_invariants and model.invariants and _f_rows(frontier_np):
         # the loop was cut (max_depth/max_states) before the remaining
         # frontier was expanded — its states still need their invariant pass
-        st = jax.vmap(spec.unpack)(jnp.asarray(frontier_np))
+        st = jax.vmap(spec.unpack)(jnp.asarray(_f_all(frontier_np)))
         for inv in model.invariants:
             ok = np.asarray(jax.vmap(inv.pred)(st))
             if not ok.all():
                 idx = int(np.argmax(~ok))
                 violation = (
                     build_violation(inv.name, depth, idx)
-                    if store_trace
+                    if have_trace(depth)
                     else Violation(
                         invariant=inv.name,
                         depth=depth,
-                        state=decode_state(frontier_np[idx]),
+                        state=decode_state(_f_row(frontier_np, idx)),
                         trace=[],
                     )
                 )
@@ -1467,9 +1640,14 @@ def check(
     )
     if host_set is not None:
         result_stats["host_fpset_size"] = len(host_set)
+    if disk is not None:
+        result_stats["spill"] = disk.stats()
+        result_stats["spill_dir"] = disk.dir
+        result_stats["mem_budget"] = disk.fpset.mem_budget
     if ht_hi is not None:
         result_stats["hash_table_capacity"] = int(ht_hi.shape[0])
         result_stats["hash_table_size"] = hash_n
+    _drop_ephemeral_spill()
     return CheckResult(
         model=model.name,
         levels=levels,

@@ -24,6 +24,8 @@ v5e-8 pod slice — XLA inserts the ICI collectives.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Optional
 
@@ -358,6 +360,9 @@ def check_sharded(
     compact_shift: int = 2,
     exchange: str = "all_to_all",
     visited_backend: str = "device",
+    mem_budget=None,
+    spill_dir: Optional[str] = None,
+    store: str = "auto",
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -400,6 +405,17 @@ def check_sharded(
     fingerprints outgrow HBM — the TLC-FPSet spill mode of engine.check,
     now at pod scale.  Device memory then holds only O(chunk × fanout)
     transient data per shard.
+
+    Out-of-core storage (storage/): `store` = "auto" | "ram" | "disk" and
+    `mem_budget` activate the disk tier for the host backend — each
+    shard's FpSet becomes a budget-bounded TieredFpSet spilling sorted,
+    bloom-gated fingerprint runs under `spill_dir`/shard<d> (fingerprint-
+    range ownership is unchanged: a fingerprint's owner shard, hence its
+    run directory, never moves).  Bit-identical counts vs the in-RAM host
+    path; checkpoints record each shard's run manifest + (budget-bounded)
+    hot dump instead of the full fingerprint sets.  The frontier and
+    traces stay in RAM in this engine (the single-device engine carries
+    the disk frontier + parent log).
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
@@ -445,12 +461,20 @@ def check_sharded(
                     0.0,
                     stats={"devices": D},
                 )
+    from ..storage import resolve_store
+
+    use_disk = resolve_store(store, mem_budget)
+    if use_disk:
+        # the disk tier spills the HOST level of the hierarchy
+        visited_backend = "host"
     if visited_backend not in ("device", "device-hash", "host"):
         raise ValueError(
             f"visited_backend must be 'device', 'device-hash' or 'host', "
             f"got {visited_backend!r}"
         )
     host_sets = None
+    spill_base = None
+    ephemeral_spill = None
 
     def _u64(hi, lo):
         return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
@@ -471,13 +495,55 @@ def check_sharded(
         # per-host ownership divides set memory and insert work by the
         # process count (novelty masks are OR-merged across processes to
         # keep the replicated host loop in lockstep)
-        host_sets = [
-            FpSet() if shard_proc[d] == my_proc else None for d in range(D)
-        ]
-        for d in range(D):
-            sel = np.nonzero(owner0 == d)[0]
-            if len(sel) and host_sets[d] is not None:
-                host_sets[d].insert(_u64(hi0[sel], lo0[sel]))
+        if use_disk:
+            from ..storage import (
+                DEFAULT_MEM_BUDGET,
+                TieredFpSet,
+                parse_mem_budget,
+            )
+
+            budget = (
+                parse_mem_budget(mem_budget)
+                if mem_budget is not None
+                else DEFAULT_MEM_BUDGET
+            )
+            spill_base = spill_dir or (
+                os.path.join(checkpoint_dir, "spill") if checkpoint_dir else None
+            )
+            if spill_base is None:
+                import tempfile
+
+                # anonymous spill space: removed after a completed run
+                spill_base = tempfile.mkdtemp(prefix="kspec-spill-")
+                ephemeral_spill = spill_base
+            # per-shard run directories; the byte budget divides across
+            # the shards THIS PROCESS hosts (mem_budget is per-process
+            # residency, matching engine.check — a multi-host job gets
+            # budget bytes per host, not budget/P).  Init fingerprints
+            # are inserted at the fresh/resume decision below (a resume
+            # must not pre-wipe the runs its manifest references).
+            n_local = max(1, sum(1 for p in shard_proc if p == my_proc))
+            host_sets = [
+                TieredFpSet(
+                    os.path.join(spill_base, f"shard{d}"),
+                    max(1, budget // n_local),
+                    runs_per_merge=int(
+                        os.environ.get("KSPEC_SPILL_RUNS_PER_MERGE", "8")
+                    ),
+                    gc_barrier=checkpoint_keep if checkpoint_dir else 0,
+                )
+                if shard_proc[d] == my_proc
+                else None
+                for d in range(D)
+            ]
+        else:
+            host_sets = [
+                FpSet() if shard_proc[d] == my_proc else None for d in range(D)
+            ]
+            for d in range(D):
+                sel = np.nonzero(owner0 == d)[0]
+                if len(sel) and host_sets[d] is not None:
+                    host_sets[d].insert(_u64(hi0[sel], lo0[sel]))
         vcap = 64  # device placeholders; the device never holds the set
         vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
         vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
@@ -538,18 +604,26 @@ def check_sharded(
         return dens.max(axis=0)
 
     fault = FaultPlan.from_env()
+    if use_disk:
+        # the plan is parsed after the per-shard sets are built — hand it
+        # to them now (mid-merge crash injection, crash@merge:N)
+        for s in host_sets:
+            if s is not None:
+                s.fault_plan = fault
     chunk_retry = ChunkRetryHandler.from_env("[sharded]")
     ckpt_store = None
     # newest durably checkpointed level (None = not checkpointing):
     # level-crash faults defer until the target level is checkpointed so
     # a supervised restart converges (FaultPlan.crash)
     last_ckpt_depth = None
+    resumed = False
     inv_names = ",".join(sorted(i.name for i in model.invariants))
     ckpt_ident = (
         f"{model.name}|lanes={spec.num_lanes}|D={D}|"
         f"P={jax.process_count()}|backend={visited_backend}|"
         f"inv={inv_names}|dl={check_deadlock}|"
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
+        + ("|store=disk" if use_disk else "")
     )
     if checkpoint_dir is not None:
         store_trace = False
@@ -572,6 +646,7 @@ def check_sharded(
         )
         loaded = ckpt_store.load(parts=my_parts)
         if loaded is not None:
+            resumed = True
             snap, part_arrays, _gen = loaded
             plens = snap["pending_lens"]
             flat = snap["pending"]
@@ -579,7 +654,24 @@ def check_sharded(
             for ln in plens:
                 pending.append(flat[at : at + int(ln)])
                 at += int(ln)
-            if host_sets is not None:
+            if host_sets is not None and use_disk:
+                # per-shard tiered sets: restore IN PLACE from the
+                # checkpointed run manifests + hot dumps (the runs stay on
+                # disk; the checkpoint only references them)
+                src = (
+                    part_arrays[f"host{my_proc}"]
+                    if is_multiprocess()
+                    else snap
+                )
+                mans = json.loads(str(src["spill_manifest"]))
+                hot_flat, lens = src["host_hot"], src["host_hot_lens"]
+                at = 0
+                for d, ln in enumerate(lens):
+                    ln = int(ln)
+                    if host_sets[d] is not None:
+                        host_sets[d].restore(mans[d], hot_flat[at : at + ln])
+                    at += ln
+            elif host_sets is not None:
                 from ..native import FpSet
 
                 if is_multiprocess():
@@ -643,12 +735,68 @@ def check_sharded(
                     f"{checkpoint_dir} and restart"
                 )
 
+    if use_disk and not resumed:
+        # fresh out-of-core run: each owned shard claims its run
+        # directory and seeds its init fingerprints
+        for d in range(D):
+            if host_sets[d] is not None:
+                host_sets[d].start_fresh()
+                sel = np.nonzero(owner0 == d)[0]
+                if len(sel):
+                    host_sets[d].insert(_u64(hi0[sel], lo0[sel]))
+
     shard1 = NamedSharding(mesh, P("d"))
     dev_vhi = put_global(vhi, shard1)
     dev_vlo = put_global(vlo, shard1)
     dev_vn = put_global(vn, shard1)
 
+    def _advance_spill_gc():
+        # a new durable generation exists: advance each owned tiered
+        # set's deferred-deletion barrier (merged-away runs older than
+        # every retained generation get unlinked)
+        if use_disk:
+            for s in host_sets:
+                if s is not None:
+                    s.on_checkpoint_saved()
+
     def _save_checkpoint():
+        if host_sets is not None and use_disk:
+            # record run manifests + hot dumps — the runs ARE the durable
+            # state; the checkpoint references them
+            hots = [
+                s.hot_dump() if s is not None else np.empty(0, np.uint64)
+                for s in host_sets
+            ]
+            payload = {
+                "host_hot": np.concatenate(hots),
+                "host_hot_lens": np.asarray([len(x) for x in hots]),
+                "spill_manifest": json.dumps(
+                    [s.manifest() if s is not None else None for s in host_sets]
+                ),
+            }
+            if is_multiprocess():
+                ckpt_store.save(depth, payload, part=f"host{my_proc}")
+                extra = {}
+            else:
+                extra = payload
+            if not is_coordinator():
+                _advance_spill_gc()
+                return
+            ckpt_store.save(
+                depth,
+                dict(
+                    pending=np.concatenate(pending)
+                    if any(p.shape[0] for p in pending)
+                    else np.empty((0, K), np.uint32),
+                    pending_lens=np.asarray([p.shape[0] for p in pending]),
+                    vcap=vcap,
+                    levels=np.asarray(levels),
+                    total=total,
+                    **extra,
+                ),
+            )
+            _advance_spill_gc()
+            return
         if host_sets is not None:
             dumps = [
                 s.dump() if s is not None else np.empty(0, np.uint64)
@@ -1091,6 +1239,18 @@ def check_sharded(
                     break
 
     dt = time.perf_counter() - t0
+    spill_stats = (
+        {
+            "spill": [s.stats() if s is not None else None for s in host_sets],
+            "spill_dir": spill_base,
+        }
+        if use_disk
+        else {}
+    )
+    if ephemeral_spill is not None:
+        import shutil
+
+        shutil.rmtree(ephemeral_spill, ignore_errors=True)
     return CheckResult(
         model=model.name,
         levels=levels,
@@ -1123,5 +1283,6 @@ def check_sharded(
                 if visited_backend == "device-hash"
                 else {}
             ),
+            **spill_stats,
         },
     )

@@ -12,6 +12,14 @@ Grammar (comma-separated specs in `KSPEC_FAULT` or `--fault`):
     crash@ckpt:N              raise InjectedCrash mid-checkpoint-write at
                               level N (after the tmp write, BEFORE the
                               atomic promote — the torn-write rehearsal)
+    crash@merge:N             raise InjectedCrash mid-way through the Nth
+                              disk-run merge of this process (merged tmp
+                              written, BEFORE the atomic promote — the
+                              disk tier's torn-write rehearsal,
+                              storage/tiered.py).  Like crash@ckpt, meant
+                              for in-process tests: N counts merges per
+                              process, so a supervised restart that
+                              re-reaches the Nth merge would re-fire
     corrupt_ckpt              corrupt the newest checkpoint right after its
                               first write (checksum-fallback rehearsal)
     corrupt_ckpt@ckpt:N       same, after the write at level N
@@ -82,7 +90,7 @@ def _parse_token(tok: str) -> _Spec:
             # level (start_depth < N), so level 0 could never fire — reject
             # it instead of silently rehearsing nothing
             raise ValueError(f"fault {tok!r}: level must be >= 1")
-        if name == "crash" and point in ("level", "ckpt"):
+        if name == "crash" and point in ("level", "ckpt", "merge"):
             return _Spec("crash", point, level, 1)
         if name == "corrupt_ckpt" and point == "ckpt":
             return _Spec("corrupt_ckpt", "ckpt", level, 1)
@@ -99,7 +107,8 @@ def _parse_token(tok: str) -> _Spec:
         )
     raise ValueError(
         f"unknown fault {tok!r} (grammar: crash@level:N, crash@ckpt:N, "
-        f"corrupt_ckpt[@ckpt:N], compile_oom, transient_device_err:N)"
+        f"crash@merge:N, corrupt_ckpt[@ckpt:N], compile_oom, "
+        f"transient_device_err:N)"
     )
 
 
@@ -145,7 +154,9 @@ class FaultPlan:
         for s in self.specs:
             if s.kind != "crash" or s.point != point or s.budget <= 0:
                 continue
-            if self.start_depth >= s.arg:
+            # merge ordinals are per-process counters, not BFS levels:
+            # the resume-depth relief below does not apply
+            if point != "merge" and self.start_depth >= s.arg:
                 continue  # resumed at/past the target: counts as fired
             if point == "level":
                 if depth < s.arg:

@@ -1,0 +1,28 @@
+"""The one copy of the disk tier's crash-safety idiom.
+
+Every file this package publishes — runs, bloom sidecars, frontier
+segments, parent-log levels — goes through the same sequence: write to a
+`.tmp` sibling, flush + fsync, then atomically `os.replace` into the
+final name.  A crash at any point leaves either the old file or no file,
+never a torn one.  Centralized here so a future hardening (e.g. fsyncing
+the parent directory entry) lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def atomic_write(path: str, write_fn, before_replace=None) -> None:
+    """Write `path` crash-safely: `write_fn(fh)` fills the tmp file, then
+    it is fsync'd and atomically promoted.  `before_replace` (if given)
+    runs between the durable tmp write and the promote — the torn-write
+    fault-injection point (`KSPEC_FAULT=crash@merge:N`)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        write_fn(fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    if before_replace is not None:
+        before_replace()
+    os.replace(tmp, path)

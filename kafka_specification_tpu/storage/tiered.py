@@ -1,0 +1,296 @@
+"""TieredFpSet: host FpSet bounded by a byte budget, spilling to disk runs.
+
+The host tier is the existing native C++ open-addressing FpSet (the
+TLC-FPSet equivalent); this class bounds its residency at `mem_budget`
+bytes.  When the hot set outgrows the budget, its fingerprints are dumped,
+sorted, and written as one immutable on-disk run (storage/runs), and the
+hot set restarts empty.  Membership is: hot set first, then each run's
+bloom + interval gate, with a binary search over the run's mmap only on a
+probable hit.  Because a fingerprint is inserted exactly once ever (the
+novelty decision happens before any spill), runs are pairwise disjoint and
+the hot set never overlaps disk — so the tiered set's novelty masks are
+bit-identical to one unbounded FpSet's.
+
+When the run count passes `runs_per_merge`, all runs k-way-merge into one
+(fewer bloom probes per lookup, one searchsorted instead of k).  Merged
+inputs are not deleted until `gc_barrier` newer checkpoint generations
+have been saved (`on_checkpoint_saved`), so every retained generation's
+manifest still resolves on disk — the deletion barrier is what makes the
+disk tier itself the durable state the checkpoint merely *references*.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..native import FpSet
+from .runs import SortedRun, merge_runs, write_run
+
+# ~bytes of host residency per fingerprint: 8 B/slot at <=1/2 open-
+# addressing load, i.e. ~16 B per live entry
+_BYTES_PER_FP = 16
+
+
+class DeferredDeleter:
+    """Deletion barrier keyed to checkpoint saves.
+
+    `schedule(paths)` marks files obsolete; they are unlinked only after
+    `barrier` subsequent `on_save()` calls (checkpoint generations), so no
+    retained generation can reference a vanished file.  barrier=0 (not
+    checkpointing) deletes immediately.  State round-trips through the
+    checkpoint manifest so a resumed run keeps honoring in-flight barriers.
+    """
+
+    def __init__(self, barrier: int):
+        self.barrier = max(0, int(barrier))
+        self.pending: list = []  # [remaining_saves, path]
+
+    def schedule(self, paths) -> None:
+        if self.barrier == 0:
+            for p in paths:
+                _unlink_quiet(p)
+            return
+        self.pending.extend([self.barrier, p] for p in paths)
+
+    def on_save(self) -> None:
+        keep = []
+        for item in self.pending:
+            item[0] -= 1
+            if item[0] <= 0:
+                _unlink_quiet(item[1])
+            else:
+                keep.append(item)
+        self.pending = keep
+
+    def manifest(self, directory: str) -> list:
+        return [[n, os.path.relpath(p, directory)] for n, p in self.pending]
+
+    def restore(self, directory: str, entries) -> None:
+        # normpath: entries may point outside `directory` (the engine
+        # store routes frontier-segment deletions through the same
+        # barrier, serialized as "../frontier/..." relpaths) and sweep
+        # code compares dirnames textually
+        self.pending = [
+            [int(n), os.path.normpath(os.path.join(directory, p))]
+            for n, p in entries
+        ]
+
+
+def _unlink_quiet(path: str) -> None:
+    for p in (path, path + ".bloom"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+class TieredFpSet:
+    """Budget-bounded host FpSet + immutable sorted disk runs.
+
+    Drop-in for the engines' host backend (`insert(u64) -> novelty mask`,
+    `contains`, `len`); `native` is False so the engines take the
+    row-masking path rather than the fused C arena (the arena's win is
+    host-assembly time, irrelevant once the set itself is the bottleneck).
+    """
+
+    native = False
+
+    def __init__(
+        self,
+        directory: str,
+        mem_budget: int,
+        *,
+        runs_per_merge: int = 8,
+        gc_barrier: int = 0,
+        fault_plan=None,
+        verify_on_open: bool = True,
+    ):
+        # normalized: orphan sweeps and the deletion barrier compare paths
+        # textually, and DeferredDeleter.restore normpaths its entries —
+        # a dot-prefixed directory ("./ck/spill") must compare equal
+        self.dir = os.path.normpath(directory)
+        self.mem_budget = int(mem_budget)
+        self.runs_per_merge = max(2, int(runs_per_merge))
+        self.fault_plan = fault_plan
+        self.verify_on_open = verify_on_open
+        self.deleter = DeferredDeleter(gc_barrier)
+        self.hot = FpSet()
+        self.runs: list[SortedRun] = []
+        self.disk_n = 0
+        self.seq = 0  # next run file number (monotonic across merges)
+        self.spills = 0
+        self.merges = 0
+        os.makedirs(directory, exist_ok=True)
+
+    # --- lifecycle ------------------------------------------------------
+    def start_fresh(self) -> None:
+        """Wipe the directory (a fresh run owns its namespace — stale runs
+        from an abandoned search must not pre-seed the visited set)."""
+        for name in os.listdir(self.dir):
+            _unlink_quiet(os.path.join(self.dir, name))
+        self.hot = FpSet()
+        self.runs = []
+        self.disk_n = 0
+        self.seq = 0
+
+    def restore(self, manifest: dict, hot_fps) -> None:
+        """Restore this set IN PLACE from a checkpoint manifest: reopen
+        (and verify) exactly the referenced runs, re-seed the hot set from
+        the checkpointed dump, and sweep orphan files (tmp/run files from
+        the crashed post-checkpoint window — the deterministic re-run
+        regenerates them identically).  In-place so callers holding a
+        reference (the engine's `host_set`) see the restored state."""
+        directory = self.dir
+        self.mem_budget = int(manifest["mem_budget"])
+        self.seq = int(manifest["seq"])
+        self.spills = int(manifest.get("spills", 0))
+        self.merges = int(manifest.get("merges", 0))
+        self.runs = [
+            SortedRun(directory, m, verify=self.verify_on_open)
+            for m in manifest["runs"]
+        ]
+        self.disk_n = sum(r.count for r in self.runs)
+        self.deleter.restore(directory, manifest.get("pending_delete", ()))
+        keep = {os.path.join(directory, m["name"]) for m in manifest["runs"]}
+        keep |= {p for _, p in self.deleter.pending}
+        for name in os.listdir(directory):
+            p = os.path.join(directory, name)
+            if p not in keep and not p.endswith(".bloom"):
+                _unlink_quiet(p)
+            elif p.endswith(".bloom") and p[: -len(".bloom")] not in keep:
+                _unlink_quiet(p)
+        self.hot = FpSet()
+        if hot_fps is not None and len(hot_fps):
+            self.hot.insert(np.asarray(hot_fps, np.uint64))
+
+    @classmethod
+    def from_manifest(
+        cls,
+        directory: str,
+        manifest: dict,
+        hot_fps,
+        **kwargs,
+    ) -> "TieredFpSet":
+        s = cls(directory, manifest["mem_budget"], **kwargs)
+        s.restore(manifest, hot_fps)
+        return s
+
+    def manifest(self) -> dict:
+        return {
+            "mem_budget": self.mem_budget,
+            "seq": self.seq,
+            "spills": self.spills,
+            "merges": self.merges,
+            "runs": [r.meta for r in self.runs],
+            "pending_delete": self.deleter.manifest(self.dir),
+        }
+
+    def on_checkpoint_saved(self) -> None:
+        self.deleter.on_save()
+
+    # --- set interface --------------------------------------------------
+    def _disk_contains(self, fps: np.ndarray) -> np.ndarray:
+        out = np.zeros(fps.shape[0], bool)
+        rem = np.arange(fps.shape[0])
+        for r in self.runs:
+            if rem.size == 0:
+                break
+            hit = r.contains(fps[rem])
+            out[rem[hit]] = True
+            rem = rem[~hit]
+        return out
+
+    def insert(self, fps: np.ndarray) -> np.ndarray:
+        """Novelty mask, bit-identical to an unbounded FpSet (in-batch
+        duplicates report novel exactly once, at first occurrence)."""
+        fps = np.ascontiguousarray(fps, np.uint64)
+        novel = np.zeros(fps.shape[0], bool)
+        fresh = ~self._disk_contains(fps)
+        if fresh.any():
+            idx = np.nonzero(fresh)[0]
+            novel[idx] = self.hot.insert(fps[idx])
+            self._maybe_spill()
+        return novel
+
+    def contains(self, fps: np.ndarray) -> np.ndarray:
+        fps = np.ascontiguousarray(fps, np.uint64)
+        out = self.hot.contains(fps)
+        miss = ~out
+        if miss.any():
+            idx = np.nonzero(miss)[0]
+            out[idx] = self._disk_contains(fps[idx])
+        return out
+
+    def __len__(self) -> int:
+        return self.disk_n + len(self.hot)
+
+    def hot_dump(self) -> np.ndarray:
+        return self.hot.dump()
+
+    def dump(self) -> np.ndarray:
+        """Every fingerprint, hot + disk (tests / tiny sets only — the
+        whole point of this class is that this does not fit in RAM)."""
+        parts = [self.hot.dump()] + [np.asarray(r.arr) for r in self.runs]
+        return np.concatenate(parts) if parts else np.empty(0, np.uint64)
+
+    def stats(self) -> dict:
+        return {
+            "hot": len(self.hot),
+            "disk": self.disk_n,
+            "runs": len(self.runs),
+            "spills": self.spills,
+            "merges": self.merges,
+            "disk_bytes": 8 * self.disk_n,
+        }
+
+    # --- spill / merge --------------------------------------------------
+    def _hot_bytes(self) -> int:
+        return _BYTES_PER_FP * len(self.hot)
+
+    def _maybe_spill(self) -> None:
+        if self._hot_bytes() > self.mem_budget:
+            self.spill()
+
+    def _run_path(self) -> str:
+        path = os.path.join(self.dir, f"run-{self.seq:06d}.fps")
+        self.seq += 1
+        return path
+
+    def spill(self) -> None:
+        """Dump + sort the hot set into a new immutable run; restart the
+        hot set empty.  Triggers a k-way merge past `runs_per_merge`."""
+        fps = np.sort(self.hot.dump())
+        if fps.shape[0] == 0:
+            return
+        path = self._run_path()
+        meta = write_run(path, fps, bloom_path=path + ".bloom")
+        self.runs.append(SortedRun(self.dir, meta, verify=False))
+        self.disk_n += fps.shape[0]
+        self.spills += 1
+        self.hot = FpSet()
+        if len(self.runs) > self.runs_per_merge:
+            self.merge()
+
+    def merge(self) -> None:
+        """K-way merge every run into one.  Crash-safe: the merged output
+        is tmp-written then atomically promoted; the inputs stay on disk
+        behind the checkpoint-generation deletion barrier, so a crash at
+        ANY point (including the injected `crash@merge:N`) leaves a state
+        some retained checkpoint manifest fully resolves."""
+        if len(self.runs) < 2:
+            return
+        self.merges += 1
+        path = self._run_path()
+        hook = None
+        if self.fault_plan is not None:
+            ordinal = self.merges
+
+            def hook():
+                self.fault_plan.crash("merge", ordinal)
+
+        meta = merge_runs(self.runs, path, crash_hook=hook)
+        old = [r.path for r in self.runs]
+        self.runs = [SortedRun(self.dir, meta, verify=False)]
+        self.deleter.schedule(old)

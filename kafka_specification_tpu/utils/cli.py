@@ -304,6 +304,28 @@ def main(argv=None):
         "C++ FpSet (spill mode for huge state spaces)",
     )
     pc.add_argument(
+        "--mem-budget",
+        metavar="BYTES",
+        help="host fingerprint-set byte budget before spilling to the "
+        "disk tier (suffixes K/M/G, e.g. 4G).  Setting this activates "
+        "--store=auto's disk tier: sorted bloom-gated runs + spilled "
+        "frontier + on-disk parent log under --spill-dir (docs/storage.md)",
+    )
+    pc.add_argument(
+        "--spill-dir",
+        metavar="DIR",
+        help="directory for the disk tier's runs/frontier/parent log "
+        "(default: <--checkpoint>/spill, else a temp dir)",
+    )
+    pc.add_argument(
+        "--store",
+        choices=["auto", "ram", "disk"],
+        default="auto",
+        help="state-storage tier: 'ram' = in-memory only, 'disk' = tiered "
+        "out-of-core store (implies the host fingerprint backend), 'auto' "
+        "= disk exactly when --mem-budget is set (default)",
+    )
+    pc.add_argument(
         "--profile",
         metavar="DIR",
         help="wrap the run in a jax.profiler trace (TensorBoard format)",
@@ -393,6 +415,15 @@ def main(argv=None):
             file=sys.stderr,
         )
         return 2
+
+    if args.cmd == "check" and args.mem_budget is not None:
+        from ..storage import parse_mem_budget
+
+        try:
+            args.mem_budget = parse_mem_budget(args.mem_budget)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     if args.cmd == "check" and args.fault:
         from ..resilience.faults import FaultPlan
@@ -615,6 +646,11 @@ def _build_or_fail(module, tlc_cfg, oracle=False, emitted=False, reference=None)
 
 
 def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
+    store_kw = dict(
+        mem_budget=args.mem_budget,
+        spill_dir=args.spill_dir,
+        store=args.store,
+    )
     if args.sharded:
         from ..parallel.sharded import check_sharded
 
@@ -631,6 +667,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
             checkpoint_keep=args.checkpoint_keep,
             stats_path=args.stats,
             visited_backend=args.visited_backend,
+            **store_kw,
             **chunk_kw,
         )
     else:
@@ -649,6 +686,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
             check_deadlock=tlc_cfg.check_deadlock,
             stats_path=args.stats,
             visited_backend=args.visited_backend,
+            **store_kw,
             **chunk_kw,
         )
     return res

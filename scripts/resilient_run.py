@@ -77,6 +77,17 @@ def main(argv=None):
         "checkpoint in $KSPEC_PROD_CKPT)",
     )
     ap.add_argument(
+        "--mem-budget",
+        help="[--preset] host fingerprint-set byte budget (K/M/G "
+        "suffixes): re-run the preset out-of-core through the disk tier "
+        "(exported as KSPEC_PROD_MEMBUDGET to the child)",
+    )
+    ap.add_argument(
+        "--spill-dir",
+        help="[--preset] disk-tier directory for the preset child "
+        "(exported as KSPEC_PROD_SPILL)",
+    )
+    ap.add_argument(
         "cmd",
         nargs=argparse.REMAINDER,
         metavar="-- CMD ...",
@@ -103,6 +114,13 @@ def main(argv=None):
             or os.path.join(_REPO, "RUNPROD464_stats.jsonl")
         )
         env["KSPEC_PROD_STATS"] = heartbeat
+        if args.mem_budget:
+            # out-of-core re-run: the child's engine spills past the
+            # budget into the disk tier (restarts resume from the
+            # checkpointed run manifest — docs/storage.md)
+            env["KSPEC_PROD_MEMBUDGET"] = args.mem_budget
+        if args.spill_dir:
+            env["KSPEC_PROD_SPILL"] = args.spill_dir
         cmd = [
             sys.executable,
             os.path.join(_REPO, "scripts", "run_product_tiny3.py"),

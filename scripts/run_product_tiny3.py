@@ -42,6 +42,20 @@ def main():
     ap.add_argument("--partitions", type=int, default=3)
     ap.add_argument("--chunk-size", type=int, default=131072)
     ap.add_argument(
+        "--mem-budget",
+        default=os.environ.get("KSPEC_PROD_MEMBUDGET"),
+        help="host fingerprint-set byte budget (K/M/G suffixes) before "
+        "spilling to the disk tier — lets the prod464 preset (and the "
+        "next decade) run out-of-core (docs/storage.md); also settable "
+        "via KSPEC_PROD_MEMBUDGET for the supervisor preset",
+    )
+    ap.add_argument(
+        "--spill-dir",
+        default=os.environ.get("KSPEC_PROD_SPILL"),
+        help="disk-tier directory (default: <checkpoint>/spill); also "
+        "settable via KSPEC_PROD_SPILL",
+    )
+    ap.add_argument(
         "--base",
         choices=["tiny", "2r", "mixed", "mixed107", "mixed464"],
         default="tiny",
@@ -126,6 +140,8 @@ def main():
         visited_backend="host",
         chunk_size=args.chunk_size,
         min_bucket=4096,
+        mem_budget=args.mem_budget or None,
+        spill_dir=args.spill_dir or None,
         checkpoint_dir=os.environ.get("KSPEC_PROD_CKPT") or None,
         checkpoint_every=2,
         # per-level heartbeat stream for the supervisor's stall detector

@@ -87,13 +87,31 @@ def main():
     }
     print(f"# platform: {record['platform']}", flush=True)
 
-    def _bank():
+    def _bank(rung_name=None):
         # persist after EVERY rung (tpu_window.py's per-stage banking
         # pattern): the libtpu AOT helper failure this ladder probes can
         # hard-kill the parent, and a window is too rare to lose the
-        # rungs that already ran (round-5 advisor item)
+        # rungs that already ran (round-5 advisor item).  Two forms: the
+        # cumulative JSON (the banked artifact) AND an append-only JSONL
+        # line per rung — a hard kill mid-rewrite can tear the JSON, but
+        # never the already-appended lines
         with open(os.path.join(_REPO, "TPU_MOSAIC_LADDER.json"), "w") as f:
             json.dump(record, f, indent=1)
+        if rung_name is not None:
+            with open(
+                os.path.join(_REPO, "TPU_MOSAIC_LADDER.jsonl"), "a"
+            ) as f:
+                f.write(
+                    json.dumps(
+                        {
+                            "ts": time.time(),
+                            "platform": record["platform"],
+                            "rung": rung_name,
+                            **record["rungs"][rung_name],
+                        }
+                    )
+                    + "\n"
+                )
 
     x = jnp.arange(256, dtype=jnp.uint32)
     for name, k in rungs:
@@ -112,7 +130,7 @@ def main():
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
             }
         print(f"# {name}: {record['rungs'][name]}", flush=True)
-        _bank()
+        _bank(name)
     ok = all(r["ok"] for r in record["rungs"].values())
     return 0 if ok else 3
 

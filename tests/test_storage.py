@@ -1,0 +1,399 @@
+"""Out-of-core storage tier (kafka_specification_tpu/storage).
+
+The acceptance bar (ISSUE 2): forced-spill runs at a tiny --mem-budget
+must be bit-identical to the in-RAM path on both engines (same per-level
+counts, same violation depth, same trace values); a crash mid-merge must
+resume to the exact result; and a kill->resume with the disk tier active
+must reproduce exact counts AND report a full (non-empty) counterexample
+trace after the resume — retiring PR 1's empty-trace limitation.
+
+Trace identity is pinned against the in-RAM HOST path: the disk tier
+spills the host level of the hierarchy, and parent choice among multiple
+valid parents is a per-backend property (test_determinism pins per-run
+reproducibility, not cross-backend trace equality).
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import finite_replicated_log as frl
+from kafka_specification_tpu.models import kip320, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.parallel.sharded import check_sharded
+from kafka_specification_tpu.resilience import FaultPlan, InjectedCrash
+from kafka_specification_tpu.storage import (
+    BloomFilter,
+    FrontierReader,
+    FrontierWriter,
+    ParentLog,
+    TieredFpSet,
+    parse_mem_budget,
+    resolve_store,
+)
+from kafka_specification_tpu.storage.frontier import SegmentCorrupt
+from kafka_specification_tpu.storage.parent_log import ParentLogCorrupt
+
+pytestmark = pytest.mark.spill
+
+TINY = Config(2, 2, 1, 1)
+
+
+@pytest.fixture(autouse=True)
+def _tiny_spill_shapes(monkeypatch):
+    """Force segment cuts and merges at toy state counts so every disk
+    code path (multi-segment levels, k-way merge) runs in tier-1."""
+    monkeypatch.setenv("KSPEC_SPILL_SEG_ROWS", "13")
+    monkeypatch.setenv("KSPEC_SPILL_RUNS_PER_MERGE", "2")
+
+
+def _verdict(res):
+    return (
+        res.total,
+        res.diameter,
+        tuple(res.levels),
+        res.ok,
+        (res.violation.invariant, res.violation.depth) if res.violation else None,
+    )
+
+
+# --- unit: tiered fingerprint set ----------------------------------------
+
+
+def test_tiered_fpset_novelty_matches_python_set(tmp_path):
+    """Random batches with in-batch and cross-batch duplicates: novelty
+    masks bit-identical to a plain set, across spills and merges."""
+    s = TieredFpSet(str(tmp_path / "fps"), mem_budget=256, runs_per_merge=2)
+    ref = set()
+    rng = np.random.default_rng(7)
+    for _ in range(30):
+        batch = rng.integers(0, 500, size=rng.integers(1, 60), dtype=np.uint64)
+        got = s.insert(batch)
+        want = np.zeros(batch.shape[0], bool)
+        for i, fp in enumerate(batch.tolist()):
+            if fp not in ref:
+                ref.add(fp)
+                want[i] = True
+        np.testing.assert_array_equal(got, want)
+    assert len(s) == len(ref)
+    assert s.stats()["spills"] > 2 and s.stats()["merges"] >= 1
+    # contains() agrees on members and non-members alike
+    probe = np.arange(600, dtype=np.uint64)
+    np.testing.assert_array_equal(
+        s.contains(probe), np.array([int(p) in ref for p in probe])
+    )
+    assert set(s.dump().tolist()) == ref
+
+
+def test_tiered_fpset_manifest_roundtrip(tmp_path):
+    s = TieredFpSet(str(tmp_path / "fps"), mem_budget=200, runs_per_merge=3)
+    fps = np.arange(100, dtype=np.uint64) * 977
+    s.insert(fps)
+    man = s.manifest()
+    hot = s.hot_dump()
+    # JSON round-trip (the manifest rides inside the checkpoint npz)
+    man = json.loads(json.dumps(man))
+    s2 = TieredFpSet.from_manifest(str(tmp_path / "fps"), man, hot)
+    assert len(s2) == len(s)
+    assert not s2.insert(fps).any()  # everything already present
+    assert s2.insert(np.array([10**12], np.uint64)).all()
+
+
+def test_bloom_no_false_negatives_and_sidecar_rebuild(tmp_path):
+    fps = np.random.default_rng(3).integers(0, 2**63, 5000, dtype=np.uint64)
+    bf = BloomFilter.build(fps)
+    assert bf.maybe(fps).all()  # false negatives are forbidden
+    p = str(tmp_path / "x.bloom")
+    bf.save(p)
+    assert BloomFilter.load(p).maybe(fps).all()
+    # corrupt sidecar -> load refuses (caller rebuilds from the run)
+    with open(p, "r+b") as fh:
+        fh.seek(64)
+        fh.write(b"\xff" * 32)
+    assert BloomFilter.load(p) is None
+
+
+# --- unit: frontier segments + parent log --------------------------------
+
+
+def test_frontier_roundtrip_and_chunk_boundaries(tmp_path):
+    w = FrontierWriter(str(tmp_path), level=3, lanes=2, seg_rows=7)
+    rows = np.arange(50, dtype=np.uint32).reshape(25, 2)
+    for i in range(0, 25, 4):
+        w.append(rows[i : i + 4])
+    r = w.finalize()
+    assert r.rows == 25 and len(r.man["segments"]) == 4
+    np.testing.assert_array_equal(r.read_all(), rows)
+    # chunk iteration crosses segment boundaries exactly like an ndarray
+    got = list(r.iter_chunks(6))
+    assert [s for s, _ in got] == [0, 6, 12, 18, 24]
+    np.testing.assert_array_equal(np.concatenate([c for _, c in got]), rows)
+    np.testing.assert_array_equal(r.row(13), rows[13])
+    # manifest round-trips through JSON and re-verifies CRCs
+    r2 = FrontierReader(str(tmp_path), json.loads(json.dumps(r.man)))
+    np.testing.assert_array_equal(r2.slice(5, 20), rows[5:20])
+
+
+def test_frontier_corruption_detected(tmp_path):
+    w = FrontierWriter(str(tmp_path), level=0, lanes=1, seg_rows=8)
+    w.append(np.arange(16, dtype=np.uint32).reshape(16, 1))
+    r = w.finalize()
+    seg = os.path.join(str(tmp_path), r.man["segments"][0]["name"])
+    with open(seg, "r+b") as fh:
+        fh.seek(20)
+        fh.write(b"\xee\xee")
+    with pytest.raises(SegmentCorrupt):
+        FrontierReader(str(tmp_path), r.man, verify=True)
+
+
+def test_parent_log_roundtrip_and_crc(tmp_path):
+    log = ParentLog(str(tmp_path), lanes=2)
+    log.write_level(
+        0, np.zeros((1, 2), np.uint32), np.full(1, -1, np.int64), np.full(1, -1)
+    )
+    log.begin_level(1)
+    log.append(
+        np.ones((3, 2), np.uint32), np.zeros(3, np.int64), np.arange(3, dtype=np.int32)
+    )
+    log.end_level()
+    assert log.has_levels(1) and not log.has_levels(2)
+    rows, parent, act = log.view()[1]
+    assert rows.shape == (3, 2) and parent.tolist() == [0, 0, 0]
+    assert act.tolist() == [0, 1, 2]
+    with open(os.path.join(str(tmp_path), "level-00001.plog"), "r+b") as fh:
+        fh.seek(300)
+        fh.write(b"\xaa\xaa")
+    with pytest.raises(ParentLogCorrupt):
+        log.view()[1]
+
+
+def test_parse_mem_budget_and_resolve_store():
+    assert parse_mem_budget("512M") == 512 << 20
+    assert parse_mem_budget("4G") == 4 << 30
+    assert parse_mem_budget("1.5K") == 1536
+    assert parse_mem_budget(65536) == 65536
+    for bad in ("zero", "-1G", "0"):
+        with pytest.raises(ValueError):
+            parse_mem_budget(bad)
+    assert resolve_store("disk", None) and not resolve_store("ram", "1G")
+    assert resolve_store("auto", "1G") and not resolve_store("auto", None)
+    with pytest.raises(ValueError):
+        resolve_store("floppy", None)
+
+
+def test_fault_grammar_crash_at_merge():
+    p = FaultPlan("crash@merge:2")
+    p.crash("merge", 1)  # first merge: no fire
+    with pytest.raises(InjectedCrash):
+        p.crash("merge", 2)
+    p.crash("merge", 2)  # budget consumed
+
+
+# --- engine: forced-spill bit-identity -----------------------------------
+
+
+def test_forced_spill_bit_identical_flagship_single_device():
+    """Kip320 flagship config at a tiny budget: per-level counts and the
+    exhaustive verdict identical to the in-RAM host path (acceptance)."""
+    def mk():
+        return kip320.make_model(TINY, ("TypeOk", "LeaderInIsr", "WeakIsr", "StrongIsr"))
+
+    golden = check(mk(), min_bucket=32, visited_backend="host")
+    assert golden.ok and golden.total == 277
+    with tempfile.TemporaryDirectory() as sd:
+        res = check(mk(), min_bucket=32, mem_budget=300, spill_dir=sd)
+        assert _verdict(res) == _verdict(golden)
+        assert res.stats["spill"]["spills"] > 0  # the budget actually bit
+        assert res.stats["spill"]["disk"] + res.stats["spill"]["hot"] == 277
+
+
+def test_forced_spill_bit_identical_violating_variant_with_trace():
+    """TruncateToHW violates WeakIsr @ 8: the disk-tier trace (parent log)
+    must equal the in-RAM host path's trace VALUE for VALUE (acceptance:
+    'same violation depth, same trace values')."""
+    def mk():
+        return variants.make_model(
+            "KafkaTruncateToHighWatermark", TINY, ("TypeOk", "WeakIsr")
+        )
+
+    golden = check(mk(), min_bucket=32, visited_backend="host")
+    assert golden.violation is not None and golden.violation.depth == 8
+    with tempfile.TemporaryDirectory() as sd:
+        res = check(mk(), min_bucket=32, mem_budget=300, spill_dir=sd)
+        assert _verdict(res) == _verdict(golden)
+        assert res.violation.trace == golden.violation.trace
+        assert len(res.violation.trace) == 9
+        assert res.violation.trace[0][0] == "<init>"
+
+
+def test_forced_spill_bit_identical_sharded():
+    """Sharded twin: per-shard disk runs at a tiny budget, exact counts
+    (fingerprint-range ownership unchanged)."""
+    def mk():
+        return kip320.make_model(TINY, ("TypeOk",))
+
+    golden = check_sharded(mk(), min_bucket=32, visited_backend="host",
+                           store_trace=False)
+    assert golden.ok and golden.total == 277
+    with tempfile.TemporaryDirectory() as sd:
+        res = check_sharded(
+            mk(), min_bucket=32, mem_budget=2048, spill_dir=sd,
+            store_trace=False,
+        )
+        assert _verdict(res) == _verdict(golden)
+        spilled = [s for s in res.stats["spill"] if s]
+        assert sum(x["spills"] for x in spilled) > 0
+        assert sum(x["disk"] + x["hot"] for x in spilled) == 277
+
+
+@pytest.mark.slow  # ~30s: 5,973-state THEOREM run through forced spills
+def test_forced_spill_kip320_small_exhaustive():
+    """The full SMALL Kip320 exhaustive pass (all four THEOREM invariants,
+    oracle-pinned 5,973 states / diameter 17) through dozens of spills and
+    repeated k-way merges."""
+    SMALL = Config(2, 2, 2, 2)
+    with tempfile.TemporaryDirectory() as sd:
+        res = check(
+            kip320.make_model(SMALL),
+            min_bucket=32,
+            mem_budget="4K",
+            spill_dir=sd,
+        )
+        assert res.ok and res.total == 5973 and res.diameter == 17
+        assert res.stats["spill"]["spills"] >= 10
+        assert res.stats["spill"]["merges"] >= 2
+        assert res.stats["spill"]["disk"] + res.stats["spill"]["hot"] == 5973
+
+
+def test_forced_spill_sharded_violating_variant_trace():
+    """Sharded + disk tier on the violating variant: same verdict AND the
+    same trace values as the sharded in-RAM host path (the disk tier only
+    changes where fingerprints live, never novelty decisions)."""
+    def mk():
+        return variants.make_model(
+            "KafkaTruncateToHighWatermark", TINY, ("TypeOk", "WeakIsr")
+        )
+
+    golden = check_sharded(mk(), min_bucket=32, visited_backend="host")
+    assert golden.violation is not None and golden.violation.depth == 8
+    with tempfile.TemporaryDirectory() as sd:
+        res = check_sharded(mk(), min_bucket=32, mem_budget=2048, spill_dir=sd)
+        assert _verdict(res) == _verdict(golden)
+        assert res.violation.trace == golden.violation.trace
+
+
+def test_store_disk_without_budget_uses_default(tmp_path):
+    """--store=disk alone activates the tier (default budget, no spill at
+    toy scale) and still lands exact counts through the disk frontier +
+    parent log."""
+    res = check(
+        frl.make_model(2, 2, 2),
+        min_bucket=32,
+        store="disk",
+        spill_dir=str(tmp_path),
+    )
+    assert res.ok and res.total == 49
+    assert res.stats["spill"]["spills"] == 0  # 49 fps under the default 4G
+
+
+# --- crash / resume (fault marker shared with the resilience suite) ------
+
+
+@pytest.mark.fault
+def test_merge_crash_resumes_bit_identical(tmp_path, monkeypatch):
+    """KSPEC_FAULT=crash@merge:1 dies after the merged tmp write, before
+    the atomic promote; the resume must land the exact in-RAM verdict and
+    trace (the inputs stayed on disk behind the deletion barrier)."""
+    def mk():
+        return variants.make_model(
+            "KafkaTruncateToHighWatermark", TINY, ("TypeOk", "WeakIsr")
+        )
+
+    golden = check(mk(), min_bucket=32, visited_backend="host")
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", "crash@merge:1")
+    with pytest.raises(InjectedCrash):
+        check(mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check(mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    assert _verdict(resumed) == _verdict(golden)
+    assert resumed.violation.trace == golden.violation.trace
+
+
+@pytest.mark.fault
+def test_resume_then_violation_reports_full_trace(tmp_path, monkeypatch):
+    """THE retirement test for PR 1's known limitation: with the disk tier
+    active, a kill->resume run that then finds a violation reports the
+    full (non-empty) counterexample trace from the on-disk parent log —
+    identical to an uninterrupted run's."""
+    def mk():
+        return variants.make_model(
+            "KafkaTruncateToHighWatermark", TINY, ("TypeOk", "WeakIsr")
+        )
+
+    golden = check(mk(), min_bucket=32, visited_backend="host")
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", "crash@level:4")
+    with pytest.raises(InjectedCrash):
+        check(mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check(mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    assert _verdict(resumed) == _verdict(golden)
+    assert resumed.violation.trace, "post-resume trace must be non-empty"
+    assert resumed.violation.trace == golden.violation.trace
+    assert resumed.violation.trace[0][0] == "<init>"
+
+
+@pytest.mark.fault
+def test_dot_prefixed_spill_dir_resume_honors_deletion_barrier(
+    tmp_path, monkeypatch
+):
+    """Regression (review finding): a dot-prefixed --checkpoint path must
+    not defeat the textual path comparisons in the resume orphan sweep —
+    barrier-protected runs/segments stayed deletable only because the
+    base dir is normalized at construction.  Double crash/resume through
+    a './'-relative checkpoint dir, merges forced throughout."""
+    monkeypatch.chdir(tmp_path)
+
+    def mk():
+        return variants.make_model(
+            "KafkaTruncateToHighWatermark", TINY, ("TypeOk", "WeakIsr")
+        )
+
+    golden = check(mk(), min_bucket=32, visited_backend="host")
+    ck = os.path.join(".", "ck")  # deliberately non-normalized
+    monkeypatch.setenv("KSPEC_FAULT", "crash@level:3")
+    with pytest.raises(InjectedCrash):
+        check(mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    monkeypatch.setenv("KSPEC_FAULT", "crash@level:6")
+    with pytest.raises(InjectedCrash):
+        check(mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check(mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    assert _verdict(resumed) == _verdict(golden)
+    assert resumed.violation.trace == golden.violation.trace
+
+
+@pytest.mark.fault
+def test_sharded_disk_crash_resume_exact(tmp_path, monkeypatch):
+    ck = str(tmp_path / "sck")
+    golden = check_sharded(
+        frl.make_model(2, 2, 2), min_bucket=32, store_trace=False,
+        visited_backend="host",
+    )
+    monkeypatch.setenv("KSPEC_FAULT", "crash@level:2")
+    with pytest.raises(InjectedCrash):
+        check_sharded(
+            frl.make_model(2, 2, 2), min_bucket=32, mem_budget=512,
+            checkpoint_dir=ck,
+        )
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check_sharded(
+        frl.make_model(2, 2, 2), min_bucket=32, mem_budget=512,
+        checkpoint_dir=ck,
+    )
+    assert _verdict(resumed) == _verdict(golden)
