@@ -132,6 +132,35 @@ def _child_main():
     )
 
 
+# Backend noise the child's stderr can carry into the banked BENCH tail:
+# XLA:CPU's "Compile machine features ... vs host machine features ... This
+# could lead to execution errors such as SIGILL" advisory (one huge line,
+# BENCH_r05.json), absl/TF-style log-prefix lines, and the pre-absl-init
+# warning.  Filtered before re-emission so the tail the bench driver banks
+# holds only the benchmark lines (the '# ...' side-notes and the JSON).
+_NOISE_MARKERS = (
+    "machine features:",
+    "execution errors such as SIGILL",
+    "WARNING: All log messages before absl::InitializeLog",
+    "TF-TRT Warning",
+)
+_NOISE_PREFIXES = ("E0000", "W0000", "I0000", "F0000")
+
+
+def _filter_backend_noise(text: str) -> str:
+    """Drop known backend-noise lines from child stderr; keep everything
+    else (benchmark side-notes, tracebacks, real warnings)."""
+    kept = []
+    for line in text.splitlines():
+        s = line.strip()
+        if any(m in s for m in _NOISE_MARKERS):
+            continue
+        if s.split(" ", 1)[0][:5] in _NOISE_PREFIXES:
+            continue
+        kept.append(line)
+    return "\n".join(kept) + ("\n" if kept else "")
+
+
 def _run_child(platform: str, timeout: int):
     """Run this script as a child pinned to `platform`; returns (ok, stdout)."""
     if platform == "cpu":
@@ -158,15 +187,15 @@ def _run_child(platform: str, timeout: int):
             err = err.decode()
         print(
             f"# {platform} attempt timed out after {timeout}s; "
-            f"stderr tail: {err[-300:]}",
+            f"stderr tail: {_filter_backend_noise(err)[-300:]}",
             file=sys.stderr,
         )
         return False, ""
-    sys.stderr.write(p.stderr)
+    sys.stderr.write(_filter_backend_noise(p.stderr))
     if p.returncode != 0:
         print(
             f"# {platform} attempt failed (rc={p.returncode}); "
-            f"stderr tail: {p.stderr[-300:]}",
+            f"stderr tail: {_filter_backend_noise(p.stderr)[-300:]}",
             file=sys.stderr,
         )
         return False, ""
@@ -201,7 +230,7 @@ def _probe_default() -> bool:
         # a broken probe doesn't silently demote the headline to CPU
         print(
             f"# default-platform probe crashed (rc={p.returncode}); "
-            f"stderr tail: {(p.stderr or '')[-300:]}",
+            f"stderr tail: {_filter_backend_noise(p.stderr or '')[-300:]}",
             file=sys.stderr,
         )
     return False

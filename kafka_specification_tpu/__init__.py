@@ -28,6 +28,8 @@ Layout:
     storage/   out-of-core tier: bloom-gated fingerprint runs on disk,
                spilled frontier segments, on-disk parent log (--mem-budget)
     resilience/ fault injection, hardened checkpoints, retry, supervisor
+    obs/       unified telemetry: run directories + manifests, span
+               tracer, metrics registry, `cli report` renderer
     utils/     TLC-compatible .cfg parsing, TLA+ front-end, CLI
 """
 

@@ -37,11 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.base import Model
+from ..obs.observer import RunObserver
 from ..ops import dedup, hashset
 from ..ops.fingerprint import fingerprint_lanes
 from ..resilience.checkpoints import CheckpointStore
 from ..resilience.faults import FaultPlan
-from ..resilience.heartbeat import append_jsonl, heartbeat_record
 from ..resilience.retry import ChunkRetryHandler
 
 # insert-or-find on the device hash table; table + claim lattice donated so
@@ -778,6 +778,7 @@ def check(
     mem_budget=None,
     spill_dir: Optional[str] = None,
     store: str = "auto",
+    run=None,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -864,10 +865,20 @@ def check(
     Checkpoints record the storage manifest (run names + frontier segment
     offsets) instead of re-serializing state — the disk tier itself is the
     durable state.
+
+    run: an obs.RunContext — correlates this run's stats/spans/metrics
+    under one run_id in the run directory (docs/observability.md).  With
+    run=None and a bare stats_path the per-level stream is emitted exactly
+    as before the obs subsystem existed (the shim contract,
+    tests/test_obs.py).
     """
     spec = model.spec
     step_builder = _Step(model)
     K, C = spec.num_lanes, step_builder.C
+
+    # unified telemetry: run_id-stamped stats/spans/metrics when a run
+    # context is given; the exact historical stats_path stream otherwise
+    obs_ = RunObserver(run, stats_path, engine="bfs")
 
     from ..storage import resolve_store
 
@@ -1049,15 +1060,27 @@ def check(
                     trace=[("<init>", decode_state(init_packed[idx]))],
                 )
                 _drop_ephemeral_spill()
-                return CheckResult(
+                res = CheckResult(
                     model.name, levels, total, 0, viol, dt, total / max(dt, 1e-9)
                 )
+                obs_.finish(res)
+                obs_.close()
+                return res
 
     frontier_np = init_packed
     depth = 0
     violation = None
     result_stats: dict = {}
-    collect_stats = stats_path is not None
+    collect_stats = obs_.collect
+    obs_.config(
+        model=model.name,
+        visited_backend=visited_backend,
+        store="disk" if use_disk else "ram",
+        mem_budget=mem_budget,
+        chunk_size=chunk_size,
+        checkpoint_dir=checkpoint_dir,
+        platform=jax.default_backend(),
+    )
 
     # identity stamp: a checkpoint may only resume the same model, constants,
     # invariant selection, and deadlock setting (a resume never re-checks
@@ -1198,6 +1221,9 @@ def check(
             break
         f_total = _f_rows(frontier_np)
         t_level = time.perf_counter()
+        # begin marker (ph=B): a crash mid-level leaves it unmatched, which
+        # is exactly what `cli report` uses to pin where the run died
+        obs_.level_begin(depth + 1, f_total)
         # A frontier larger than `chunk` is streamed through the same
         # compiled step in chunk_size pieces: cross-chunk duplicates are
         # caught because each chunk probes the visited set updated by the
@@ -1364,7 +1390,12 @@ def check(
                 verdict = ("deadlock", start + int(dl_idx), "Deadlock")
                 break
             nn = int(new_n)
-            prof_step += time.perf_counter() - t_attempt
+            step_s = time.perf_counter() - t_attempt
+            prof_step += step_s
+            obs_.chunk_span(
+                "step", step_s, depth=depth, start=start, rows=fp_n,
+                bucket=bucket,
+            )
             t_host = time.perf_counter()
             if host_set is not None and nn:
                 if use_arena:
@@ -1515,7 +1546,12 @@ def check(
                 lvl_parent.append(np.asarray(out_parent[:nn]) + start)
                 lvl_act.append(np.asarray(out_act[:nn]))
                 lvl_new += nn
-            prof_host_s += time.perf_counter() - t_host
+            host_s = time.perf_counter() - t_host
+            prof_host_s += host_s
+            obs_.chunk_span(
+                "host-assembly", host_s, depth=depth, start=start, new=nn,
+                backend=visited_backend,
+            )
             if collect_stats:
                 lvl_act_en += act_en_np
 
@@ -1574,9 +1610,11 @@ def check(
         if collect_stats:
             enabled_total = int(lvl_act_en.sum())
             # heartbeat-enveloped (kind/ts/unix): the per-level stats
-            # stream doubles as the supervisor's liveness signal
-            rec = heartbeat_record(
-                "level",
+            # stream doubles as the supervisor's liveness signal.  The obs
+            # shim emits the historical record shape (and, with a run
+            # context, additionally stamps run_id, closes the level span,
+            # and folds the metrics registry + Prometheus export)
+            rec = obs_.level(
                 depth=depth,
                 frontier=f_total,
                 enabled_candidates=enabled_total,
@@ -1591,8 +1629,6 @@ def check(
                 },
             )
             result_stats.setdefault("levels", []).append(rec)
-            if stats_path is not None:
-                append_jsonl(stats_path, rec)
         if collect_levels is not None and new_n:
             collect_levels.append(_f_all(next_frontier))
         if store_trace:
@@ -1648,7 +1684,7 @@ def check(
         result_stats["hash_table_capacity"] = int(ht_hi.shape[0])
         result_stats["hash_table_size"] = hash_n
     _drop_ephemeral_spill()
-    return CheckResult(
+    res = CheckResult(
         model=model.name,
         levels=levels,
         total=total,
@@ -1658,3 +1694,6 @@ def check(
         states_per_sec=total / max(dt, 1e-9),
         stats=result_stats,
     )
+    obs_.finish(res)
+    obs_.close()
+    return res

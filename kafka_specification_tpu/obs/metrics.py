@@ -1,0 +1,174 @@
+"""Metrics registry: counters / gauges / histograms, JSONL + Prometheus.
+
+One registry per run.  The engines update it through ``RunObserver``
+(obs/observer.py); deep call sites (checkpoint writes, spill merges,
+transient retries) bump counters through the module-level :func:`inc` /
+:func:`set_gauge` helpers, which no-op unless a run is active — mirroring
+the tracer's global-current pattern so storage/resilience need no
+plumbing.
+
+Exports, refreshed on every snapshot call (the engines snapshot per BFS
+level, so a multi-day run's scrape is at most one level stale):
+
+- ``metrics.jsonl`` — append-only heartbeat-enveloped snapshots (history;
+  the report renderer reads the last one even from a crashed run).
+- ``metrics.prom``  — the Prometheus *textfile-collector* format, written
+  atomically (tmp + rename) so node_exporter's textfile collector (or any
+  scraper that re-reads the file) never sees a torn export.  Every sample
+  carries a ``run_id`` label; extra labels (e.g. ``shard``) ride alongside.
+
+Metric names use the ``kspec_`` prefix and Prometheus conventions
+(``*_total`` for counters).  docs/observability.md lists them all.
+
+Must stay jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..resilience.heartbeat import heartbeat_record
+
+# histogram default buckets: per-level wall times span 4ms toy levels to
+# multi-minute deep-product levels (RUNPROD464_r5.log)
+DEFAULT_MS_BUCKETS = (10, 50, 100, 500, 1000, 5000, 30_000, 120_000, 600_000)
+
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    def __init__(self, run_id: str = ""):
+        self.run_id = run_id
+        self.counters: dict = {}
+        self.gauges: dict = {}
+        self.hists: dict = {}  # name -> {buckets, counts[], sum, count}
+
+    # --- instruments ------------------------------------------------------
+    def inc(self, name: str, value=1, **labels) -> None:
+        k = _key(name, labels)
+        self.counters[k] = self.counters.get(k, 0) + value
+
+    def set_gauge(self, name: str, value, **labels) -> None:
+        self.gauges[_key(name, labels)] = value
+
+    def observe(self, name: str, value, buckets=DEFAULT_MS_BUCKETS) -> None:
+        h = self.hists.get(name)
+        if h is None:
+            h = self.hists[name] = {
+                "buckets": list(buckets),
+                "counts": [0] * (len(buckets) + 1),
+                "sum": 0.0,
+                "count": 0,
+            }
+        i = 0
+        for i, b in enumerate(h["buckets"]):
+            if value <= b:
+                break
+        else:
+            i = len(h["buckets"])
+        h["counts"][i] += 1
+        h["sum"] += value
+        h["count"] += 1
+
+    # --- export -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "histograms": {
+                n: {
+                    "sum": round(h["sum"], 3),
+                    "count": h["count"],
+                    "buckets": dict(
+                        zip([str(b) for b in h["buckets"]] + ["+Inf"],
+                            _cum(h["counts"]))
+                    ),
+                }
+                for n, h in self.hists.items()
+            },
+        }
+
+    def write_jsonl(self, path: str) -> None:
+        rec = heartbeat_record("metrics", run_id=self.run_id,
+                               **self.snapshot())
+        with open(path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+
+    def write_prom(self, path: str) -> None:
+        """Atomic Prometheus textfile export (tmp + rename: a scraper
+        re-reading the path mid-write never sees a torn file)."""
+        rid = f'run_id="{self.run_id}"'
+
+        def sample(key, value):
+            # merge the run_id label into an existing {labels} suffix
+            if key.endswith("}"):
+                return f"{key[:-1]},{rid}}} {value}"
+            return f"{key}{{{rid}}} {value}"
+
+        lines = []
+        seen_types = set()
+
+        def type_line(key, mtype):
+            base = key.split("{", 1)[0]
+            if base not in seen_types:
+                seen_types.add(base)
+                lines.append(f"# TYPE {base} {mtype}")
+
+        for k in sorted(self.counters):
+            type_line(k, "counter")
+            lines.append(sample(k, self.counters[k]))
+        for k in sorted(self.gauges):
+            type_line(k, "gauge")
+            lines.append(sample(k, self.gauges[k]))
+        for n in sorted(self.hists):
+            h = self.hists[n]
+            type_line(n, "histogram")
+            for le, c in zip([str(b) for b in h["buckets"]] + ["+Inf"],
+                             _cum(h["counts"])):
+                lines.append(sample(f'{n}_bucket{{le="{le}"}}', c))
+            lines.append(sample(f"{n}_sum", round(h["sum"], 3)))
+            lines.append(sample(f"{n}_count", h["count"]))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+
+def _cum(counts):
+    out, acc = [], 0
+    for c in counts:
+        acc += c
+        out.append(acc)
+    return out
+
+
+# --- module-level current registry (deep call sites, zero plumbing) -------
+_current: Optional[MetricsRegistry] = None
+
+
+def set_registry(reg: Optional[MetricsRegistry]) -> None:
+    global _current
+    _current = reg
+
+
+def current_registry() -> Optional[MetricsRegistry]:
+    return _current
+
+
+def inc(name: str, value=1, **labels) -> None:
+    if _current is not None:
+        _current.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value, **labels) -> None:
+    if _current is not None:
+        _current.set_gauge(name, value, **labels)

@@ -1,0 +1,396 @@
+"""`cli report <run-dir>`: render a run directory into a human summary.
+
+Works on any run directory — completed, still live, or crashed mid-level:
+every input is optional and every JSONL stream is read torn-final-line
+tolerantly (the only tear the O_APPEND writers can leave).  Never imports
+jax: a report must render on a box whose accelerator tunnel is wedged,
+which is exactly when you want it most.
+
+Sections:
+  header     run id / module / engine / status verdict
+  levels     per-level table + states/sec sparkline (TLC's live coverage
+             statistics, after the fact and correlated by run)
+  actions    cumulative action-enablement histogram (TLC action coverage)
+  spill      disk-tier accounting (runs/spills/merges/bloom gating)
+  timeline   restarts, stall-kills, checkpoint fallbacks, retries,
+             degradations — supervisor events + obs events, interleaved
+  ETA        frontier growth-rate fit over the recent levels
+  verdict    complete / violation / live / stalled / crashed — the stall
+             rule is the supervisor's own (no heartbeat growth past the
+             stall timeout), so `cli report` and the sentry always agree
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Optional
+
+from .tracer import read_jsonl_tolerant
+
+DEFAULT_STALL_TIMEOUT = 1800.0  # the supervisor's default
+_SPARK = "▁▂▃▄▅▆▇█"
+_EVENT_KINDS = (
+    "retry",
+    "compile-fallback",
+    "checkpoint-fallback",
+    "xprof-start",
+    "xprof-stop",
+)
+
+
+def load_run(run_dir: str) -> dict:
+    """Collect everything a run directory holds, tolerating absences."""
+    run_dir = os.path.normpath(run_dir)
+
+    def maybe_json(name):
+        p = os.path.join(run_dir, name)
+        if os.path.isfile(p):
+            try:
+                with open(p) as fh:
+                    return json.load(fh)
+            except ValueError:
+                return None  # torn manifest: the report still renders
+        return None
+
+    def jsonl(name):
+        return read_jsonl_tolerant(os.path.join(run_dir, name))
+
+    spans = jsonl("spans.jsonl")
+    metrics = jsonl("metrics.jsonl")
+    return {
+        "dir": run_dir,
+        "manifest": maybe_json("manifest.json") or {},
+        "levels": [r for r in jsonl("stats.jsonl") if r.get("kind") == "level"],
+        "events": jsonl("events.jsonl"),
+        "spans": [s for s in spans if s.get("kind") == "span"],
+        "obs_events": [s for s in spans if s.get("kind") == "event"],
+        "metrics": metrics[-1] if metrics else None,
+    }
+
+
+def _pid_alive(pid) -> Optional[bool]:
+    if not pid:
+        return None
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except (OSError, ValueError):
+        return None  # permission / foreign host: unknowable
+
+
+def verdict(data: dict, now: Optional[float] = None) -> dict:
+    """-> {status, detail}: the stall rule is the supervisor's (heartbeat
+    growth within the stall timeout), so report and sentry agree."""
+    man = data["manifest"]
+    status = man.get("status")
+    if status in ("complete", "violation", "error"):
+        return {"status": status, "detail": man.get("result", {})}
+    now = time.time() if now is None else now
+    beats = [r.get("unix") for r in data["levels"] if r.get("unix")]
+    beats += [r.get("unix") for r in data["spans"] if r.get("unix")]
+    beats += [r.get("unix") for r in data["events"] if r.get("unix")]
+    last = max(beats) if beats else man.get("unix") or man.get("created_unix")
+    age = (now - last) if last else None
+    timeout = float(
+        (man.get("config") or {}).get("stall_timeout") or DEFAULT_STALL_TIMEOUT
+    )
+    # a supervisor give-up is terminal ONLY for the current attempt chain:
+    # reopening the run dir (a new `cli check --run-dir` on it) appends a
+    # fresh open/reopen lineage entry, and give-ups older than that must
+    # not shadow the live run
+    last_open = max(
+        (e.get("unix", 0) for e in man.get("lineage", ())
+         if e.get("event") in ("open", "reopen")),
+        default=0,
+    )
+    for ev in reversed(data["events"]):
+        if ev.get("event") == "give-up" and ev.get("unix", 0) >= last_open:
+            return {
+                "status": "crashed",
+                "detail": {"supervisor": "gave up", "last_heartbeat_age_s":
+                           round(age, 1) if age is not None else None},
+            }
+    alive = _pid_alive(man.get("pid"))
+    if alive is False:
+        return {
+            "status": "crashed",
+            "detail": {
+                "pid": man.get("pid"),
+                "last_heartbeat_age_s": round(age, 1) if age else None,
+            },
+        }
+    if age is not None and age > timeout:
+        return {
+            "status": "stalled",
+            "detail": {
+                "last_heartbeat_age_s": round(age, 1),
+                "stall_timeout_s": timeout,
+            },
+        }
+    return {
+        "status": "live",
+        "detail": {"last_heartbeat_age_s": round(age, 1) if age is not None
+                   else None},
+    }
+
+
+def eta(levels: list, window: int = 5) -> dict:
+    """Frontier growth-rate fit: log-linear least squares on the per-level
+    new-state counts over the last `window` levels.  A decaying frontier
+    (ratio < 1) extrapolates the geometric tail into a finite remaining
+    count and, via the recent throughput, a time estimate; a flat or
+    growing frontier is honestly unbounded (BFS cannot know its horizon).
+    """
+    pts = [(r["depth"], r["new"]) for r in levels
+           if r.get("new", 0) > 0 and "depth" in r]
+    if len(pts) < 3:
+        return {"status": "insufficient-data"}
+    pts = pts[-window:]
+    xs = [p[0] for p in pts]
+    ys = [math.log(p[1]) for p in pts]
+    n = len(pts)
+    mx, my = sum(xs) / n, sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / max(denom, 1e-12)
+    ratio = math.exp(slope)
+    recent = levels[-window:]
+    wall_ms = sum(r.get("level_ms", 0.0) for r in recent)
+    new_sum = sum(r.get("new", 0) for r in recent)
+    rate = new_sum / (wall_ms / 1e3) if wall_ms else None
+    out = {"status": "fit", "growth_ratio": round(ratio, 3),
+           "recent_states_per_sec": round(rate, 1) if rate else None}
+    if ratio < 0.999:
+        remaining = pts[-1][1] * ratio / (1.0 - ratio)
+        out["est_remaining_states"] = int(remaining)
+        # levels until the geometric tail drops below one new state
+        out["est_remaining_levels"] = (
+            max(1, int(math.ceil(-math.log(pts[-1][1]) / math.log(ratio))))
+            if pts[-1][1] > 1
+            else 1
+        )
+        if rate:
+            out["eta_seconds"] = round(remaining / rate, 1)
+    else:
+        out["note"] = "frontier not yet decaying; ETA unbounded"
+    return out
+
+
+def _spark(vals: list) -> str:
+    if not vals:
+        return ""
+    hi = max(vals) or 1
+    return "".join(_SPARK[min(len(_SPARK) - 1,
+                              int(v / hi * (len(_SPARK) - 1)))] for v in vals)
+
+
+def _fmt_dur(s: Optional[float]) -> str:
+    if s is None:
+        return "?"
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.1f}m"
+    return f"{s / 3600:.1f}h"
+
+
+def report_data(run_dir: str, now: Optional[float] = None) -> dict:
+    """The machine-readable report (cli report --json)."""
+    data = load_run(run_dir)
+    levels = data["levels"]
+    man = data["manifest"]
+    actions: dict = {}
+    for r in levels:
+        for name, c in (r.get("action_enablement") or {}).items():
+            actions[name] = actions.get(name, 0) + int(c)
+    # spill accounting: last metrics snapshot (finish-time gauges when the
+    # run completed, live counters either way) + span aggregates
+    snap = data["metrics"] or {}
+    spill = {
+        k: v
+        for src in ("gauges", "counters")
+        for k, v in snap.get(src, {}).items()
+        if k.startswith(("kspec_spill_", "kspec_bloom_"))
+    }
+    span_agg: dict = {}
+    for s in data["spans"]:
+        if s.get("ph") != "E":
+            continue
+        k = s.get("span")
+        a = span_agg.setdefault(k, {"count": 0, "ms": 0.0})
+        a["count"] += 1
+        a["ms"] += s.get("ms", 0.0)
+    timeline = []
+    for ev in data["events"]:
+        if ev.get("kind") == "supervisor":
+            timeline.append(ev)
+    for ev in data["obs_events"]:
+        if ev.get("event") in _EVENT_KINDS:
+            timeline.append(ev)
+    timeline.sort(key=lambda e: e.get("unix", 0))
+    # unclosed level begin marker = died mid-level
+    open_level = None
+    closed = {s.get("depth") for s in data["spans"]
+              if s.get("span") == "level" and s.get("ph") == "E"}
+    for s in data["spans"]:
+        if s.get("span") == "level" and s.get("ph") == "B" \
+                and s.get("depth") not in closed:
+            open_level = s.get("depth")
+    return {
+        "run_id": man.get("run_id") or os.path.basename(data["dir"]),
+        "dir": data["dir"],
+        "manifest": man,
+        "verdict": verdict(data, now=now),
+        "levels": levels,
+        "actions": actions,
+        "spill": spill,
+        "spans": span_agg,
+        "timeline": timeline,
+        "eta": eta(levels),
+        "open_level": open_level,
+    }
+
+
+def render_report(run_dir: str, now: Optional[float] = None,
+                  max_rows: int = 40) -> str:
+    r = report_data(run_dir, now=now)
+    man, levels = r["manifest"], r["levels"]
+    cfg = man.get("config") or {}
+    out = []
+    v = r["verdict"]
+    out.append(f"Run {r['run_id']}  [{v['status'].upper()}]")
+    bits = [
+        f"module={cfg.get('module') or cfg.get('model') or '?'}",
+        f"engine={cfg.get('engine', '?')}",
+    ]
+    if cfg.get("platform"):
+        bits.append(f"platform={cfg['platform']}")
+    if man.get("git"):
+        bits.append(f"git={man['git']}")
+    if cfg.get("mem_budget"):
+        bits.append(f"mem_budget={cfg['mem_budget']}")
+    restarts = sum(
+        1 for e in r["timeline"]
+        if e.get("kind") == "supervisor" and e.get("event") == "restart"
+    )
+    if restarts:
+        bits.append(f"restarts={restarts}")
+    out.append("  " + "  ".join(bits))
+    if v["detail"]:
+        out.append("  " + json.dumps(v["detail"], default=str))
+    if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
+        out.append(f"  died mid-level: level {r['open_level']} began but "
+                   f"never completed")
+    # --- levels table -----------------------------------------------------
+    if levels:
+        out.append("")
+        out.append("Per-level throughput "
+                   f"({len(levels)} levels recorded):")
+        out.append(
+            f"  {'depth':>5} {'frontier':>10} {'new':>10} {'dup%':>6} "
+            f"{'wall':>8} {'kstates/s':>10}"
+        )
+        rows = levels if len(levels) <= max_rows else (
+            levels[: max_rows // 2] + [None] + levels[-max_rows // 2:]
+        )
+        for rec in rows:
+            if rec is None:
+                out.append(f"  {'...':>5}")
+                continue
+            en = rec.get("enabled_candidates", 0)
+            dup = rec.get("duplicates", 0)
+            ms = rec.get("level_ms", 0.0)
+            sps = rec.get("new", 0) / (ms / 1e3) if ms else 0.0
+            out.append(
+                f"  {rec.get('depth', '?'):>5} {rec.get('frontier', 0):>10,}"
+                f" {rec.get('new', 0):>10,}"
+                f" {100.0 * dup / en if en else 0.0:>5.1f}%"
+                f" {_fmt_dur(ms / 1e3):>8} {sps / 1e3:>10.1f}"
+            )
+        sps_curve = [
+            rec.get("new", 0) / (rec.get("level_ms", 0) / 1e3)
+            if rec.get("level_ms") else 0.0
+            for rec in levels
+        ]
+        out.append(f"  states/sec  {_spark(sps_curve)}")
+        out.append(f"  new/level   "
+                   f"{_spark([rec.get('new', 0) for rec in levels])}")
+        total = levels[-1].get("total")
+        if total:
+            out.append(f"  total distinct so far: {total:,}")
+        shard_new = levels[-1].get("shard_new")
+        if shard_new:
+            mean = sum(shard_new) / len(shard_new)
+            imb = max(shard_new) / mean if mean else 0.0
+            out.append(
+                f"  shards: {len(shard_new)}; last-level new per shard "
+                f"{_spark(shard_new)} (imbalance max/mean {imb:.2f})"
+            )
+    else:
+        out.append("")
+        out.append("No per-level stats recorded (yet).")
+    # --- action enablement ------------------------------------------------
+    if r["actions"]:
+        out.append("")
+        out.append("Action enablement (cumulative successors per action):")
+        tot = sum(r["actions"].values()) or 1
+        width = max(len(n) for n in r["actions"])
+        for name, c in sorted(r["actions"].items(), key=lambda kv: -kv[1]):
+            out.append(f"  {name:<{width}} {c:>12,}  {100.0 * c / tot:>5.1f}%")
+    # --- spill accounting -------------------------------------------------
+    if r["spill"] or any(k.startswith("spill-") for k in r["spans"]):
+        out.append("")
+        out.append("Disk-tier (spill) accounting:")
+        for k in sorted(r["spill"]):
+            out.append(f"  {k} = {r['spill'][k]}")
+        for k in ("spill-run-write", "spill-merge"):
+            if k in r["spans"]:
+                a = r["spans"][k]
+                out.append(
+                    f"  {k}: {a['count']}x, {_fmt_dur(a['ms'] / 1e3)} total"
+                )
+    # --- timeline ---------------------------------------------------------
+    if r["timeline"]:
+        out.append("")
+        out.append("Restart / fallback timeline:")
+        for ev in r["timeline"][-20:]:
+            what = ev.get("event", "?")
+            extra = {
+                k: v
+                for k, v in ev.items()
+                if k not in ("kind", "ts", "unix", "event", "run_id", "cmd")
+            }
+            out.append(f"  {ev.get('ts', '?')}  {what}  "
+                       f"{json.dumps(extra, default=str)}")
+    # --- ETA --------------------------------------------------------------
+    e = r["eta"]
+    out.append("")
+    if v["status"] in ("complete", "violation"):
+        res = man.get("result") or {}
+        out.append(
+            f"ETA: run finished — {res.get('distinct_states', '?')} states, "
+            f"diameter {res.get('diameter', '?')}, "
+            f"{_fmt_dur(res.get('seconds'))}"
+        )
+    elif e.get("status") == "fit":
+        if "eta_seconds" in e:
+            out.append(
+                f"ETA: frontier decaying x{e['growth_ratio']}/level — "
+                f"~{e['est_remaining_states']:,} states remain, "
+                f"~{_fmt_dur(e['eta_seconds'])} at "
+                f"{e['recent_states_per_sec']:,.0f} states/sec"
+            )
+        else:
+            out.append(
+                f"ETA: frontier growth x{e['growth_ratio']}/level — "
+                f"unbounded (sustaining "
+                f"{e.get('recent_states_per_sec') or 0:,.0f} states/sec)"
+            )
+    else:
+        out.append("ETA: insufficient data (needs >= 3 levels of stats)")
+    out.append(f"Stall verdict: {v['status']}")
+    return "\n".join(out)

@@ -1,0 +1,193 @@
+"""RunContext: one run_id, one directory, one manifest for a whole run.
+
+Every ``cli check`` / ``resilient_run.py`` invocation gets a run directory
+(``--run-dir``, default ``runs/<run_id>/`` under the current directory or
+``$KSPEC_RUNS_ROOT``) that collects what previously landed wherever each
+caller pointed it:
+
+    runs/<run_id>/
+      manifest.json    config, engine, git describe, knobs, lineage, status
+      stats.jsonl      the engines' per-level heartbeat stream (--stats)
+      spans.jsonl      nested spans + point events (obs/tracer)
+      metrics.jsonl    per-level metric snapshots (obs/metrics)
+      metrics.prom     Prometheus textfile export (atomic, scrapable)
+      events.jsonl     supervisor events (resilient runs)
+      logs/            per-attempt child logs (resilient runs)
+      spill/           disk-tier default when --mem-budget is set
+      xprof/           jax.profiler windows (KSPEC_OBS_XPROF)
+
+The manifest is written atomically at open (status "running"), updated
+with a resume-lineage entry every time an existing run directory is
+reopened (supervised restarts resume *into the same run*: the run_id is
+the correlation key across attempts), and finalized by ``finish`` with the
+terminal status + result summary.  A manifest stuck at "running" whose
+heartbeat has gone stale is exactly what ``cli report``'s stall verdict
+keys on.
+
+Must stay jax-free (resilient_run.py / tpu_sentry.py import this from a
+parent that must survive a wedged accelerator tunnel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from ..resilience.heartbeat import heartbeat_record
+from .metrics import MetricsRegistry, set_registry
+from .tracer import SpanTracer, set_tracer
+
+MANIFEST = "manifest.json"
+
+
+def new_run_id() -> str:
+    """Sortable, collision-resistant without coordination:
+    <utc-stamp>-<pid>-<4 hex>."""
+    return "{}-{}-{}".format(
+        time.strftime("%Y%m%dT%H%M%S", time.gmtime()),
+        os.getpid(),
+        os.urandom(2).hex(),
+    )
+
+
+def default_run_dir(run_id: str) -> str:
+    root = os.environ.get("KSPEC_RUNS_ROOT", "runs")
+    return os.path.join(root, run_id)
+
+
+def git_describe(cwd: Optional[str] = None) -> Optional[str]:
+    try:
+        p = subprocess.run(
+            ["git", "describe", "--always", "--dirty", "--tags"],
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=10,
+        )
+        return p.stdout.strip() or None if p.returncode == 0 else None
+    except Exception:
+        return None
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    # same tmp+fsync+replace sequence as storage.atomic.atomic_write; a
+    # local copy because importing the storage package would pull the
+    # native C++ FpSet into jax-free supervisor parents.  fsync matters
+    # here: a power loss publishing an empty manifest would mint a new
+    # run_id on reopen and sever the restart lineage
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, indent=1, default=str)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+class RunContext:
+    def __init__(self, run_dir: Optional[str] = None,
+                 run_id: Optional[str] = None):
+        """Open (creating if needed) a run directory.
+
+        A fresh directory gets a new run_id + manifest; an existing one is
+        *resumed*: its manifest's run_id is adopted and a lineage entry is
+        appended (checkpoint lineage across supervised restarts)."""
+        existing = None
+        if run_dir is not None and os.path.isfile(
+            os.path.join(run_dir, MANIFEST)
+        ):
+            try:
+                with open(os.path.join(run_dir, MANIFEST)) as fh:
+                    existing = json.load(fh)
+            except ValueError:
+                existing = None  # torn manifest: treat as fresh
+        if existing is not None and existing.get("run_id"):
+            run_id = existing["run_id"]
+        self.run_id = run_id or new_run_id()
+        self.dir = os.path.normpath(run_dir or default_run_dir(self.run_id))
+        os.makedirs(self.dir, exist_ok=True)
+        self.manifest_path = os.path.join(self.dir, MANIFEST)
+        self.stats_path = os.path.join(self.dir, "stats.jsonl")
+        self.spans_path = os.path.join(self.dir, "spans.jsonl")
+        self.metrics_jsonl = os.path.join(self.dir, "metrics.jsonl")
+        self.metrics_prom = os.path.join(self.dir, "metrics.prom")
+        self.events_path = os.path.join(self.dir, "events.jsonl")
+        self.log_dir = os.path.join(self.dir, "logs")
+        self.spill_dir = os.path.join(self.dir, "spill")
+
+        self.tracer = SpanTracer(self.spans_path, self.run_id)
+        self.metrics = MetricsRegistry(self.run_id)
+
+        if existing is not None:
+            self.manifest = existing
+            self.manifest.setdefault("lineage", []).append(
+                {"event": "reopen", "pid": os.getpid(),
+                 **_ts_fields()}
+            )
+            self.manifest["status"] = "running"
+            self.manifest["pid"] = os.getpid()
+        else:
+            self.manifest = {
+                "run_id": self.run_id,
+                "status": "running",
+                "pid": os.getpid(),
+                "argv": list(sys.argv),
+                "cwd": os.getcwd(),
+                "git": git_describe(),
+                "lineage": [
+                    {"event": "open", "pid": os.getpid(), **_ts_fields()}
+                ],
+                **_ts_fields("created", "created_unix"),
+            }
+        self.write_manifest()
+
+    # --- manifest ---------------------------------------------------------
+    def write_manifest(self) -> None:
+        _atomic_write_json(self.manifest_path, self.manifest)
+
+    def update_manifest(self, **fields) -> None:
+        self.manifest.update(fields)
+        self.write_manifest()
+
+    def record_config(self, **fields) -> None:
+        """Stamp run configuration (module, engine, knobs...) — keys land
+        under manifest['config'], merged across calls (a resumed run may
+        re-record identical config; new keys win)."""
+        cfg = self.manifest.setdefault("config", {})
+        cfg.update({k: v for k, v in fields.items() if v is not None})
+        self.write_manifest()
+
+    # --- activation (global tracer/registry for deep call sites) ----------
+    def activate(self) -> None:
+        set_tracer(self.tracer)
+        set_registry(self.metrics)
+
+    def deactivate(self) -> None:
+        self.tracer.xprof_force_stop()  # windows must flush even when a
+        set_tracer(None)                # verdict cut the level loop early
+        set_registry(None)
+        self.tracer.close()
+
+    # --- exports ----------------------------------------------------------
+    def snapshot_metrics(self) -> None:
+        self.metrics.write_jsonl(self.metrics_jsonl)
+        self.metrics.write_prom(self.metrics_prom)
+
+    def finish(self, status: str, **summary) -> None:
+        """Terminal manifest update + final metric snapshot."""
+        self.manifest["status"] = status
+        self.manifest.setdefault("lineage", []).append(
+            {"event": "finish", "status": status, **_ts_fields()}
+        )
+        if summary:
+            self.manifest["result"] = summary
+        self.write_manifest()
+        self.snapshot_metrics()
+
+
+def _ts_fields(ts_key: str = "ts", unix_key: str = "unix") -> dict:
+    rec = heartbeat_record("x")
+    return {ts_key: rec["ts"], unix_key: rec["unix"]}

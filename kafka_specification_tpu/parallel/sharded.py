@@ -64,10 +64,10 @@ from ..engine.bfs import (
     walk_trace,
 )
 from ..models.base import Model
+from ..obs.observer import RunObserver
 from ..ops import dedup, hashset
 from ..resilience.checkpoints import CheckpointStore
 from ..resilience.faults import FaultPlan
-from ..resilience.heartbeat import append_jsonl, heartbeat_record
 from ..resilience.retry import ChunkRetryHandler
 from .multihost import (
     fetch_global,
@@ -363,6 +363,7 @@ def check_sharded(
     mem_budget=None,
     spill_dir: Optional[str] = None,
     store: str = "auto",
+    run=None,
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -416,10 +417,19 @@ def check_sharded(
     hot dump instead of the full fingerprint sets.  The frontier and
     traces stay in RAM in this engine (the single-device engine carries
     the disk frontier + parent log).
+
+    run: an obs.RunContext (docs/observability.md) — per-level stats gain
+    per-shard frontier/new/duplicate breakdowns and an exchange-imbalance
+    gauge; spans/metrics/manifest land in the run directory.  In a
+    multi-process job only the coordinator observes (the replicated host
+    loops would otherwise write D copies of every artifact).
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
     D = mesh.devices.size
+    if run is not None and not is_coordinator():
+        run = None
+    obs_ = RunObserver(run, stats_path, engine="sharded")
     spec = model.spec
     expander = _Step(model)  # width bookkeeping only; steps build their own
     C = expander.C
@@ -446,7 +456,7 @@ def check_sharded(
                     for k, v in spec.unpack(jnp.asarray(init_packed[idx])).items()
                 }
                 dec = model.decode(st) if model.decode else st
-                return CheckResult(
+                res = CheckResult(
                     model.name,
                     [n0],
                     n0,
@@ -461,6 +471,9 @@ def check_sharded(
                     0.0,
                     stats={"devices": D},
                 )
+                obs_.finish(res)
+                obs_.close()
+                return res
     from ..storage import resolve_store
 
     use_disk = resolve_store(store, mem_budget)
@@ -472,6 +485,16 @@ def check_sharded(
             f"visited_backend must be 'device', 'device-hash' or 'host', "
             f"got {visited_backend!r}"
         )
+    obs_.config(
+        model=model.name,
+        devices=D,
+        exchange=exchange,
+        visited_backend=visited_backend,
+        store="disk" if use_disk else "ram",
+        mem_budget=mem_budget,
+        checkpoint_dir=checkpoint_dir,
+        platform=jax.default_backend(),
+    )
     host_sets = None
     spill_base = None
     ephemeral_spill = None
@@ -583,6 +606,7 @@ def check_sharded(
     total = n0
     depth = 0
     violation = None
+    result_levels: list = []  # per-level stats records (mirrors engine.check)
     steps = {}
     w_extra = 0  # extra doublings of the all_to_all per-destination width
 
@@ -892,11 +916,18 @@ def check_sharded(
             cut = True
             break
         t_level = time.perf_counter()
+        obs_.level_begin(depth + 1, int(sum(p.shape[0] for p in pending)))
         next_pending = [[] for _ in range(D)]
         next_parent = [[] for _ in range(D)]
         next_act = [[] for _ in range(D)]
         lvl_act_en = np.zeros(len(model.actions), np.int64)
         lvl_new_per_shard = np.zeros(D, np.int64)
+        # per-shard breakdowns for the stats stream (exchange imbalance is
+        # invisible in coordinator-aggregated totals): enabled candidates
+        # per SOURCE shard, and — host backend, where the coordinator sees
+        # the novelty masks — received candidates per OWNER shard
+        lvl_en_per_shard = np.zeros(D, np.int64)
+        lvl_recv_per_shard = np.zeros(D, np.int64)
         offs = [0] * D
         # base offset of each shard's rows in this level's shard-major order
         prev_base = np.concatenate([[0], np.cumsum([p.shape[0] for p in pending])])
@@ -929,6 +960,7 @@ def check_sharded(
             # run to a wider shape (the compiled steps stay cached).
             attempt, w_try = adapt.widths_for(bucket), w_extra
             chunk_retry.reset_chunk()
+            t_chunk = time.perf_counter()
             while True:
                 if isinstance(attempt, int):
                     ca = _norm_shift(bucket, attempt) or None
@@ -1081,6 +1113,13 @@ def check_sharded(
             # adapt buffer sizing from the committed attempt's guard counts
             # (mirrors engine.check; no-op until escalation activates)
             adapt.observe(_shard_density(fetch_global(act_guard), took))
+            obs_.chunk_span(
+                "exchange",
+                time.perf_counter() - t_chunk,
+                depth=depth,
+                bucket=bucket,
+                exchange=exchange,
+            )
             # frontier-level verdicts (states being expanded = level `depth`)
             viol_any_np = fetch_global(viol_any)  # [D, n_inv]
             if viol_any_np.any():
@@ -1097,6 +1136,9 @@ def check_sharded(
                 verdict = ("Deadlock", frontier[d, idx], gidx)
                 break
             counts = fetch_global(new_n)
+            # received candidates per OWNER shard (post-exchange, pre-host-
+            # dedup on the host backend; == novel on device backends)
+            lvl_recv_per_shard += counts.astype(np.int64)
             M_per = out.shape[0] // D
             # device-side slice to the widest shard before the host copy —
             # the padded buffer is mostly empty
@@ -1149,8 +1191,10 @@ def check_sharded(
                 newc[d] = c
             lvl_new_per_shard += newc
             shard_visited += newc
-            if stats_path is not None:
-                lvl_act_en += fetch_global(act_en).astype(np.int64).sum(axis=0)
+            if obs_.collect:
+                act_en_np = fetch_global(act_en).astype(np.int64)
+                lvl_act_en += act_en_np.sum(axis=0)
+                lvl_en_per_shard += act_en_np.sum(axis=1)
 
         if verdict is not None:
             inv_name, row, gidx = verdict
@@ -1170,12 +1214,22 @@ def check_sharded(
         if n_new:
             levels.append(n_new)
             total += n_new
-        if stats_path is not None and is_coordinator():
+        if obs_.collect and is_coordinator():
             enabled_total = int(lvl_act_en.sum())
             # heartbeat-enveloped (kind/ts/unix): the per-level stats
-            # stream doubles as the supervisor's liveness signal
-            rec = heartbeat_record(
-                "level",
+            # stream doubles as the supervisor's liveness signal.  Beyond
+            # the coordinator-aggregated totals, the record carries the
+            # per-shard breakdowns (frontier rows expanded per shard,
+            # enabled per source shard, new per owner shard, and — host
+            # backend, where the coordinator computes the novelty masks —
+            # duplicates per owner shard) so exchange imbalance is
+            # visible without re-running the level
+            shard_extra = {}
+            if host_sets is not None:
+                shard_extra["shard_duplicates"] = (
+                    lvl_recv_per_shard - lvl_new_per_shard
+                ).tolist()
+            rec = obs_.level(
                 depth=depth,
                 frontier=int(prev_base[-1]),
                 enabled_candidates=enabled_total,
@@ -1184,11 +1238,14 @@ def check_sharded(
                 total=total,
                 level_ms=round((time.perf_counter() - t_level) * 1e3, 1),
                 shard_new=lvl_new_per_shard.tolist(),
+                shard_frontier=np.diff(prev_base).astype(np.int64).tolist(),
+                shard_enabled=lvl_en_per_shard.tolist(),
+                **shard_extra,
                 action_enablement={
                     a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
                 },
             )
-            append_jsonl(stats_path, rec)
+            result_levels.append(rec)
         if progress:
             progress(depth, n_new, total)
         pending = [
@@ -1251,7 +1308,7 @@ def check_sharded(
         import shutil
 
         shutil.rmtree(ephemeral_spill, ignore_errors=True)
-    return CheckResult(
+    res = CheckResult(
         model=model.name,
         levels=levels,
         total=total,
@@ -1261,6 +1318,7 @@ def check_sharded(
         states_per_sec=total / max(dt, 1e-9),
         stats={
             "devices": D,
+            **({"levels": result_levels} if result_levels else {}),
             "visited_capacity_per_shard": int(vcap),
             "fanout": C,
             "visited_backend": visited_backend,
@@ -1286,3 +1344,6 @@ def check_sharded(
             **spill_stats,
         },
     )
+    obs_.finish(res)
+    obs_.close()
+    return res

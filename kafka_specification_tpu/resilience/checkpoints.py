@@ -99,24 +99,34 @@ class CheckpointStore:
 
         `depth` is stamped into the file (and must match across the main
         file and every part of a generation for a load to accept it)."""
+        # lazy import: obs <-> resilience must stay acyclic at module level
+        from ..obs import metrics as _met
+        from ..obs import tracer as _obs
+
         arrays = dict(arrays)
         arrays["ident"] = self.ident
         arrays["depth"] = depth
         path = self.path(0, part)
         tmp = path + ".tmp.npz"
-        # uncompressed (live fingerprints are high-entropy; zlib only burns
-        # time — same rationale as the seed writer)
-        np.savez(tmp, **{MANIFEST_KEY: json.dumps(build_manifest(arrays))}, **arrays)
-        if self.fault_plan is not None:
-            # torn-write rehearsal point: tmp written, nothing promoted
-            self.fault_plan.crash("ckpt", depth)
-        # shift existing generations up (newest-first so each replace's
-        # target is the already-vacated slot); generation keep-1 falls off
-        for g in range(self.keep - 1, 0, -1):
-            src = self.path(g - 1, part)
-            if os.path.exists(src):
-                os.replace(src, self.path(g, part))
-        os.replace(tmp, path)
+        with _obs.span("checkpoint-write", depth=depth, part=part or ""):
+            # uncompressed (live fingerprints are high-entropy; zlib only
+            # burns time — same rationale as the seed writer)
+            np.savez(
+                tmp, **{MANIFEST_KEY: json.dumps(build_manifest(arrays))},
+                **arrays,
+            )
+            if self.fault_plan is not None:
+                # torn-write rehearsal point: tmp written, nothing promoted
+                self.fault_plan.crash("ckpt", depth)
+            # shift existing generations up (newest-first so each replace's
+            # target is the already-vacated slot); generation keep-1 falls
+            # off
+            for g in range(self.keep - 1, 0, -1):
+                src = self.path(g - 1, part)
+                if os.path.exists(src):
+                    os.replace(src, self.path(g, part))
+            os.replace(tmp, path)
+        _met.inc("kspec_checkpoint_writes_total")
         if self.fault_plan is not None and self.fault_plan.should_corrupt(depth):
             from .faults import corrupt_file
 
@@ -198,13 +208,16 @@ class CheckpointStore:
         all; raises CheckpointCorrupt when files exist but none verify;
         raises ValueError on an identity mismatch (never falls back past
         it)."""
+        from ..obs import tracer as _obs  # lazy: cycle hygiene
+
         gens = self.generations()
         if not gens:
             return None
         errors = []
         for g in gens:
             try:
-                main = self._verify(self.path(g))
+                with _obs.span("checkpoint-verify", generation=g):
+                    main = self._verify(self.path(g))
             except CheckpointCorrupt as e:
                 errors.append(str(e))
                 continue
@@ -232,6 +245,13 @@ class CheckpointStore:
                     f"resuming from generation {g} (level {depth}):\n  "
                     + "\n  ".join(errors),
                     file=sys.stderr,
+                )
+                # run-correlated fallback record for `cli report`'s timeline
+                _obs.event(
+                    "checkpoint-fallback",
+                    generation=g,
+                    depth=depth,
+                    errors=len(errors),
                 )
             return main, part_arrays, g
         raise CheckpointCorrupt(

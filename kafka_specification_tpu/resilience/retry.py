@@ -136,6 +136,17 @@ class ChunkRetryHandler:
                 f"{pause:.2f}s",
                 file=sys.stderr,
             )
+            # run-correlated retry record (obs/tracer; no-op without a run
+            # context — lazy import keeps obs <-> resilience acyclic)
+            from ..obs import tracer as _obs
+
+            _obs.event(
+                "retry",
+                depth=depth,
+                attempt=self.transient_try,
+                backoff_s=round(pause, 2),
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
             time.sleep(pause)
             return "retry"
         if not escalated:
@@ -152,5 +163,12 @@ class ChunkRetryHandler:
                 "depth": depth,
                 "error": f"{type(e).__name__}: {e}"[:300],
             }
+        )
+        from ..obs import tracer as _obs
+
+        _obs.event(
+            "compile-fallback",
+            depth=depth,
+            error=f"{type(e).__name__}: {e}"[:200],
         )
         return "degrade"

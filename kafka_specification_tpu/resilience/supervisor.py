@@ -51,11 +51,20 @@ class SupervisorConfig:
     poll: float = 0.5
     term_grace: float = 10.0  # SIGTERM -> SIGKILL grace
     env: Optional[dict] = None
+    run_id: Optional[str] = None  # obs correlation key (stamped per event)
     rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def backoff(self, restart: int) -> float:
         d = min(self.backoff_base * 2.0 ** (restart - 1), self.backoff_cap)
         return d * (1.0 + self.jitter * self.rng.random())
+
+    def event(self, **fields) -> None:
+        """One supervisor event: heartbeat-enveloped, run_id-stamped when
+        the run has one (obs run directories), appended to the log."""
+        extra = {"run_id": self.run_id} if self.run_id else {}
+        append_jsonl(
+            self.events, heartbeat_record("supervisor", **extra, **fields)
+        )
 
 
 STALL_RC = -97  # synthetic rc recorded for a stall-killed attempt
@@ -117,15 +126,11 @@ def _run_attempt(cfg: SupervisorConfig, attempt: int) -> int:
                 hb_size = size
                 last_progress = time.monotonic()
             if time.monotonic() - last_progress > cfg.stall_timeout:
-                append_jsonl(
-                    cfg.events,
-                    heartbeat_record(
-                        "supervisor",
-                        event="stall-kill",
-                        attempt=attempt,
-                        stall_timeout=cfg.stall_timeout,
-                        heartbeat=cfg.heartbeat,
-                    ),
+                cfg.event(
+                    event="stall-kill",
+                    attempt=attempt,
+                    stall_timeout=cfg.stall_timeout,
+                    heartbeat=cfg.heartbeat,
                 )
                 signal_tree(signal.SIGTERM)
                 try:
@@ -144,52 +149,26 @@ def supervise(cfg: SupervisorConfig) -> int:
     """Run cfg.cmd to success or budget exhaustion; returns the final rc."""
     rc = None
     for attempt in range(1, cfg.max_restarts + 2):
-        append_jsonl(
-            cfg.events,
-            heartbeat_record(
-                "supervisor", event="start", attempt=attempt, cmd=cfg.cmd
-            ),
-        )
+        cfg.event(event="start", attempt=attempt, cmd=cfg.cmd)
         t0 = time.time()
         rc = _run_attempt(cfg, attempt)
-        append_jsonl(
-            cfg.events,
-            heartbeat_record(
-                "supervisor",
-                event="exit",
-                attempt=attempt,
-                rc=rc,
-                seconds=round(time.time() - t0, 1),
-            ),
+        cfg.event(
+            event="exit",
+            attempt=attempt,
+            rc=rc,
+            seconds=round(time.time() - t0, 1),
         )
         if rc == 0:
-            append_jsonl(
-                cfg.events,
-                heartbeat_record("supervisor", event="complete", attempt=attempt),
-            )
+            cfg.event(event="complete", attempt=attempt)
             return 0
         if attempt > cfg.max_restarts:
             break
         delay = cfg.backoff(attempt)
-        append_jsonl(
-            cfg.events,
-            heartbeat_record(
-                "supervisor",
-                event="restart",
-                attempt=attempt,
-                backoff_s=round(delay, 2),
-            ),
+        cfg.event(
+            event="restart", attempt=attempt, backoff_s=round(delay, 2)
         )
         time.sleep(delay)
-    append_jsonl(
-        cfg.events,
-        heartbeat_record(
-            "supervisor",
-            event="give-up",
-            attempts=cfg.max_restarts + 1,
-            rc=rc,
-        ),
-    )
+    cfg.event(event="give-up", attempts=cfg.max_restarts + 1, rc=rc)
     print(
         f"[supervisor] giving up after {cfg.max_restarts + 1} attempts "
         f"(last rc={rc}); see {cfg.events}",

@@ -82,6 +82,11 @@ class SortedRun:
         if self.bloom is None:  # missing/rotted sidecar: rebuild, re-save
             self.bloom = BloomFilter.build(np.asarray(self.arr))
             self.bloom.save(bloom_path)
+        # bloom-gate accounting (obs metrics: how much disk traffic the
+        # per-run gates actually save on a spilled run)
+        self.probes = 0  # interval-passing queries
+        self.bloom_maybe = 0  # of those, bloom said "maybe" (disk touched)
+        self.hits = 0  # of those, actually present
 
     def contains(self, fps: np.ndarray) -> np.ndarray:
         """Exact membership mask for a (possibly unsorted) query batch."""
@@ -93,12 +98,15 @@ class SortedRun:
             return out
         ci = np.nonzero(cand)[0]
         q = fps[ci]
+        self.probes += int(ci.shape[0])
         m = self.bloom.maybe(q)  # the disk-touch gate
+        self.bloom_maybe += int(m.sum())
         if not m.any():
             return out
         ci, q = ci[m], q[m]
         pos = np.searchsorted(self.arr, q)
         hit = self.arr[np.minimum(pos, self.count - 1)] == q
+        self.hits += int(hit.sum())
         out[ci[hit]] = True
         return out
 

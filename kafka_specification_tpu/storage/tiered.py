@@ -122,6 +122,9 @@ class TieredFpSet:
         self.seq = 0  # next run file number (monotonic across merges)
         self.spills = 0
         self.merges = 0
+        # bloom-gate traffic accumulated on merged-away runs (their
+        # per-run counters die with them; totals must not)
+        self._retired_probes = {"probes": 0, "bloom_maybe": 0, "hits": 0}
         os.makedirs(directory, exist_ok=True)
 
     # --- lifecycle ------------------------------------------------------
@@ -243,6 +246,30 @@ class TieredFpSet:
             "spills": self.spills,
             "merges": self.merges,
             "disk_bytes": 8 * self.disk_n,
+            # bloom-gate accounting per open run (obs: how much disk
+            # traffic the per-run gates save — bloom_filtered probes never
+            # touched the mmap)
+            "run_probes": [
+                {
+                    "name": r.meta["name"],
+                    "probes": r.probes,
+                    "bloom_maybe": r.bloom_maybe,
+                    "bloom_filtered": r.probes - r.bloom_maybe,
+                    "hits": r.hits,
+                }
+                for r in self.runs
+            ],
+            # whole-run totals: live runs + everything merged away (the
+            # *_total metrics must survive compaction)
+            "bloom_totals": {
+                k: self._retired_probes[k]
+                + sum(getattr(r, a) for r in self.runs)
+                for k, a in (
+                    ("probes", "probes"),
+                    ("bloom_maybe", "bloom_maybe"),
+                    ("hits", "hits"),
+                )
+            },
         }
 
     # --- spill / merge --------------------------------------------------
@@ -264,8 +291,14 @@ class TieredFpSet:
         fps = np.sort(self.hot.dump())
         if fps.shape[0] == 0:
             return
+        # lazy import: obs <-> storage must stay acyclic at module level
+        from ..obs import metrics as _met
+        from ..obs import tracer as _obs
+
         path = self._run_path()
-        meta = write_run(path, fps, bloom_path=path + ".bloom")
+        with _obs.span("spill-run-write", rows=int(fps.shape[0])):
+            meta = write_run(path, fps, bloom_path=path + ".bloom")
+        _met.inc("kspec_spill_runs_total")
         self.runs.append(SortedRun(self.dir, meta, verify=False))
         self.disk_n += fps.shape[0]
         self.spills += 1
@@ -281,6 +314,9 @@ class TieredFpSet:
         some retained checkpoint manifest fully resolves."""
         if len(self.runs) < 2:
             return
+        from ..obs import metrics as _met
+        from ..obs import tracer as _obs
+
         self.merges += 1
         path = self._run_path()
         hook = None
@@ -290,7 +326,17 @@ class TieredFpSet:
             def hook():
                 self.fault_plan.crash("merge", ordinal)
 
-        meta = merge_runs(self.runs, path, crash_hook=hook)
+        with _obs.span(
+            "spill-merge",
+            runs=len(self.runs),
+            rows=int(sum(r.count for r in self.runs)),
+        ):
+            meta = merge_runs(self.runs, path, crash_hook=hook)
+        _met.inc("kspec_spill_merges_total")
+        for r in self.runs:  # retire the merged-away runs' gate counters
+            self._retired_probes["probes"] += r.probes
+            self._retired_probes["bloom_maybe"] += r.bloom_maybe
+            self._retired_probes["hits"] += r.hits
         old = [r.path for r in self.runs]
         self.runs = [SortedRun(self.dir, meta, verify=False)]
         self.deleter.schedule(old)

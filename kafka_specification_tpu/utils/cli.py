@@ -228,6 +228,13 @@ def main(argv=None):
     pc = sub.add_parser("check", help="run the TPU/JAX engine on a TLC .cfg")
     pc.add_argument("cfg")
     pc.add_argument("--module", help="TLA+ module (default: cfg file stem)")
+    pc.add_argument(
+        "--run-dir",
+        help="run directory for this invocation's manifest + stats + spans "
+        "+ metrics (default: runs/<run_id>/ under $KSPEC_RUNS_ROOT or the "
+        "cwd; reopening an existing run dir resumes its run_id — "
+        "docs/observability.md).  Render it later with `cli report`",
+    )
     pc.add_argument("--sharded", action="store_true", help="mesh-sharded engine")
     pc.add_argument("--max-depth", type=int)
     pc.add_argument("--max-states", type=int)
@@ -349,6 +356,18 @@ def main(argv=None):
         "fallback when no reference checkout exists)",
     )
 
+    pr = sub.add_parser(
+        "report",
+        help="render a run directory (manifest + stats + spans + metrics + "
+        "events) into a human summary: per-level throughput, action "
+        "enablement, spill accounting, restart timeline, ETA, stall "
+        "verdict.  Works on live and crashed-mid-run directories; never "
+        "touches an accelerator",
+    )
+    pr.add_argument("run_dir")
+    pr.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
     po.add_argument("cfg")
     po.add_argument("--module")
@@ -400,6 +419,18 @@ def main(argv=None):
     )
 
     args = p.parse_args(argv)
+
+    if args.cmd == "report":
+        # a report must render on a box whose accelerator is wedged (that
+        # is when you want it most): obs never imports jax
+        from ..obs.report import render_report, report_data
+
+        if args.json:
+            print(json.dumps(report_data(args.run_dir), default=str))
+        else:
+            print(render_report(args.run_dir))
+        return 0
+
     from pathlib import Path
 
     module = args.module or Path(args.cfg).stem
@@ -445,8 +476,17 @@ def main(argv=None):
             and not os.environ.get(_CLI_CHILD_ENV)
         ):
             # default platform may be a hang-prone accelerator tunnel:
-            # run guarded (init-bounded child, CPU fallback)
-            return _guarded_reexec(argv if argv is not None else sys.argv[1:])
+            # run guarded (init-bounded child, CPU fallback).  Pin the run
+            # directory HERE so a CPU retry after a wedged default-platform
+            # attempt reopens the same run (one run_id per invocation, not
+            # per attempt)
+            child_argv = list(argv if argv is not None else sys.argv[1:])
+            if args.cmd == "check" and args.run_dir is None:
+                from ..obs import default_run_dir, new_run_id
+
+                args.run_dir = default_run_dir(new_run_id())
+                child_argv += ["--run-dir", args.run_dir]
+            return _guarded_reexec(child_argv)
         from .platform_guard import pin_cpu_in_process, reassert_env_pin
 
         if args.cpu:
@@ -538,6 +578,39 @@ def main(argv=None):
     model = _build_or_fail(
         module, tlc_cfg, emitted=_kernel_source(args, module)
     )
+    run_ctx = None
+    if args.cmd == "check" and _is_obs_coordinator():
+        # every check invocation gets a run directory: manifest + stats +
+        # spans + metrics correlated under one run_id (cli report renders
+        # it, live or post-mortem — docs/observability.md).  One writer
+        # per job: in a multi-process sharded run only process 0 opens
+        # the run dir (the replicated loops would otherwise race the
+        # manifest or strand never-finished orphan dirs)
+        from ..obs import RunContext
+
+        run_ctx = RunContext(args.run_dir)
+        run_ctx.record_config(
+            module=module,
+            cfg=args.cfg,
+            sharded=bool(args.sharded),
+            checkpoint=args.checkpoint,
+            stats=args.stats,
+        )
+        spill_defaulted = False
+        if args.mem_budget is not None and args.spill_dir is None \
+                and args.checkpoint is None:
+            # un-homed disk tier: spill under the run dir instead of an
+            # ephemeral tmp dir — a crashed run's spill is then
+            # inspectable next to its stats/spans.  Like the ephemeral
+            # tmp it replaces, it is deleted once the run completes
+            # (checkpointed runs keep <checkpoint>/spill: the tier lives
+            # and dies with the checkpoints that reference it)
+            args.spill_dir = run_ctx.spill_dir
+            spill_defaulted = True
+        print(
+            f"[obs] run dir: {run_ctx.dir} (run {run_ctx.run_id})",
+            file=sys.stderr,
+        )
     progress = None
     if args.progress:
         def progress(depth, new_n, total):
@@ -552,17 +625,42 @@ def main(argv=None):
         prof = jax.profiler.trace(args.profile)
     chunk_kw = {} if args.chunk_size is None else {"chunk_size": args.chunk_size}
     with prof:
-        res = _run_engine(args, model, tlc_cfg, progress, chunk_kw)
+        res = _run_engine(args, model, tlc_cfg, progress, chunk_kw,
+                          run=run_ctx)
+    if run_ctx is not None and spill_defaulted:
+        # completed run: the spilled fingerprint data is dead weight (the
+        # spill accounting lives on in metrics/spans); only a crash —
+        # which never reaches here — leaves it behind for post-mortems
+        import shutil
+
+        shutil.rmtree(run_ctx.spill_dir, ignore_errors=True)
     _print_result(res, args.json, model_meta=model.meta)
     return 0 if res.violation is None else 1
 
+
+
+def _is_obs_coordinator() -> bool:
+    """True unless this is a non-coordinator process of a multi-process
+    jax job (jax is initialized by model building before this runs)."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
 
 
 def _run_resilient(args, argv) -> int:
     """`check --resilient`: re-run this command under the supervisor.
 
     The child is this same CLI minus --resilient; engines resume from
-    --checkpoint automatically, so a restart is just a re-run."""
+    --checkpoint automatically, so a restart is just a re-run.  The parent
+    opens the run directory and hands it to every child attempt: one
+    run_id correlates the supervisor's events with each attempt's stats
+    and spans (a restart reopens the run, appending to its lineage)."""
+    from pathlib import Path
+
+    from ..obs import RunContext
     from ..resilience.supervisor import SupervisorConfig, supervise
 
     # strip the flag AND its argparse prefix abbreviations ("--resil" also
@@ -573,33 +671,44 @@ def _run_resilient(args, argv) -> int:
         for a in argv
         if not (a.startswith("--re") and "--resilient".startswith(a))
     ]
+    run_ctx = RunContext(args.run_dir)
+    if args.run_dir is None:
+        child_argv += ["--run-dir", run_ctx.dir]
+    if not args.stats:
+        # heartbeat lives in the run dir by default — the stall detector
+        # always has a stream to watch
+        args.stats = run_ctx.stats_path
+        child_argv += ["--stats", args.stats]
     if not args.checkpoint:
         print(
             "warning: --resilient without --checkpoint — a restarted run "
             "starts over from the initial states",
             file=sys.stderr,
         )
-    if not args.stats:
-        print(
-            "warning: --resilient without --stats — no heartbeat stream, "
-            "so the stall detector only sees child exits",
-            file=sys.stderr,
-        )
-    events = args.events or (
-        os.path.join(args.checkpoint, "supervisor_events.jsonl")
-        if args.checkpoint
-        else "RESILIENT_EVENTS.jsonl"
+    events = args.events or run_ctx.events_path
+    run_ctx.record_config(
+        module=args.module or Path(args.cfg).stem,
+        cfg=args.cfg,
+        supervised=True,
+        stall_timeout=args.stall_timeout,
+        max_restarts=args.max_restarts,
     )
     if args.checkpoint:
         os.makedirs(args.checkpoint, exist_ok=True)
+    print(
+        f"[obs] run dir: {run_ctx.dir} (run {run_ctx.run_id})",
+        file=sys.stderr,
+    )
     cfg = SupervisorConfig(
         cmd=[sys.executable, "-m", "kafka_specification_tpu.utils.cli"]
         + child_argv,
         heartbeat=args.stats,
         events=events,
+        log_dir=run_ctx.log_dir,
         stall_timeout=args.stall_timeout,
         max_restarts=args.max_restarts,
         env=dict(os.environ),
+        run_id=run_ctx.run_id,
     )
     return supervise(cfg)
 
@@ -645,11 +754,12 @@ def _build_or_fail(module, tlc_cfg, oracle=False, emitted=False, reference=None)
         raise SystemExit(2)
 
 
-def _run_engine(args, model, tlc_cfg, progress, chunk_kw):
+def _run_engine(args, model, tlc_cfg, progress, chunk_kw, run=None):
     store_kw = dict(
         mem_budget=args.mem_budget,
         spill_dir=args.spill_dir,
         store=args.store,
+        run=run,
     )
     if args.sharded:
         from ..parallel.sharded import check_sharded

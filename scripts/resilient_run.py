@@ -34,6 +34,7 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from kafka_specification_tpu.obs import RunContext  # noqa: E402 (jax-free)
 from kafka_specification_tpu.resilience.supervisor import (  # noqa: E402
     SupervisorConfig,
     supervise,
@@ -46,16 +47,25 @@ def main(argv=None):
         usage="%(prog)s [options] [--preset prod464 | -- CMD ...]",
     )
     ap.add_argument(
+        "--run-dir",
+        help="obs run directory (default: runs/<run_id>/) — the manifest, "
+        "supervisor events, per-attempt logs, and (when the child doesn't "
+        "say otherwise) the heartbeat all land here, correlated by one "
+        "run_id; render with `cli report` (docs/observability.md)",
+    )
+    ap.add_argument(
         "--heartbeat",
-        help="JSONL file the child appends progress to (growth = liveness)",
+        help="JSONL file the child appends progress to (growth = liveness; "
+        "default: <run-dir>/stats.jsonl)",
     )
     ap.add_argument(
         "--events",
-        default=os.path.join(_REPO, "RESILIENT_EVENTS.jsonl"),
-        help="supervisor JSONL event log",
+        help="supervisor JSONL event log (default: <run-dir>/events.jsonl)",
     )
     ap.add_argument(
-        "--log-dir", help="directory for per-attempt child stdout/stderr logs"
+        "--log-dir",
+        help="directory for per-attempt child stdout/stderr logs "
+        "(default: <run-dir>/logs/)",
     )
     ap.add_argument(
         "--stall-timeout",
@@ -99,6 +109,11 @@ def main(argv=None):
     cmd = args.cmd
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+    # one run_id for the whole supervised run: the manifest records the
+    # command + restart lineage, the events/heartbeat/logs live together,
+    # and `cli report <run-dir>` renders the result (legacy repo-root
+    # RUN*/TPU_* artifact paths remain honored when passed explicitly)
+    run_ctx = RunContext(args.run_dir)
     heartbeat = args.heartbeat
     if args.preset == "prod464":
         if cmd:
@@ -107,11 +122,9 @@ def main(argv=None):
         env.setdefault("KSPEC_PROD_CKPT", os.path.join(_REPO, ".prod464_ckpt"))
         env.setdefault("KSPEC_ADAPTIVE_COMPACT", "0")  # known-good config
         # watch the SAME path the child writes: a pre-set KSPEC_PROD_STATS
-        # wins over both the --heartbeat default and the repo default
+        # wins over both the --heartbeat flag and the run-dir default
         heartbeat = (
-            env.get("KSPEC_PROD_STATS")
-            or heartbeat
-            or os.path.join(_REPO, "RUNPROD464_stats.jsonl")
+            env.get("KSPEC_PROD_STATS") or heartbeat or run_ctx.stats_path
         )
         env["KSPEC_PROD_STATS"] = heartbeat
         if args.mem_budget:
@@ -129,17 +142,30 @@ def main(argv=None):
         ]
     if not cmd:
         ap.error("no command given (use -- CMD ... or --preset)")
-
+    heartbeat = heartbeat or run_ctx.stats_path
+    run_ctx.record_config(
+        supervised=True,
+        preset=args.preset,
+        cmd=cmd,
+        heartbeat=heartbeat,
+        stall_timeout=args.stall_timeout,
+        max_restarts=args.max_restarts,
+    )
+    print(
+        f"[obs] run dir: {run_ctx.dir} (run {run_ctx.run_id})",
+        file=sys.stderr,
+    )
     cfg = SupervisorConfig(
         cmd=cmd,
         heartbeat=heartbeat,
-        events=args.events,
-        log_dir=args.log_dir,
+        events=args.events or run_ctx.events_path,
+        log_dir=args.log_dir or run_ctx.log_dir,
         stall_timeout=args.stall_timeout,
         max_restarts=args.max_restarts,
         backoff_base=args.backoff,
         backoff_cap=args.backoff_cap,
         env=env,
+        run_id=run_ctx.run_id,
     )
     return supervise(cfg)
 

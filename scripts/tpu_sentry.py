@@ -37,12 +37,26 @@ sys.path.insert(0, _REPO)
 
 # the shared heartbeat envelope (kind/ts/unix) the resilient_run
 # supervisor consumes — jax-free import, safe in this tunnel-shy parent
+from kafka_specification_tpu.obs.runctx import new_run_id  # noqa: E402
 from kafka_specification_tpu.resilience.heartbeat import (  # noqa: E402
     append_jsonl,
     heartbeat_record,
 )
 
-_LOG = os.path.join(_REPO, "TPU_SENTRY.jsonl")
+# KSPEC_RUN_DIR routes the sentry log under an obs run directory
+# (<run-dir>/sentry.jsonl); the legacy repo-root TPU_SENTRY.jsonl remains
+# the default so existing tooling keeps tailing the same file.  Either
+# way every record is stamped with this sentry instance's run_id, so a
+# whole round's attempts correlate.
+_RUN_DIR = os.environ.get("KSPEC_RUN_DIR")
+_LOG = (
+    os.path.join(_RUN_DIR, "sentry.jsonl")
+    if _RUN_DIR
+    else os.path.join(_REPO, "TPU_SENTRY.jsonl")
+)
+if _RUN_DIR:
+    os.makedirs(_RUN_DIR, exist_ok=True)
+_RUN_ID = os.environ.get("KSPEC_RUN_ID") or new_run_id()
 _PERIOD = int(os.environ.get("KSPEC_SENTRY_PERIOD", "1800"))
 _HOURS = float(os.environ.get("KSPEC_SENTRY_HOURS", "12"))
 _OUTCOME = {0: "live", 4: "cpu-only", 5: "wedged"}
@@ -80,6 +94,7 @@ def _attempt(n):
     line = heartbeat_record(
         "sentry",
         t=t0,
+        run_id=_RUN_ID,
         attempt=n,
         seconds=round(time.time() - t0, 1),
         rc=rc,

@@ -1,0 +1,407 @@
+"""Observability subsystem: run dirs, spans, metrics, report, CLI.
+
+Tier-1 contracts (ISSUE 3):
+- a crashed-mid-level run directory still renders a report;
+- span JSONL lines are untearable (a torn FINAL line is tolerated, exactly
+  like the mosaic ladder's append-only banking);
+- the `stats_path` shim emits records identical to the pre-obs stream on a
+  known model (volatile wall-clock fields aside);
+- `cli check --run-dir` + `cli report` works on both engines, including
+  with a forced tiny `--mem-budget` (spill accounting) and under the
+  `KSPEC_FAULT=crash@level` injector.
+"""
+
+import json
+import os
+
+import pytest
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import finite_replicated_log as frl
+from kafka_specification_tpu.obs import (
+    MetricsRegistry,
+    RunContext,
+    SpanTracer,
+    read_jsonl_tolerant,
+    render_report,
+    report_data,
+)
+from kafka_specification_tpu.obs.report import eta
+from kafka_specification_tpu.obs.tracer import parse_xprof, set_tracer
+from kafka_specification_tpu.resilience.faults import InjectedCrash
+from kafka_specification_tpu.utils.cli import main as cli_main
+
+pytestmark = pytest.mark.obs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MINI_RUN = os.path.join(_REPO, "tests", "data", "mini_run")
+
+# volatile fields: wall-clock and run-correlation stamps; everything else
+# in a level record is deterministic for a fixed model
+_VOLATILE = ("ts", "unix", "level_ms", "step_ms", "host_ms", "run_id")
+
+
+def _strip(rec):
+    return {k: v for k, v in rec.items() if k not in _VOLATILE}
+
+
+def _records(path):
+    return [json.loads(l) for l in open(path).read().splitlines()]
+
+
+# --- run context ---------------------------------------------------------
+
+
+def test_run_context_manifest_and_resume_lineage(tmp_path):
+    d = str(tmp_path / "r")
+    run = RunContext(d)
+    man = json.load(open(run.manifest_path))
+    assert man["run_id"] == run.run_id
+    assert man["status"] == "running"
+    assert man["lineage"][0]["event"] == "open"
+    run.record_config(module="Toy", engine="bfs")
+    run.finish("complete", distinct_states=42)
+    man = json.load(open(run.manifest_path))
+    assert man["status"] == "complete"
+    assert man["result"]["distinct_states"] == 42
+    assert man["config"]["module"] == "Toy"
+    # reopening the same directory resumes the SAME run_id and appends to
+    # the lineage (supervised restarts correlate under one run)
+    run2 = RunContext(d)
+    assert run2.run_id == run.run_id
+    man = json.load(open(run.manifest_path))
+    assert [e["event"] for e in man["lineage"]][-1] == "reopen"
+    assert man["status"] == "running"
+
+
+def test_default_run_dir_honors_runs_root(tmp_path, monkeypatch):
+    monkeypatch.setenv("KSPEC_RUNS_ROOT", str(tmp_path / "allruns"))
+    run = RunContext()
+    assert run.dir.startswith(str(tmp_path / "allruns"))
+    assert os.path.isfile(run.manifest_path)
+
+
+# --- span tracer ---------------------------------------------------------
+
+
+def test_tracer_nesting_and_event(tmp_path):
+    p = str(tmp_path / "spans.jsonl")
+    tr = SpanTracer(p, "run-x")
+    with tr.span("outer", depth=3):
+        with tr.span("inner", item=1):
+            pass
+        tr.event("retry", attempt=1)
+    tr.close()
+    recs = read_jsonl_tolerant(p)
+    assert [r.get("span", r.get("event")) for r in recs] == [
+        "inner", "retry", "outer",
+    ]
+    inner, ev, outer = recs
+    assert inner["parent_id"] == outer["span_id"] != inner["span_id"]
+    assert all(r["run_id"] == "run-x" for r in recs)
+    assert all(r["unix"] >= r["t0"] for r in (inner, outer))
+    assert ev["kind"] == "event" and ev["attempt"] == 1
+
+
+def test_span_jsonl_untearable_torn_lines(tmp_path):
+    """Mirror of the ladder fix: a hard kill can tear at most the final
+    appended line.  A supervised restart then appends PAST the tear (one
+    shared file per run dir), so the reader must skip torn lines anywhere
+    and keep every intact record around them."""
+    p = str(tmp_path / "spans.jsonl")
+    tr = SpanTracer(p, "run-x")
+    for i in range(5):
+        with tr.span("level", depth=i):
+            pass
+    tr.close()
+    whole = open(p, "rb").read()
+    torn = whole[: len(whole) - 17]  # rip through the last record
+    open(p, "wb").write(torn)
+    recs = read_jsonl_tolerant(p)
+    assert len(recs) == 4 and recs[-1]["depth"] == 3
+    # a tear mid-file (kill, then restart appended after it): the records
+    # on both sides survive, only the torn line is dropped
+    lines = whole.split(b"\n")
+    lines[1] = lines[1][:10]
+    open(p, "wb").write(b"\n".join(lines))
+    recs = read_jsonl_tolerant(p)
+    assert [r["depth"] for r in recs] == [0, 2, 3, 4]
+
+
+def test_xprof_env_parse():
+    assert parse_xprof(None) is None
+    assert parse_xprof("level") == ("level", 0, float("inf"))
+    assert parse_xprof("level:3") == ("level", 3, 3)
+    assert parse_xprof("spill-merge:2-7") == ("spill-merge", 2, 7)
+    with pytest.raises(ValueError):
+        parse_xprof("level:x")
+    with pytest.raises(ValueError):
+        parse_xprof(":3")
+
+
+# --- metrics registry ----------------------------------------------------
+
+
+def test_metrics_registry_and_prom_export(tmp_path):
+    m = MetricsRegistry("run-y")
+    m.inc("kspec_states_total", 10)
+    m.inc("kspec_states_total", 5)
+    m.set_gauge("kspec_frontier", 123)
+    m.set_gauge("kspec_shard_new", 7, shard=1)
+    m.observe("kspec_level_ms", 42.0)
+    m.observe("kspec_level_ms", 9000.0)
+    snap = m.snapshot()
+    assert snap["counters"]["kspec_states_total"] == 15
+    assert snap["gauges"]['kspec_shard_new{shard="1"}'] == 7
+    assert snap["histograms"]["kspec_level_ms"]["count"] == 2
+    prom = str(tmp_path / "m.prom")
+    m.write_prom(prom)
+    text = open(prom).read()
+    assert "# TYPE kspec_states_total counter" in text
+    assert 'kspec_states_total{run_id="run-y"} 15' in text
+    assert "# TYPE kspec_frontier gauge" in text
+    assert 'kspec_shard_new{shard="1",run_id="run-y"} 7' in text
+    # histogram: cumulative buckets + sum + count, all run_id-labelled
+    assert 'kspec_level_ms_bucket{le="50",run_id="run-y"} 1' in text
+    assert 'kspec_level_ms_bucket{le="+Inf",run_id="run-y"} 2' in text
+    assert 'kspec_level_ms_count{run_id="run-y"} 2' in text
+    jl = str(tmp_path / "m.jsonl")
+    m.write_jsonl(jl)
+    rec = _records(jl)[0]
+    assert rec["kind"] == "metrics" and rec["run_id"] == "run-y"
+
+
+# --- stats shim equivalence ---------------------------------------------
+
+
+def test_stats_shim_record_for_record_identical(tmp_path):
+    """The legacy stats_path stream must be unchanged by the obs refactor:
+    same record set with and without a run context (minus the volatile
+    wall-clock fields and the run_id stamp), no run_id on the bare path,
+    and file records == result.stats['levels']."""
+    bare = str(tmp_path / "bare.jsonl")
+    r1 = check(frl.make_model(2, 2, 2), min_bucket=32, stats_path=bare)
+    run = RunContext(str(tmp_path / "run"))
+    r2 = check(frl.make_model(2, 2, 2), min_bucket=32, run=run)
+    assert r1.total == r2.total == 49
+    recs_bare = _records(bare)
+    recs_run = _records(run.stats_path)
+    assert [_strip(r) for r in recs_bare] == [_strip(r) for r in recs_run]
+    # legacy schema exactly: envelope + historical fields, nothing else
+    assert list(recs_bare[0]) == [
+        "kind", "ts", "unix", "depth", "frontier", "enabled_candidates",
+        "new", "duplicates", "total", "level_ms", "step_ms", "host_ms",
+        "action_enablement",
+    ]
+    assert all("run_id" not in r for r in recs_bare)
+    assert all(r["run_id"] == run.run_id for r in recs_run)
+    assert recs_bare == r1.stats["levels"]
+
+
+# --- engine-threaded run dirs -------------------------------------------
+
+
+def test_run_dir_artifacts_single_device(tmp_path):
+    run = RunContext(str(tmp_path / "run"))
+    res = check(frl.make_model(2, 2, 2), min_bucket=32, run=run)
+    assert res.total == 49
+    man = json.load(open(run.manifest_path))
+    assert man["status"] == "complete"
+    assert man["result"]["distinct_states"] == 49
+    assert man["config"]["engine"] == "bfs"
+    spans = read_jsonl_tolerant(run.spans_path)
+    kinds = {(s.get("span"), s.get("ph")) for s in spans}
+    assert ("level", "B") in kinds and ("level", "E") in kinds
+    assert ("step", "E") in kinds and ("host-assembly", "E") in kinds
+    prom = open(run.metrics_prom).read()
+    assert f'kspec_states_total{{run_id="{run.run_id}"}} 48' in prom
+    assert "kspec_level_ms_bucket" in prom
+    report = render_report(run.dir)
+    assert "COMPLETE" in report and "Action enablement" in report
+
+
+def test_sharded_per_shard_breakdowns_and_imbalance(tmp_path):
+    from kafka_specification_tpu.parallel.sharded import check_sharded
+
+    run = RunContext(str(tmp_path / "run"))
+    res = check_sharded(frl.make_model(2, 2, 2), min_bucket=32, run=run)
+    assert res.total == 49
+    recs = _records(run.stats_path)
+    import jax
+
+    D = len(jax.devices())
+    for rec in recs:
+        # satellite: per-shard breakdowns ride every level record so
+        # exchange imbalance is visible without re-running
+        assert len(rec["shard_new"]) == D
+        assert len(rec["shard_frontier"]) == D
+        assert len(rec["shard_enabled"]) == D
+        assert sum(rec["shard_new"]) == rec["new"]
+        assert sum(rec["shard_frontier"]) == rec["frontier"]
+        assert sum(rec["shard_enabled"]) == rec["enabled_candidates"]
+    assert res.stats["levels"] == recs
+    prom = open(run.metrics_prom).read()
+    assert "kspec_shard_imbalance" in prom
+    assert f'kspec_shard_new{{shard="0",run_id="{run.run_id}"}}' in prom
+    spans = read_jsonl_tolerant(run.spans_path)
+    assert any(s.get("span") == "exchange" for s in spans)
+
+
+def test_sharded_host_backend_shard_duplicates(tmp_path):
+    from kafka_specification_tpu.parallel.sharded import check_sharded
+
+    run = RunContext(str(tmp_path / "run"))
+    res = check_sharded(
+        frl.make_model(2, 2, 2), min_bucket=32, visited_backend="host",
+        run=run,
+    )
+    assert res.total == 49
+    recs = _records(run.stats_path)
+    # host backend: the coordinator sees the novelty masks, so per-owner
+    # duplicate counts are exact and present
+    assert all("shard_duplicates" in r for r in recs)
+    assert all(
+        all(d >= 0 for d in r["shard_duplicates"]) for r in recs
+    )
+
+
+# --- crash + report (acceptance criterion) ------------------------------
+
+
+def test_crashed_mid_level_run_dir_still_renders(tmp_path, monkeypatch):
+    monkeypatch.setenv("KSPEC_FAULT", "crash@level:2")
+    run = RunContext(str(tmp_path / "run"))
+    with pytest.raises(InjectedCrash):
+        check(frl.make_model(2, 2, 2), min_bucket=32, run=run)
+    set_tracer(None)  # the crash skipped the observer's teardown
+    # manifest still says "running" (nobody finalized it) + dead pid in a
+    # subprocess world; in-process the pid is alive, so force the verdict
+    # path that only depends on heartbeat age by rendering "now" far ahead
+    rep = render_report(run.dir, now=__import__("time").time() + 10_000)
+    assert "Run " + run.run_id in rep
+    assert "Per-level throughput" in rep
+    assert ("STALLED" in rep) or ("CRASHED" in rep)
+    data = report_data(run.dir, now=__import__("time").time() + 10_000)
+    assert data["verdict"]["status"] in ("stalled", "crashed")
+    assert len(data["levels"]) >= 1  # the levels before the crash survive
+
+
+def test_report_on_empty_and_partial_dirs(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rep = render_report(str(empty))
+    assert "No per-level stats" in rep
+    # stats only, no manifest — e.g. artifacts copied off a dead box
+    part = tmp_path / "part"
+    part.mkdir()
+    (part / "stats.jsonl").write_text(
+        json.dumps({"kind": "level", "unix": 1.0, "depth": 1, "frontier": 1,
+                    "new": 3, "enabled_candidates": 4, "duplicates": 1,
+                    "total": 4, "level_ms": 10.0}) + "\n"
+    )
+    rep = render_report(str(part))
+    assert "Per-level throughput" in rep
+
+
+def test_eta_fit_directions():
+    def lv(depth, new):
+        return {"kind": "level", "depth": depth, "new": new,
+                "level_ms": 1000.0, "total": 0}
+
+    shrink = [lv(i, int(1e6 * 0.5 ** i)) for i in range(1, 8)]
+    e = eta(shrink)
+    assert e["status"] == "fit" and e["growth_ratio"] < 1
+    assert e["est_remaining_states"] > 0 and "eta_seconds" in e
+    grow = [lv(i, 10 * 2 ** i) for i in range(1, 8)]
+    e = eta(grow)
+    assert e["growth_ratio"] > 1 and "eta_seconds" not in e
+    assert eta([lv(1, 5)])["status"] == "insufficient-data"
+
+
+# --- CLI -----------------------------------------------------------------
+
+
+def test_cli_check_run_dir_then_report(tmp_path, capsys):
+    d = str(tmp_path / "run")
+    rc = cli_main(
+        ["check", os.path.join(_REPO, "configs", "IdSequence.cfg"),
+         "--hand", "--run-dir", d, "--json"]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = cli_main(["report", d])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[COMPLETE]" in out
+    assert "Per-level throughput" in out
+    assert "Action enablement" in out
+    assert "NextId" in out
+    assert "Stall verdict: complete" in out
+    rc = cli_main(["report", d, "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert data["verdict"]["status"] == "complete"
+    assert data["manifest"]["config"]["module"] == "IdSequence"
+
+
+@pytest.mark.spill
+def test_cli_spill_run_dir_report_both_engines(tmp_path, capsys):
+    """Acceptance criterion: --mem-budget spill accounting shows up in
+    `cli report` on both engines (the forced tiny budget spills runs)."""
+    for tag, extra in (("b", []), ("s", ["--sharded"])):
+        d = str(tmp_path / f"run{tag}")
+        rc = cli_main(
+            ["check", os.path.join(_REPO, "configs", "IdSequence.cfg"),
+             "--hand", "--run-dir", d, "--mem-budget", "1K", "--json"]
+            + extra
+        )
+        assert rc == 0
+        capsys.readouterr()
+        assert cli_main(["report", d]) == 0
+        out = capsys.readouterr().out
+        assert "spill" in out.lower(), out
+        assert "kspec_spill_runs" in out
+
+
+def test_cli_report_mini_run_smoke(capsys):
+    """Fast-suite smoke over the checked-in miniature run directory: a
+    supervised sharded spill run killed mid-level (the post-mortem case
+    the report exists for)."""
+    assert cli_main(["report", _MINI_RUN]) == 0
+    out = capsys.readouterr().out
+    assert "[CRASHED]" in out or "[STALLED]" in out
+    assert "died mid-level: level 9" in out
+    assert "Per-level throughput" in out
+    assert "imbalance max/mean" in out
+    assert "LeaderWrite" in out
+    assert "kspec_spill_disk_fps" in out
+    assert "stall-kill" in out and "restart" in out and "retry" in out
+    assert "ETA: frontier decaying" in out
+    # torn-final-line tolerance end to end: report survives a ripped tail
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp()
+    dst = os.path.join(tmp, "mini")
+    shutil.copytree(_MINI_RUN, dst)
+    with open(os.path.join(dst, "stats.jsonl"), "ab") as fh:
+        fh.write(b'{"kind": "level", "torn": tr')
+    assert cli_main(["report", dst]) == 0
+    assert "Per-level throughput" in capsys.readouterr().out
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_supervisor_events_run_id_stamped(tmp_path):
+    from kafka_specification_tpu.resilience.supervisor import (
+        SupervisorConfig,
+        supervise,
+    )
+
+    ev = str(tmp_path / "events.jsonl")
+    cfg = SupervisorConfig(
+        cmd=["true"], events=ev, max_restarts=0, run_id="run-z"
+    )
+    assert supervise(cfg) == 0
+    events = _records(ev)
+    assert [e["event"] for e in events] == ["start", "exit", "complete"]
+    assert all(e["run_id"] == "run-z" for e in events)
+    assert all(e["kind"] == "supervisor" for e in events)
