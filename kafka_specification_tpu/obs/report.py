@@ -36,6 +36,7 @@ _EVENT_KINDS = (
     "retry",
     "compile-fallback",
     "checkpoint-fallback",
+    "elastic-reshard",
     "xprof-start",
     "xprof-stop",
 )
@@ -60,6 +61,19 @@ def load_run(run_dir: str) -> dict:
 
     spans = jsonl("spans.jsonl")
     metrics = jsonl("metrics.jsonl")
+    # per-process shard heartbeats (parallel/sharded.py writes one file
+    # per process under <run-dir>/shards/): the only stream that tells a
+    # multiprocess run's processes apart after the fact
+    shard_streams = []
+    shard_dir = os.path.join(run_dir, "shards")
+    if os.path.isdir(shard_dir):
+        for name in sorted(os.listdir(shard_dir)):
+            if name.startswith("proc") and name.endswith(".jsonl"):
+                recs = read_jsonl_tolerant(os.path.join(shard_dir, name))
+                recs = [r for r in recs
+                        if r.get("kind") == "shard-heartbeat"]
+                if recs:
+                    shard_streams.append(recs)
     return {
         "dir": run_dir,
         "manifest": maybe_json("manifest.json") or {},
@@ -68,6 +82,7 @@ def load_run(run_dir: str) -> dict:
         "spans": [s for s in spans if s.get("kind") == "span"],
         "obs_events": [s for s in spans if s.get("kind") == "event"],
         "metrics": metrics[-1] if metrics else None,
+        "shard_heartbeats": shard_streams,
     }
 
 
@@ -94,6 +109,8 @@ def verdict(data: dict, now: Optional[float] = None) -> dict:
     beats = [r.get("unix") for r in data["levels"] if r.get("unix")]
     beats += [r.get("unix") for r in data["spans"] if r.get("unix")]
     beats += [r.get("unix") for r in data["events"] if r.get("unix")]
+    for stream in data.get("shard_heartbeats", ()):
+        beats += [r.get("unix") for r in stream if r.get("unix")]
     last = max(beats) if beats else man.get("unix") or man.get("created_unix")
     age = (now - last) if last else None
     timeout = float(
@@ -137,6 +154,49 @@ def verdict(data: dict, now: Optional[float] = None) -> dict:
         "detail": {"last_heartbeat_age_s": round(age, 1) if age is not None
                    else None},
     }
+
+
+def _shard_proc_summary(data: dict) -> list:
+    """One row per process of a (multi)process sharded run, from its
+    shard-heartbeat stream: pid, owned shards, last completed level."""
+    procs = []
+    for stream in data.get("shard_heartbeats", ()):
+        last = stream[-1]
+        procs.append({
+            "proc": last.get("proc"),
+            "pid": last.get("pid"),
+            "shards": last.get("shards"),
+            "last_depth": max(
+                (r.get("depth") for r in stream
+                 if r.get("depth") is not None),
+                default=None,
+            ),
+            "last_unix": last.get("unix"),
+            "alive": _pid_alive(last.get("pid")),
+            "finished": any(r.get("event") == "finish" for r in stream),
+        })
+    return procs
+
+
+def _died_shards(procs: list) -> list:
+    """Which process(es) a died-mid-level verdict points at.
+
+    Preference order: known-dead pids that never finished; else any
+    unfinished process.  Among those, the one(s) that stopped a level
+    behind the rest died first (a lockstep fleet cannot advance past a
+    dead peer, so the laggard is the culprit); a level tie falls back to
+    the stalest heartbeat."""
+    cands = [p for p in procs if p["alive"] is False and not p["finished"]]
+    if not cands:
+        cands = [p for p in procs if not p["finished"]]
+    if not cands:
+        return []
+    lo = min((p["last_depth"] or 0) for p in cands)
+    behind = [p for p in cands if (p["last_depth"] or 0) == lo]
+    if len(behind) < len(cands) or len(cands) == 1:
+        return behind
+    t = min((p["last_unix"] or 0) for p in cands)
+    return [p for p in cands if (p["last_unix"] or 0) == t]
 
 
 def eta(levels: list, window: int = 5) -> dict:
@@ -240,11 +300,18 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
         if s.get("span") == "level" and s.get("ph") == "B" \
                 and s.get("depth") not in closed:
             open_level = s.get("depth")
+    shard_procs = _shard_proc_summary(data)
+    vd = verdict(data, now=now)
+    died = (
+        _died_shards(shard_procs)
+        if vd["status"] in ("crashed", "stalled")
+        else []
+    )
     return {
         "run_id": man.get("run_id") or os.path.basename(data["dir"]),
         "dir": data["dir"],
         "manifest": man,
-        "verdict": verdict(data, now=now),
+        "verdict": vd,
         "levels": levels,
         "actions": actions,
         "spill": spill,
@@ -252,6 +319,8 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
         "timeline": timeline,
         "eta": eta(levels),
         "open_level": open_level,
+        "shard_procs": shard_procs,
+        "died_shards": died,
     }
 
 
@@ -285,6 +354,24 @@ def render_report(run_dir: str, now: Optional[float] = None,
     if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
         out.append(f"  died mid-level: level {r['open_level']} began but "
                    f"never completed")
+    if r["died_shards"] and v["status"] in ("crashed", "stalled"):
+        # multiprocess attribution: WHICH process took the run down (its
+        # peers wedge in the next collective, so the laggard is causal)
+        for p in r["died_shards"]:
+            shards = p.get("shards") or []
+            out.append(
+                "  attributed to shard(s) "
+                + ",".join(str(s) for s in shards)
+                + f" (process {p['proc']}, pid {p['pid']}"
+                + (", pid dead" if p["alive"] is False else "")
+                + f", last completed level {p['last_depth']})"
+            )
+    if r["shard_procs"] and len(r["shard_procs"]) > 1:
+        depths = [p["last_depth"] for p in r["shard_procs"]]
+        out.append(
+            f"  processes: {len(r['shard_procs'])}; last completed level "
+            f"per process {depths}"
+        )
     # --- levels table -----------------------------------------------------
     if levels:
         out.append("")

@@ -68,7 +68,9 @@ from ..obs.observer import RunObserver
 from ..ops import dedup, hashset
 from ..resilience.checkpoints import CheckpointStore
 from ..resilience.faults import FaultPlan
+from ..resilience.heartbeat import append_jsonl, heartbeat_record
 from ..resilience.retry import ChunkRetryHandler
+from ..storage.parent_log import ShardedParentLog
 from .multihost import (
     fetch_global,
     is_coordinator,
@@ -343,6 +345,185 @@ def _make_sharded_step(
     return jax.jit(sharded)
 
 
+def _elastic_reshard(
+    snap,
+    part_arrays,
+    old_D: int,
+    old_P: int,
+    old_pending,
+    *,
+    D: int,
+    spec,
+    visited_backend: str,
+    use_disk: bool,
+    host_sets,
+    shard_proc,
+    my_proc: int,
+    spill_base,
+    vcap: int,
+    shard_visited,
+):
+    """Re-bucket a D-shard checkpoint onto the current D-shard layout.
+
+    Ownership is pure fingerprint arithmetic (owner = fp_lo mod D), so an
+    elastic resume is a deterministic re-bucketing of every piece of
+    persisted state — the pending frontiers and the visited fingerprints
+    of whichever backend the run uses — with no re-exploration:
+
+    - pending rows are re-fingerprinted and dealt to their new owners
+      (within a shard the old concatenated order is preserved, so the
+      re-bucketing is deterministic and the parent-log boundary rewrite
+      can mirror it);
+    - device / device-hash shards are rebuilt from the snapshot's live
+      fingerprint pairs;
+    - host FpSets are rebuilt from the (possibly per-host-part) dumps;
+    - tiered disk sets re-insert every old shard's hot dump + run files
+      into the new shards' sets.  Old run files are NOT deleted: they go
+      behind the new sets' checkpoint-generation deletion barrier (new
+      run numbering continues past them), so every retained pre-reshard
+      generation still resolves until it rotates away.
+
+    Returns (pending, host_sets, vhi, vlo, vn, vcap, shard_visited) with
+    only the backend-relevant entries changed.
+    """
+    K = spec.num_lanes
+    vhi = vlo = vn = None
+
+    rows_all = (
+        np.concatenate(old_pending)
+        if any(p.shape[0] for p in old_pending)
+        else np.empty((0, K), np.uint32)
+    )
+    if rows_all.shape[0]:
+        rhi, rlo = fingerprint_lanes(jnp.asarray(rows_all), spec.exact64)
+        rowner = np.asarray(rlo).astype(np.int64) % D
+    else:
+        rowner = np.empty(0, np.int64)
+    pending = [rows_all[rowner == d] for d in range(D)]
+
+    if visited_backend == "host" and use_disk:
+        from ..storage.runs import SortedRun
+
+        srcs = (
+            [part_arrays[f"host{p}"] for p in range(old_P)]
+            if old_P > 1
+            else [snap]
+        )
+        old_mans = [None] * old_D
+        old_hots = [np.empty(0, np.uint64)] * old_D
+        for src in srcs:
+            mans = json.loads(str(src["spill_manifest"]))
+            hot_flat, lens = src["host_hot"], src["host_hot_lens"]
+            at = 0
+            for d, ln in enumerate(lens):
+                ln = int(ln)
+                if mans[d] is not None:
+                    old_mans[d] = mans[d]
+                    old_hots[d] = np.asarray(
+                        hot_flat[at : at + ln], np.uint64
+                    )
+                at += ln
+        # continue run numbering past every old layout's files so a
+        # re-used shard directory never collides with barrier-protected
+        # old runs
+        next_seq = max(
+            (int(m["seq"]) for m in old_mans if m is not None), default=0
+        )
+        for d in range(D):
+            if host_sets[d] is not None:
+                host_sets[d].seq = next_seq
+
+        def deal(fps: np.ndarray) -> None:
+            # re-bucket one source array; the new sets spill past their
+            # budgets as usual, so peak residency stays O(one old run),
+            # never O(visited) — the whole point of the disk tier
+            fo = (fps & np.uint64(0xFFFFFFFF)).astype(np.int64) % D
+            for d in range(D):
+                if host_sets[d] is None:
+                    continue
+                sel = fps[fo == d]
+                if len(sel):
+                    host_sets[d].insert(sel)
+
+        for k in range(old_D):
+            old_files = []
+            deal(old_hots[k])
+            if old_mans[k] is not None:
+                shard_dir = os.path.join(spill_base, f"shard{k}")
+                for m in old_mans[k]["runs"]:
+                    r = SortedRun(shard_dir, m, verify=True)
+                    deal(np.asarray(r.arr))
+                    old_files.append(r.path)
+                # in-flight deferred deletions from the old layout keep
+                # aging out under the new sets' barriers
+                old_files.extend(
+                    os.path.normpath(os.path.join(shard_dir, p))
+                    for _, p in old_mans[k].get("pending_delete", ())
+                )
+            # retire the old layout's files behind the deletion barrier
+            # of a deterministic owner (old shard k -> new set k mod D),
+            # so every retained pre-reshard generation still resolves
+            tgt = host_sets[k % D]
+            if tgt is not None and old_files:
+                tgt.deleter.schedule(old_files)
+    elif visited_backend == "host":
+        from ..native import FpSet
+
+        if old_P > 1:
+            all_fps = np.concatenate(
+                [np.asarray(part_arrays[f"host{p}"]["host_fps"], np.uint64)
+                 for p in range(old_P)]
+            )
+        else:
+            all_fps = np.asarray(snap["host_fps"], np.uint64)
+        fowner = (all_fps & np.uint64(0xFFFFFFFF)).astype(np.int64) % D
+        host_sets = []
+        for d in range(D):
+            if shard_proc[d] != my_proc:
+                host_sets.append(None)
+                continue
+            sel = all_fps[fowner == d]
+            s = FpSet(initial_capacity=max(64, 2 * len(sel)))
+            if len(sel):
+                s.insert(sel)
+            host_sets.append(s)
+    elif visited_backend == "device-hash":
+        flat_hi = np.asarray(snap["hash_hi"], np.uint32)
+        flat_lo = np.asarray(snap["hash_lo"], np.uint32)
+        howner = flat_lo.astype(np.int64) % D
+        per_shard = [
+            (flat_hi[howner == d], flat_lo[howner == d]) for d in range(D)
+        ]
+        shard_visited = np.asarray(
+            [len(h) for h, _ in per_shard], np.int64
+        )
+        vhi, vlo, vcap = _shard_tables_from_pairs(per_shard, _HASH_MIN_CAP)
+        vn = np.zeros((D,), np.int32)
+    else:  # device: sorted per-shard pair sets
+        vn_old = snap["vn"]
+        his, los = [], []
+        for d in range(old_D):
+            n = int(vn_old[d])
+            his.append(np.asarray(snap["vhi"])[d, :n])
+            los.append(np.asarray(snap["vlo"])[d, :n])
+        all_hi = np.concatenate(his) if his else np.empty(0, np.uint32)
+        all_lo = np.concatenate(los) if los else np.empty(0, np.uint32)
+        downer = all_lo.astype(np.int64) % D
+        counts = np.bincount(downer, minlength=D)
+        vcap = _next_pow2(max(1024, 2 * int(counts.max() if len(counts) else 1)))
+        vhi = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vlo = np.full((D, vcap), 0xFFFFFFFF, np.uint32)
+        vn = np.zeros((D,), np.int32)
+        for d in range(D):
+            sel = np.nonzero(downer == d)[0]
+            order = np.lexsort((all_lo[sel], all_hi[sel]))
+            vhi[d, : len(sel)] = all_hi[sel][order]
+            vlo[d, : len(sel)] = all_lo[sel][order]
+            vn[d] = len(sel)
+
+    return pending, host_sets, vhi, vlo, vn, vcap, shard_visited
+
+
 def check_sharded(
     model: Model,
     mesh: Optional[Mesh] = None,
@@ -364,6 +545,7 @@ def check_sharded(
     spill_dir: Optional[str] = None,
     store: str = "auto",
     run=None,
+    shard_heartbeat_dir: Optional[str] = None,
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -376,17 +558,32 @@ def check_sharded(
     checkpoint_dir: level-synchronous checkpoint/resume — persists the
     per-shard pending frontiers and fingerprint shards every
     `checkpoint_every` levels (default 1 = per level; a crash loses at most
-    checkpoint_every-1 levels); a run restarts from the last saved level
-    (store_trace forced off, as in engine.check).  A checkpoint binds to
-    (model, constants, invariant selection, deadlock flag, mesh size).
-    Checkpoints are hardened as in engine.check (resilience.checkpoints):
-    per-array checksums, keep-last-`checkpoint_keep` rotation with atomic
-    promote, automatic fallback to the newest verifying generation, and —
-    for the per-host FpSet part files — a cross-shard level-consistency
-    check: a generation whose parts disagree with the main file's level
-    (crash between the part and main writes) is treated as torn and
-    skipped.  Fault injection (`KSPEC_FAULT`) and transient-error retry
-    mirror engine.check, with the injection point at the exchange step.
+    checkpoint_every-1 levels); a run restarts from the last saved level.
+    A checkpoint binds to (model, constants, invariant selection, deadlock
+    flag) — NOT to the mesh layout: the writing layout is stamped
+    (mesh_D/mesh_P) and resuming on a different shard or process count
+    takes the ELASTIC path, re-bucketing fingerprint-range ownership onto
+    the new mesh (docs/resilience.md § Distributed resilience).  With
+    store_trace requested, each level's (rows, parent, action) slices are
+    also published to per-shard on-disk parent logs under
+    `<checkpoint_dir>/plog/`, so a violation found AFTER a resume still
+    reports the full counterexample trace (the in-RAM trace store remains
+    off for checkpointed runs).  Checkpoints are hardened as in
+    engine.check (resilience.checkpoints): per-array checksums,
+    keep-last-`checkpoint_keep` rotation with atomic promote, automatic
+    fallback to the newest verifying generation, and — for the per-host
+    FpSet part files — a cross-shard consistency check: a generation
+    whose parts disagree with the main file's level (or mesh layout) is
+    treated as torn and skipped.  Fault injection (`KSPEC_FAULT`,
+    including shard-targeted `crash@shard<d>:level:N` scoping) and
+    transient-error retry mirror engine.check, with the injection point
+    at the exchange step.
+
+    shard_heartbeat_dir (or $KSPEC_SHARD_HEARTBEAT_DIR, or `<run
+    dir>/shards` when a run context is given): every process appends one
+    heartbeat line per BFS level to `proc<i>.jsonl` there — the fleet
+    supervisor's per-shard liveness signal and `cli report`'s
+    died-mid-level shard attribution.
 
     compact_shift: two-phase expansion (see engine.check) — guards sweep the
     full lattice, update/pack/sort/exchange run at 1/2^shift of it.  0
@@ -427,6 +624,13 @@ def check_sharded(
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
     D = mesh.devices.size
+    # per-process shard heartbeat stream (the fleet supervisor's per-shard
+    # liveness signal and `cli report`'s died-mid-level attribution): every
+    # process — not just the obs coordinator — appends one line per level
+    # to <dir>/proc<i>.jsonl
+    hb_dir = shard_heartbeat_dir or os.environ.get("KSPEC_SHARD_HEARTBEAT_DIR")
+    if hb_dir is None and run is not None:
+        hb_dir = os.path.join(run.dir, "shards")
     if run is not None and not is_coordinator():
         run = None
     obs_ = RunObserver(run, stats_path, engine="sharded")
@@ -509,6 +713,7 @@ def check_sharded(
     # which process hosts each shard's device (per-host FpSet ownership)
     shard_proc = [int(dev.process_index) for dev in mesh.devices.flat]
     my_proc = jax.process_index()
+    my_shards = [d for d in range(D) if shard_proc[d] == my_proc]
     if visited_backend == "host":
         from ..native import FpSet
 
@@ -628,6 +833,32 @@ def check_sharded(
         return dens.max(axis=0)
 
     fault = FaultPlan.from_env()
+    # shard-targeted faults (crash@shard<d>:..., docs/resilience.md) fire
+    # only on the process hosting the named shard's device — in a fleet,
+    # exactly one process dies and its peers wedge in the next collective,
+    # which is the failure the fleet supervisor exists to catch
+    fault.set_local_shards(my_shards)
+    fault.validate_shards(D)
+    hb_path = None
+    if hb_dir:
+        os.makedirs(hb_dir, exist_ok=True)
+        hb_path = os.path.join(hb_dir, f"proc{my_proc}.jsonl")
+
+    def _shard_beat(done_depth: int, **extra) -> None:
+        if hb_path is None:
+            return
+        append_jsonl(
+            hb_path,
+            heartbeat_record(
+                "shard-heartbeat",
+                proc=int(my_proc),
+                pid=os.getpid(),
+                shards=my_shards,
+                depth=int(done_depth),
+                **extra,
+            ),
+        )
+
     if use_disk:
         # the plan is parsed after the per-shard sets are built — hand it
         # to them now (mid-merge crash injection, crash@merge:N)
@@ -641,15 +872,33 @@ def check_sharded(
     # a supervised restart converges (FaultPlan.crash)
     last_ckpt_depth = None
     resumed = False
+    elastic_resumed = False
+    plog = None  # per-shard on-disk parent log (checkpointed runs only)
     inv_names = ",".join(sorted(i.name for i in model.invariants))
+    # NB: the mesh layout (D, P) is deliberately NOT part of the identity:
+    # a checkpoint binds to the *search* (model, constants, invariants,
+    # backend), and resuming it on a different shard/process count is the
+    # elastic-resume path below, not a config mismatch.  The layout that
+    # wrote a generation is stamped as mesh_D/mesh_P arrays instead.
+    _fields_ident = ",".join(
+        f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields
+    ) + ("|store=disk" if use_disk else "")
     ckpt_ident = (
+        f"{model.name}|lanes={spec.num_lanes}|"
+        f"backend={visited_backend}|"
+        f"inv={inv_names}|dl={check_deadlock}|" + _fields_ident
+    )
+    # the pre-elastic ident baked the layout in; accepting it (for THIS
+    # mesh exactly) keeps checkpoints written by older code resumable
+    # after an upgrade — a legacy checkpoint from a different layout
+    # still refuses (it carries no mesh stamps to re-bucket from)
+    ckpt_ident_legacy = (
         f"{model.name}|lanes={spec.num_lanes}|D={D}|"
         f"P={jax.process_count()}|backend={visited_backend}|"
-        f"inv={inv_names}|dl={check_deadlock}|"
-        + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
-        + ("|store=disk" if use_disk else "")
+        f"inv={inv_names}|dl={check_deadlock}|" + _fields_ident
     )
     if checkpoint_dir is not None:
+        want_trace = store_trace
         store_trace = False
         last_ckpt_depth = 0
         checkpoint_every = max(1, int(checkpoint_every))
@@ -659,26 +908,120 @@ def check_sharded(
             ident=ckpt_ident,
             keep=checkpoint_keep,
             fault_plan=fault,
+            ident_aliases=(ckpt_ident_legacy,),
         )
-        # per-host FpSet part files: each process verifies its own part
-        # against the main file's level (cross-shard consistency — a torn
-        # generation falls back instead of resuming a spliced state)
-        my_parts = (
-            (f"host{my_proc}",)
-            if visited_backend == "host" and is_multiprocess()
-            else ()
-        )
-        loaded = ckpt_store.load(parts=my_parts)
+        if want_trace:
+            # per-shard on-disk parent logs: counterexample traces that
+            # survive checkpoint resume (the sharded twin of the single-
+            # device engine's disk-tier parent log — docs/resilience.md)
+            plog = ShardedParentLog(
+                os.path.join(checkpoint_dir, "plog"),
+                K,
+                D,
+                local_shards=my_shards,
+                epoch_writer=is_coordinator(),
+            )
+
+        def _parts_for(main):
+            # per-host FpSet part files, derived from the layout recorded
+            # in the MAIN file: a same-layout resume needs only this
+            # process's part (cross-shard consistency is still enforced),
+            # an elastic resume needs every old host's part to re-bucket.
+            # A stamp-less main is a pre-elastic legacy checkpoint, which
+            # can only have passed the ident check via the same-layout
+            # alias — so its layout IS the current one
+            old_P_ = (
+                int(main["mesh_P"])
+                if "mesh_P" in main
+                else jax.process_count()
+            )
+            old_D_ = int(main["mesh_D"]) if "mesh_D" in main else D
+            if visited_backend != "host" or old_P_ <= 1:
+                return ()
+            if old_D_ == D and old_P_ == jax.process_count():
+                return (f"host{my_proc}",)
+            return tuple(f"host{p}" for p in range(old_P_))
+
+        loaded = ckpt_store.load(parts=_parts_for)
         if loaded is not None:
             resumed = True
             snap, part_arrays, _gen = loaded
+            # stamp-less legacy snapshots passed the ident check via the
+            # same-layout alias, so their layout is by construction the
+            # current one (never spuriously elastic)
+            old_D = int(snap["mesh_D"]) if "mesh_D" in snap else D
+            old_P = (
+                int(snap["mesh_P"])
+                if "mesh_P" in snap
+                else jax.process_count()
+            )
+            elastic_resumed = old_D != D or old_P != jax.process_count()
             plens = snap["pending_lens"]
             flat = snap["pending"]
             pending, at = [], 0
             for ln in plens:
                 pending.append(flat[at : at + int(ln)])
                 at += int(ln)
-            if host_sets is not None and use_disk:
+            levels = snap["levels"].tolist()
+            total = int(snap["total"])
+            depth = int(snap["depth"])
+            last_ckpt_depth = depth
+            # crash faults at or below the resume level count as fired
+            fault.set_start_depth(depth)
+            if elastic_resumed:
+                (
+                    pending,
+                    host_sets,
+                    new_vhi,
+                    new_vlo,
+                    new_vn,
+                    vcap,
+                    shard_visited,
+                ) = _elastic_reshard(
+                    snap,
+                    part_arrays,
+                    old_D,
+                    old_P,
+                    pending,
+                    D=D,
+                    spec=spec,
+                    visited_backend=visited_backend,
+                    use_disk=use_disk,
+                    host_sets=host_sets,
+                    shard_proc=shard_proc,
+                    my_proc=my_proc,
+                    spill_base=spill_base,
+                    vcap=vcap,
+                    shard_visited=shard_visited,
+                )
+                if new_vhi is not None:
+                    # device-resident backends got rebuilt shard arrays;
+                    # host backends keep their placeholder device views
+                    vhi, vlo, vn = new_vhi, new_vlo, new_vn
+                if plog is not None and is_multiprocess():
+                    # the boundary-level rewrite atomically replaces
+                    # segments other processes may concurrently be
+                    # reading to build their own permutation (shard dirs
+                    # overlap between layouts) — without a barrier the
+                    # rewrite is racy, so a MULTI-process elastic resume
+                    # stays trace-less; single-process elastic (and all
+                    # same-layout resumes) keep full traces
+                    plog = None
+                if plog is not None and not plog.reshard(depth, pending):
+                    plog = None  # old segments unreadable: trace-less
+                from ..obs import metrics as _met
+                from ..obs import tracer as _obs_t
+
+                _obs_t.event(
+                    "elastic-reshard",
+                    depth=depth,
+                    from_shards=old_D,
+                    to_shards=D,
+                    from_procs=old_P,
+                    to_procs=jax.process_count(),
+                )
+                _met.inc("kspec_elastic_reshards_total")
+            elif host_sets is not None and use_disk:
                 # per-shard tiered sets: restore IN PLACE from the
                 # checkpointed run manifests + hot dumps (the runs stay on
                 # disk; the checkpoint only references them)
@@ -735,12 +1078,10 @@ def check_sharded(
                 pad = np.full((D, vcap - w), 0xFFFFFFFF, np.uint32)
                 vhi = np.concatenate([snap["vhi"], pad], axis=1)
                 vlo = np.concatenate([snap["vlo"], pad], axis=1)
-            levels = snap["levels"].tolist()
-            total = int(snap["total"])
-            depth = int(snap["depth"])
-            last_ckpt_depth = depth
-            # crash faults at or below the resume level count as fired
-            fault.set_start_depth(depth)
+            if plog is not None and not elastic_resumed and not plog.resume(
+                depth
+            ):
+                plog = None  # no resolvable epochs: trace-less as before
         if is_multiprocess():
             # split-brain guard: each process verifies its own part files,
             # so per-host corruption could make hosts fall back to
@@ -797,6 +1138,11 @@ def check_sharded(
                 "spill_manifest": json.dumps(
                     [s.manifest() if s is not None else None for s in host_sets]
                 ),
+                # layout stamp: parts pair with mains by (depth, layout) —
+                # after an elastic re-save a stale old-layout part can
+                # share the depth (resilience.checkpoints._find_part)
+                "mesh_D": D,
+                "mesh_P": jax.process_count(),
             }
             if is_multiprocess():
                 ckpt_store.save(depth, payload, part=f"host{my_proc}")
@@ -806,19 +1152,21 @@ def check_sharded(
             if not is_coordinator():
                 _advance_spill_gc()
                 return
-            ckpt_store.save(
-                depth,
-                dict(
-                    pending=np.concatenate(pending)
-                    if any(p.shape[0] for p in pending)
-                    else np.empty((0, K), np.uint32),
-                    pending_lens=np.asarray([p.shape[0] for p in pending]),
-                    vcap=vcap,
-                    levels=np.asarray(levels),
-                    total=total,
-                    **extra,
-                ),
+            main = dict(
+                pending=np.concatenate(pending)
+                if any(p.shape[0] for p in pending)
+                else np.empty((0, K), np.uint32),
+                pending_lens=np.asarray([p.shape[0] for p in pending]),
+                vcap=vcap,
+                levels=np.asarray(levels),
+                total=total,
+                **extra,
             )
+            # single-process runs carry the payload (incl. its layout
+            # stamp) inline; multi-process mains stamp their own
+            main["mesh_D"] = D
+            main["mesh_P"] = jax.process_count()
+            ckpt_store.save(depth, main)
             _advance_spill_gc()
             return
         if host_sets is not None:
@@ -828,10 +1176,11 @@ def check_sharded(
             ]
             if is_multiprocess():
                 # per-host ownership: each process persists its own shards
-                # in a sidecar part file; resume is symmetric (same mesh
-                # layout is enforced by ckpt_ident's D and P stamps).  The
-                # part carries the level it snapshots: a crash between the
-                # part writes and the coordinator's main write would leave
+                # in a sidecar part file; a same-layout resume is symmetric
+                # (the mesh_D/mesh_P stamps pair parts with mains), and an
+                # elastic resume reads every old host's part to re-bucket.
+                # The part carries the level it snapshots: a crash between
+                # the part writes and the coordinator's main write would leave
                 # parts one level ahead of (or behind) the main file, and
                 # resuming such a torn pair would silently skip the
                 # re-expanded frontier's subtrees — the depth cross-check
@@ -842,6 +1191,8 @@ def check_sharded(
                     dict(
                         host_fps=np.concatenate(dumps),
                         host_lens=np.asarray([len(x) for x in dumps]),
+                        mesh_D=D,
+                        mesh_P=jax.process_count(),
                     ),
                     part=f"host{my_proc}",
                 )
@@ -884,9 +1235,19 @@ def check_sharded(
                 vcap=vcap,
                 levels=np.asarray(levels),
                 total=total,
+                mesh_D=D,
+                mesh_P=jax.process_count(),
                 **extra,
             ),
         )
+
+    if elastic_resumed:
+        # persist one generation in the NEW layout immediately: a crash
+        # before the next periodic save then resumes into this layout
+        # without re-paying the re-bucketing read, and for the disk tier
+        # the re-bucketed runs become durably referenced before any old
+        # run can start aging out of the deletion barrier
+        _save_checkpoint()
 
     def decode_row(row):
         st = {k: np.asarray(v) for k, v in spec.unpack(jnp.asarray(row)).items()}
@@ -899,10 +1260,35 @@ def check_sharded(
         trace_store.append(
             (init_rows, np.full(n0, -1, np.int64), np.full(n0, -1, np.int64))
         )
+    if plog is not None and not resumed:
+        # level 0 = the init states, parentless, in shard-major order
+        plog.start_fresh()
+        plog.write_level(
+            0,
+            pending,
+            [np.full(p.shape[0], -1, np.int64) for p in pending],
+            [np.full(p.shape[0], -1, np.int64) for p in pending],
+        )
+    # parent/act bookkeeping is needed by EITHER trace consumer (the
+    # in-RAM store or the on-disk per-shard parent logs)
+    collect_trace = store_trace or plog is not None
 
     def build_violation(inv_name, d_level, idx):
-        return walk_trace(trace_store, model.actions, decode_row, inv_name, d_level, idx)
+        """Full trace when any source can resolve it, else None (the
+        caller reports the violating state trace-less)."""
+        if store_trace:
+            return walk_trace(
+                trace_store, model.actions, decode_row, inv_name, d_level, idx
+            )
+        if plog is not None and plog.has_levels(d_level):
+            # per-shard on-disk parent logs: O(depth) single-row reads —
+            # this is what makes sharded traces survive checkpoint resume
+            return walk_trace(
+                plog.view(), model.actions, decode_row, inv_name, d_level, idx
+            )
+        return None
 
+    _shard_beat(depth, event="start", resumed=bool(resumed))
     cut = False
     while any(p.shape[0] for p in pending):
         # level-boundary fault injection point (resilience.faults); the
@@ -1144,7 +1530,7 @@ def check_sharded(
             # the padded buffer is mostly empty
             cmax = int(counts.max())
             out3 = fetch_global(out.reshape(D, M_per, K)[:, :cmax])
-            if store_trace:
+            if collect_trace:
                 parent_np = fetch_global(out_parent.reshape(D, M_per)[:, :cmax])
                 act_np = fetch_global(out_act.reshape(D, M_per)[:, :cmax])
             if host_sets is not None and cmax:
@@ -1168,18 +1554,18 @@ def check_sharded(
                 if not c:
                     continue
                 rows = out3[d, :c]
-                p = parent_np[d, :c].astype(np.int64) if store_trace else None
-                a = act_np[d, :c].astype(np.int64) if store_trace else None
+                p = parent_np[d, :c].astype(np.int64) if collect_trace else None
+                a = act_np[d, :c].astype(np.int64) if collect_trace else None
                 if host_sets is not None:
                     mask = masks[d, :c]
                     rows = rows[mask]
-                    if store_trace:
+                    if collect_trace:
                         p, a = p[mask], a[mask]
                     c = rows.shape[0]
                     if not c:
                         continue
                 next_pending[d].append(rows)
-                if store_trace:
+                if collect_trace:
                     # step parents are d_src*bucket + i within this padded
                     # chunk -> level-global index in shard-major order
                     src_d = p // bucket
@@ -1198,15 +1584,12 @@ def check_sharded(
 
         if verdict is not None:
             inv_name, row, gidx = verdict
-            if store_trace:
-                violation = build_violation(inv_name, depth, gidx)
-            else:
-                violation = Violation(
-                    invariant=inv_name,
-                    depth=depth,
-                    state=decode_row(row),
-                    trace=[],
-                )
+            violation = build_violation(inv_name, depth, gidx) or Violation(
+                invariant=inv_name,
+                depth=depth,
+                state=decode_row(row),
+                trace=[],
+            )
             break
 
         n_new = int(lvl_new_per_shard.sum())
@@ -1248,12 +1631,34 @@ def check_sharded(
             result_levels.append(rec)
         if progress:
             progress(depth, n_new, total)
+        _shard_beat(depth, new=n_new, total=total)
         pending = [
             np.concatenate(next_pending[d])
             if next_pending[d]
             else np.empty((0, K), np.uint32)
             for d in range(D)
         ]
+        if plog is not None:
+            # publish the level's per-shard parent-log segments BEFORE the
+            # checkpoint save: a checkpoint at depth R then implies the
+            # log resolves every level <= R (segments past a crash are
+            # rewritten byte-identically by the deterministic re-run)
+            plog.write_level(
+                depth,
+                pending,
+                [
+                    np.concatenate(next_parent[d])
+                    if next_parent[d]
+                    else np.empty(0, np.int64)
+                    for d in range(D)
+                ],
+                [
+                    np.concatenate(next_act[d])
+                    if next_act[d]
+                    else np.empty(0, np.int64)
+                    for d in range(D)
+                ],
+            )
         if ckpt_store is not None and depth % checkpoint_every == 0:
             _save_checkpoint()
             last_ckpt_depth = depth
@@ -1284,18 +1689,18 @@ def check_sharded(
                 ok = np.asarray(jax.vmap(inv.pred)(st))
                 if not ok.all():
                     idx = int(np.argmax(~ok))
-                    if store_trace:
-                        violation = build_violation(inv.name, depth, idx)
-                    else:
-                        violation = Violation(
-                            invariant=inv.name,
-                            depth=depth,
-                            state=decode_row(rows[idx]),
-                            trace=[],
-                        )
+                    violation = build_violation(
+                        inv.name, depth, idx
+                    ) or Violation(
+                        invariant=inv.name,
+                        depth=depth,
+                        state=decode_row(rows[idx]),
+                        trace=[],
+                    )
                     break
 
     dt = time.perf_counter() - t0
+    _shard_beat(depth, event="finish", ok=violation is None)
     spill_stats = (
         {
             "spill": [s.stats() if s is not None else None for s in host_sets],

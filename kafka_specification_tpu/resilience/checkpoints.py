@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import zlib
 from typing import Optional
 
@@ -63,6 +64,48 @@ def build_manifest(arrays: dict) -> dict:
     return man
 
 
+def verify_file(path: str) -> dict:
+    """Load `path` into a plain dict, checking the manifest checksums.
+
+    Raises CheckpointCorrupt on any read/CRC/manifest failure.  A legacy
+    file (no manifest) loads unchecked.  Module-level so the offline
+    verifier (`verify_checkpoint_dir`) shares one definition of "this
+    checkpoint file is intact" with the resume path."""
+    try:
+        with np.load(path, allow_pickle=False) as snap:
+            arrays = {k: snap[k] for k in snap.files}
+    except Exception as e:  # zipfile/np errors: torn or rotted file
+        raise CheckpointCorrupt(f"{path}: unreadable ({e})") from e
+    man_raw = arrays.pop(MANIFEST_KEY, None)
+    if man_raw is None:
+        return arrays  # legacy pre-manifest checkpoint
+    try:
+        manifest = json.loads(str(man_raw))
+    except ValueError as e:
+        raise CheckpointCorrupt(f"{path}: bad manifest ({e})") from e
+    if set(manifest) != set(arrays):
+        raise CheckpointCorrupt(
+            f"{path}: manifest/content mismatch "
+            f"({sorted(set(manifest) ^ set(arrays))})"
+        )
+    for k, meta in manifest.items():
+        if _crc(arrays[k]) != meta["crc32"]:
+            raise CheckpointCorrupt(f"{path}: checksum mismatch on {k!r}")
+    return arrays
+
+
+def part_matches(part_arrays: dict, match: dict) -> bool:
+    """THE part-to-main pairing rule, shared by the resume path
+    (CheckpointStore._find_part) and the offline verifier: a part pairs
+    with a main iff every stamp the main carries (`depth`, and mesh
+    layout when recorded) is either absent from the part (legacy) or
+    equal."""
+    return all(
+        k not in part_arrays or v is None or int(part_arrays[k]) == v
+        for k, v in match.items()
+    )
+
+
 class CheckpointStore:
     def __init__(
         self,
@@ -71,12 +114,19 @@ class CheckpointStore:
         ident: str,
         keep: int = 3,
         fault_plan: Optional[FaultPlan] = None,
+        ident_aliases: tuple = (),
     ):
+        """`ident_aliases`: additional identity strings accepted on LOAD
+        (new saves always stamp `ident`).  The sharded engine passes its
+        pre-elastic ident form (which baked the mesh layout in) so
+        checkpoints written by older code stay resumable on the same
+        mesh after an upgrade."""
         if not basename.endswith(".npz"):
             raise ValueError(f"basename must end in .npz, got {basename!r}")
         self.directory = directory
         self.basename = basename
         self.ident = ident
+        self.ident_aliases = tuple(ident_aliases)
         self.keep = max(1, int(keep))
         self.fault_plan = fault_plan
         os.makedirs(directory, exist_ok=True)
@@ -135,35 +185,11 @@ class CheckpointStore:
 
     # --- load ----------------------------------------------------------
     def _verify(self, path: str) -> dict:
-        """Load `path` into a plain dict, checking the manifest checksums.
-
-        Raises CheckpointCorrupt on any read/CRC/manifest failure.  A
-        legacy file (no manifest) loads unchecked."""
-        try:
-            with np.load(path, allow_pickle=False) as snap:
-                arrays = {k: snap[k] for k in snap.files}
-        except Exception as e:  # zipfile/np errors: torn or rotted file
-            raise CheckpointCorrupt(f"{path}: unreadable ({e})") from e
-        man_raw = arrays.pop(MANIFEST_KEY, None)
-        if man_raw is None:
-            return arrays  # legacy pre-manifest checkpoint
-        try:
-            manifest = json.loads(str(man_raw))
-        except ValueError as e:
-            raise CheckpointCorrupt(f"{path}: bad manifest ({e})") from e
-        if set(manifest) != set(arrays):
-            raise CheckpointCorrupt(
-                f"{path}: manifest/content mismatch "
-                f"({sorted(set(manifest) ^ set(arrays))})"
-            )
-        for k, meta in manifest.items():
-            if _crc(arrays[k]) != meta["crc32"]:
-                raise CheckpointCorrupt(f"{path}: checksum mismatch on {k!r}")
-        return arrays
+        return verify_file(path)
 
     def _check_ident(self, path: str, arrays: dict) -> None:
         found = str(arrays["ident"]) if "ident" in arrays else "<none>"
-        if found != self.ident:
+        if found != self.ident and found not in self.ident_aliases:
             raise ValueError(
                 f"checkpoint at {path} was written by a different "
                 f"model/config:\n  checkpoint: {found}\n  this run:   {self.ident}"
@@ -173,15 +199,19 @@ class CheckpointStore:
         """Generation indices present on disk (main files), newest first."""
         return [g for g in range(self.keep) if os.path.exists(self.path(g))]
 
-    def _find_part(self, part: str, depth, errors: list):
-        """Newest verifying generation of `part` at level `depth`, or None.
+    def _find_part(self, part: str, match: dict, errors: list):
+        """Newest verifying generation of `part` matching `match`, or None.
 
-        Parts are matched to the main file BY LEVEL, not by generation
-        index: part and main chains rotate at slightly different moments
-        (every process promotes its part before the coordinator promotes
-        the main file), so a crash in between skews the chains by one —
-        pairing by index would make every generation look torn and defeat
-        fallback entirely."""
+        Parts are matched to the main file BY LEVEL (plus any mesh-layout
+        stamps the writer recorded — `match` maps array name -> required
+        value), not by generation index: part and main chains rotate at
+        slightly different moments (every process promotes its part
+        before the coordinator promotes the main file), so a crash in
+        between skews the chains by one — pairing by index would make
+        every generation look torn and defeat fallback entirely.  The
+        layout stamps matter after an elastic re-shard: the re-saved main
+        and a stale old-layout part can share a depth, and splicing them
+        would resume half a re-shard."""
         for pg in range(self.keep):
             path = self.path(pg, part)
             if not os.path.exists(path):
@@ -192,11 +222,11 @@ class CheckpointStore:
                 errors.append(str(e))
                 continue
             self._check_ident(path, pa)
-            if "depth" not in pa or int(pa["depth"]) == depth:
+            if part_matches(pa, match):
                 return pa
         return None
 
-    def load(self, parts: tuple = ()) -> Optional[tuple]:
+    def load(self, parts=()) -> Optional[tuple]:
         """Newest verifying generation -> (main_arrays, {part: arrays}, gen).
 
         Walks main generations newest -> oldest; a generation is accepted
@@ -204,10 +234,14 @@ class CheckpointStore:
         verifying copy AT THE SAME LEVEL (the cross-shard level-consistency
         check — a crash between part and main writes must not splice two
         different levels; the part may live at a different generation
-        index, see _find_part).  Returns None when no checkpoint exists at
-        all; raises CheckpointCorrupt when files exist but none verify;
-        raises ValueError on an identity mismatch (never falls back past
-        it)."""
+        index, see _find_part).  `parts` is a tuple of part names or a
+        callable main_arrays -> tuple: the sharded engine derives the
+        part set from the mesh layout recorded IN the main file, because
+        an elastic resume may need a different process count's parts than
+        the resuming job runs with.  Returns None when no checkpoint
+        exists at all; raises CheckpointCorrupt when files exist but none
+        verify; raises ValueError on an identity mismatch (never falls
+        back past it)."""
         from ..obs import tracer as _obs  # lazy: cycle hygiene
 
         gens = self.generations()
@@ -223,10 +257,14 @@ class CheckpointStore:
                 continue
             self._check_ident(self.path(g), main)
             depth = int(main["depth"]) if "depth" in main else None
+            match = {"depth": depth}
+            for k in ("mesh_D", "mesh_P"):
+                if k in main:
+                    match[k] = int(main[k])
             part_arrays = {}
             torn = False
-            for p in parts:
-                pa = self._find_part(p, depth, errors)
+            for p in (parts(main) if callable(parts) else parts):
+                pa = self._find_part(p, match, errors)
                 if pa is None:
                     errors.append(
                         f"generation {g}: no verifying part {p!r} at "
@@ -257,3 +295,192 @@ class CheckpointStore:
         raise CheckpointCorrupt(
             "no checkpoint generation verified:\n  " + "\n  ".join(errors)
         )
+
+
+# --- offline verification (`cli verify-checkpoint`) -----------------------
+
+_CKPT_RE = re.compile(
+    r"^(?P<stem>.+?)(?:\.(?P<gen>\d+))?\.npz(?:\.(?P<part>.+))?$"
+)
+
+
+def _scan_checkpoint_files(directory: str) -> dict:
+    """-> {stem: {"mains": {gen: path}, "parts": {part: {gen: path}}}}."""
+    stores: dict = {}
+    for name in sorted(os.listdir(directory)):
+        path = os.path.join(directory, name)
+        if not os.path.isfile(path) or ".tmp.npz" in name:
+            continue
+        m = _CKPT_RE.match(name)
+        if m is None:
+            continue
+        st = stores.setdefault(m.group("stem"), {"mains": {}, "parts": {}})
+        gen = int(m.group("gen") or 0)
+        part = m.group("part")
+        if part is None:
+            st["mains"][gen] = path
+        else:
+            st["parts"].setdefault(part, {})[gen] = path
+    return stores
+
+
+def _resolve_spill(arrays: dict, spill_dir: str) -> dict:
+    """Resolve a checkpoint's recorded storage manifest against the disk:
+    every referenced run file / frontier segment must exist with the size
+    its manifest entry implies — the checkpoint only *references* the
+    disk tier (docs/storage.md's crash-safety contract), so a resumable
+    generation is one whose references all still land."""
+    from ..storage.runs import _HEADER as _RUN_HEADER  # jax-free
+
+    problems = []
+    checked = 0
+
+    def check_run(run_dir: str, meta: dict) -> None:
+        nonlocal checked
+        checked += 1
+        p = os.path.join(run_dir, meta["name"])
+        if not os.path.isfile(p):
+            problems.append(f"missing run file {p}")
+            return
+        want = _RUN_HEADER + 8 * int(meta["count"])
+        size = os.path.getsize(p)
+        if size != want:
+            problems.append(f"{p}: size {size} != expected {want}")
+
+    raw = json.loads(str(arrays["spill_manifest"]))
+    if isinstance(raw, dict):  # single-device DiskTierStore manifest
+        for meta in (raw.get("fpset") or {}).get("runs", ()):
+            check_run(os.path.join(spill_dir, "fps"), meta)
+        frontier_dir = os.path.join(spill_dir, "frontier")
+        for seg in (raw.get("frontier") or {}).get("segments", ()):
+            checked += 1
+            p = os.path.join(frontier_dir, seg["name"])
+            if not os.path.isfile(p):
+                problems.append(f"missing frontier segment {p}")
+    else:  # sharded: one tiered manifest per shard (None = unowned)
+        for d, man in enumerate(raw):
+            for meta in (man or {}).get("runs", ()):
+                check_run(os.path.join(spill_dir, f"shard{d}"), meta)
+    return {"ok": not problems, "files_checked": checked,
+            "problems": problems}
+
+
+def verify_checkpoint_dir(directory: str, spill_dir=None) -> dict:
+    """Offline integrity report for a checkpoint directory — jax-free, so
+    it runs from CI or an operator shell on a box whose accelerator stack
+    is wedged (`cli verify-checkpoint` is the front-end).
+
+    Checks, per checkpoint chain found in `directory`:
+
+    - per-array CRC32 manifests of every main/part generation (the same
+      `verify_file` the resume path trusts, without resuming anything);
+    - cross-shard consistency: a generation is *resumable* only when
+      every part file present has a verifying copy at the main file's
+      depth (and mesh layout, when stamped) — the crash-between-promotes
+      rule the sharded engine's per-host part files live by;
+    - storage-manifest resolvability: a recorded `spill_manifest`'s run
+      files / frontier segments must exist on disk at their manifest
+      sizes (default spill dir: `<directory>/spill`, the engines'
+      default placement; `--spill-dir` overrides).
+
+    -> {"ok": bool, "dir": ..., "stores": [...]}: ok iff at least one
+    chain exists and every chain has a fully-resumable generation.
+    """
+    directory = os.path.normpath(directory)
+    spill_dir = spill_dir or os.path.join(directory, "spill")
+    report: dict = {"dir": directory, "stores": [], "ok": False}
+    if not os.path.isdir(directory):
+        report["error"] = "not a directory"
+        return report
+
+    # checkpoint files are immutable once promoted; each part generation
+    # may be consulted once per MAIN generation (keep of them), and a
+    # full-CRC re-read of multi-GB fingerprint dumps per consult would
+    # triple the verifier's disk traffic — memoize per path
+    _verified: dict = {}
+
+    def cached_verify(path: str) -> dict:
+        if path not in _verified:
+            try:
+                _verified[path] = verify_file(path)
+            except CheckpointCorrupt as e:
+                _verified[path] = e
+        out = _verified[path]
+        if isinstance(out, CheckpointCorrupt):
+            raise out
+        return out
+    for stem, files in sorted(_scan_checkpoint_files(directory).items()):
+        store_rep = {"basename": f"{stem}.npz", "generations": [],
+                     "ok": False}
+        for gen in sorted(files["mains"]):
+            path = files["mains"][gen]
+            gen_rep: dict = {"gen": gen, "path": path, "ok": False,
+                             "errors": []}
+            store_rep["generations"].append(gen_rep)
+            try:
+                arrays = verify_file(path)
+            except CheckpointCorrupt as e:
+                gen_rep["errors"].append(str(e))
+                continue
+            depth = int(arrays["depth"]) if "depth" in arrays else None
+            gen_rep["depth"] = depth
+            if "ident" in arrays:
+                gen_rep["ident"] = str(arrays["ident"])
+            match = {"depth": depth}
+            for k in ("mesh_D", "mesh_P"):
+                if k in arrays:
+                    gen_rep[k] = match[k] = int(arrays[k])
+            # required parts come from the MAIN's own stamps (the same
+            # rule the resume path's _parts_for applies): per-host
+            # `host<p>` part files exist only for the host visited
+            # backend (the ident records `backend=...`), and only for
+            # multi-process layouts — a stamped device/device-hash main
+            # or a single-process main needs none.  Stale parts from a
+            # pre-elastic layout are then ignored rather than failing a
+            # perfectly resumable directory.  Unstamped (legacy) mains
+            # fall back to requiring every part found on disk.
+            if "mesh_P" in arrays:
+                host_backend = "|backend=host|" in (gen_rep.get("ident") or "")
+                needed = (
+                    [f"host{p}" for p in range(match["mesh_P"])]
+                    if match["mesh_P"] > 1 and host_backend
+                    else []
+                )
+            else:
+                needed = sorted(files["parts"])
+            gen_rep["parts"] = {}
+            for part in needed:
+                found = None
+                gens = files["parts"].get(part, {})
+                for pg in sorted(gens):  # gen 0 = newest, as in load()
+                    try:
+                        pa = cached_verify(gens[pg])
+                    except CheckpointCorrupt as e:
+                        gen_rep["errors"].append(str(e))
+                        continue
+                    if part_matches(pa, match):
+                        found = pg
+                        break
+                    pa = None
+                gen_rep["parts"][part] = found
+                if found is None:
+                    gen_rep["errors"].append(
+                        f"no verifying part {part!r} at depth {depth}"
+                    )
+                elif "spill_manifest" in pa:
+                    # multi-process disk-tier runs record each host's
+                    # spill manifest ONLY in its part file — resolve it
+                    # there or missing run files go undetected
+                    psp = _resolve_spill(pa, spill_dir)
+                    gen_rep.setdefault("part_spill", {})[part] = psp
+                    gen_rep["errors"].extend(psp["problems"])
+            if "spill_manifest" in arrays:
+                gen_rep["spill"] = _resolve_spill(arrays, spill_dir)
+                gen_rep["errors"].extend(gen_rep["spill"]["problems"])
+            gen_rep["ok"] = not gen_rep["errors"]
+        store_rep["ok"] = any(g["ok"] for g in store_rep["generations"])
+        report["stores"].append(store_rep)
+    report["ok"] = bool(report["stores"]) and all(
+        s["ok"] for s in report["stores"]
+    )
+    return report

@@ -30,6 +30,28 @@ Grammar (comma-separated specs in `KSPEC_FAULT` or `--fault`):
     transient_device_err:N    the next N chunk/exchange step executions
                               raise a transient-classified backend error
 
+Shard scoping (the distributed engine's fault surface): any `@` fault may
+carry a `shard<d>:` scope immediately after the `@`, and the bare faults
+accept `@shard<d>` — the fault then fires only on the process that hosts
+shard `d`'s device (`FaultPlan.set_local_shards`, wired by
+`parallel/sharded.py` from the mesh's device->process map):
+
+    crash@shard2:level:N          kill exactly the process hosting shard 2
+                                  at the level-N boundary (its peers block
+                                  in the next collective until the fleet
+                                  supervisor tears the job down)
+    crash@shard2:ckpt:N           torn-write rehearsal on one shard's host
+    corrupt_ckpt@shard1           corrupt a checkpoint written by shard
+    corrupt_ckpt@shard1:ckpt:N    1's host (its per-host part file, in a
+                                  multi-process job)
+    transient_device_err@shard0:N transient errors on shard 0's host only
+
+In a single-process run every shard is local, so shard-scoped faults
+degenerate to their unscoped forms — which is exactly what lets the whole
+matrix run in tier-1 on the virtual CPU mesh.  Engines that never call
+`set_local_shards` (the single-device engine) treat every scope as local
+for the same reason.
+
 Crash faults fire only when the run *started* below the target level
 (`FaultPlan.set_start_depth` is called by the engines after a checkpoint
 resume), and on a checkpointing run a `crash@level:N` additionally defers
@@ -72,12 +94,44 @@ class _Spec:
     point: Optional[str]  # level | ckpt | None
     arg: Optional[int]  # level number (crash/corrupt) — None = first
     budget: int  # remaining firings
+    shard: Optional[int] = None  # fire only on this shard's host process
+
+
+def _split_shard(rest: str, tok: str):
+    """Peel an optional `shard<d>:`/`shard<d>` scope off `rest`."""
+    if not rest.startswith("shard"):
+        return None, rest
+    head, _, tail = rest.partition(":")
+    try:
+        shard = int(head[len("shard"):])
+    except ValueError:
+        raise ValueError(
+            f"fault {tok!r}: shard scope must be 'shard<index>', got {head!r}"
+        )
+    if shard < 0:
+        raise ValueError(f"fault {tok!r}: shard index must be >= 0")
+    return shard, tail
 
 
 def _parse_token(tok: str) -> _Spec:
-    name, _, count = tok.partition(":") if "@" not in tok else (tok, "", "")
     if "@" in tok:
         name, _, rest = tok.partition("@")
+        shard, rest = _split_shard(rest, tok)
+        if name == "corrupt_ckpt" and shard is not None and not rest:
+            return _Spec("corrupt_ckpt", "ckpt", None, 1, shard)
+        if name == "transient_device_err" and shard is not None:
+            if rest:
+                try:
+                    budget = int(rest)
+                except ValueError:
+                    raise ValueError(
+                        f"fault {tok!r}: budget must be an integer"
+                    )
+            else:
+                budget = 1
+            return _Spec("transient_device_err", None, None, budget, shard)
+        if name == "compile_oom" and shard is not None and not rest:
+            return _Spec("compile_oom", None, None, 1, shard)
         point, _, arg = rest.partition(":")
         if not arg:
             raise ValueError(f"fault {tok!r}: '@{point}' needs ':<level>'")
@@ -91,10 +145,11 @@ def _parse_token(tok: str) -> _Spec:
             # it instead of silently rehearsing nothing
             raise ValueError(f"fault {tok!r}: level must be >= 1")
         if name == "crash" and point in ("level", "ckpt", "merge"):
-            return _Spec("crash", point, level, 1)
+            return _Spec("crash", point, level, 1, shard)
         if name == "corrupt_ckpt" and point == "ckpt":
-            return _Spec("corrupt_ckpt", "ckpt", level, 1)
+            return _Spec("corrupt_ckpt", "ckpt", level, 1, shard)
         raise ValueError(f"unknown fault {tok!r}")
+    name, _, count = tok.partition(":")
     if name == "corrupt_ckpt":
         if count:
             raise ValueError(f"fault {tok!r}: use corrupt_ckpt@ckpt:<level>")
@@ -108,7 +163,9 @@ def _parse_token(tok: str) -> _Spec:
     raise ValueError(
         f"unknown fault {tok!r} (grammar: crash@level:N, crash@ckpt:N, "
         f"crash@merge:N, corrupt_ckpt[@ckpt:N], compile_oom, "
-        f"transient_device_err:N)"
+        f"transient_device_err:N, each '@'-scopeable as "
+        f"crash@shard<d>:level:N / corrupt_ckpt@shard<d> / "
+        f"transient_device_err@shard<d>:N)"
     )
 
 
@@ -122,6 +179,9 @@ class FaultPlan:
     def __init__(self, spec: str = ""):
         self.spec = spec or ""
         self.start_depth = 0
+        # None = no topology wired: every shard scope counts as local
+        # (single-process runs, and the single-device engine)
+        self.local_shards: Optional[frozenset] = None
         self.specs = [
             _parse_token(t.strip())
             for t in self.spec.split(",")
@@ -140,6 +200,34 @@ class FaultPlan:
         below it are considered already-fired (restart convergence)."""
         self.start_depth = int(depth)
 
+    def set_local_shards(self, shards) -> None:
+        """Record which shards this process hosts (the sharded engine's
+        mesh device->process map).  Shard-scoped faults then fire only on
+        the targeted shard's host — the peers sail past the injection
+        point and block in their next collective, which is precisely the
+        one-process-died failure the fleet supervisor exists to catch."""
+        self.local_shards = frozenset(int(s) for s in shards)
+
+    def validate_shards(self, shard_count: int) -> None:
+        """Reject shard scopes outside the mesh (same fail-loudly rule as
+        the level >= 1 parse check: a typo'd `crash@shard5:...` on a
+        2-shard run would otherwise silently rehearse nothing on EVERY
+        process and report the drill as passed)."""
+        for s in self.specs:
+            if s.shard is not None and s.shard >= shard_count:
+                raise ValueError(
+                    f"fault plan {self.spec!r}: shard {s.shard} is out of "
+                    f"range for a {shard_count}-shard mesh (valid: "
+                    f"0..{shard_count - 1})"
+                )
+
+    def _is_local(self, s: _Spec) -> bool:
+        return (
+            s.shard is None
+            or self.local_shards is None
+            or s.shard in self.local_shards
+        )
+
     def crash(self, point: str, depth: int, ckpt_depth=None) -> None:
         """Raise InjectedCrash if a crash fault matches this (point, depth).
 
@@ -154,6 +242,8 @@ class FaultPlan:
         for s in self.specs:
             if s.kind != "crash" or s.point != point or s.budget <= 0:
                 continue
+            if not self._is_local(s):
+                continue
             # merge ordinals are per-process counters, not BFS levels:
             # the resume-depth relief below does not apply
             if point != "merge" and self.start_depth >= s.arg:
@@ -167,7 +257,9 @@ class FaultPlan:
                 continue
             s.budget -= 1
             raise InjectedCrash(
-                f"injected crash at {point}:{depth} (KSPEC_FAULT)"
+                f"injected crash at {point}:{depth}"
+                + (f" on shard {s.shard}" if s.shard is not None else "")
+                + " (KSPEC_FAULT)"
             )
 
     def chunk_error(self, escalated: bool) -> Optional[Exception]:
@@ -178,6 +270,8 @@ class FaultPlan:
         only attempt shape for which the engines have a compile fallback.
         """
         for s in self.specs:
+            if not self._is_local(s):
+                continue
             if s.kind == "transient_device_err" and s.budget > 0:
                 s.budget -= 1
                 return RuntimeError(TRANSIENT_MARKER)
@@ -189,7 +283,7 @@ class FaultPlan:
     def should_corrupt(self, depth: int) -> bool:
         """True if the checkpoint just written at `depth` must be corrupted."""
         for s in self.specs:
-            if s.kind == "corrupt_ckpt" and s.budget > 0:
+            if s.kind == "corrupt_ckpt" and s.budget > 0 and self._is_local(s):
                 if s.arg is None or s.arg == depth:
                     s.budget -= 1
                     return True

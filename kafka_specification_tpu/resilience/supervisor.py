@@ -175,3 +175,218 @@ def supervise(cfg: SupervisorConfig) -> int:
         file=sys.stderr,
     )
     return rc if rc not in (0, None) else 1
+# --- fleet supervision (the multi-process jax.distributed regime) --------
+#
+# A pod-scale sharded run is P cooperating processes in one
+# jax.distributed job; losing ANY of them wedges the rest in their next
+# collective (they block on a peer that will never answer), so
+# per-process restart is meaningless — the correct unit of recovery is
+# the whole fleet.  `supervise_fleet`:
+#
+# - launches all P processes of the job (injecting
+#   JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, with a
+#   fresh coordinator port per attempt — the old coordinator dies with
+#   the fleet),
+# - watches one heartbeat file per process (`<heartbeat_dir>/proc<i>.jsonl`,
+#   appended per BFS level by parallel/sharded.py under
+#   KSPEC_SHARD_HEARTBEAT_DIR) — so a *stalled* shard is detected even
+#   while its peers' heartbeats still grow,
+# - on any process death or per-shard stall, records which process/pid
+#   failed, tears the WHOLE fleet down (SIGTERM the process groups, then
+#   SIGKILL), and
+# - restarts the entire job under the usual bounded budget with jittered
+#   backoff; the children resume from the newest cross-shard-consistent
+#   checkpoint generation exactly as a single-process restart would
+#   (resilience.checkpoints pairs the coordinator's main file with every
+#   per-host part file BY LEVEL, so a crash between part and main
+#   promotes falls back to the newest level all shards agree on).
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class FleetConfig:
+    cmd: list  # one command, launched num_processes times
+    num_processes: int
+    events: str = "RESILIENT_EVENTS.jsonl"
+    heartbeat_dir: Optional[str] = None  # per-process shard heartbeats
+    log_dir: Optional[str] = None  # per-attempt, per-process child logs
+    stall_timeout: float = 1800.0
+    max_restarts: int = 8
+    backoff_base: float = 5.0
+    backoff_cap: float = 300.0
+    jitter: float = 0.25
+    poll: float = 0.5
+    term_grace: float = 10.0
+    env: Optional[dict] = None
+    run_id: Optional[str] = None
+    coordinator_host: str = "127.0.0.1"
+    # CPU fleets (CI / rehearsals): virtual devices per process via
+    # --xla_force_host_platform_device_count; None = leave XLA_FLAGS alone
+    devices_per_proc: Optional[int] = None
+    rng: random.Random = field(default_factory=random.Random, repr=False)
+
+    backoff = SupervisorConfig.backoff
+    event = SupervisorConfig.event
+
+
+def _child_env(cfg: FleetConfig, proc: int, port: int) -> dict:
+    env = dict(cfg.env if cfg.env is not None else os.environ)
+    env["JAX_COORDINATOR_ADDRESS"] = f"{cfg.coordinator_host}:{port}"
+    env["JAX_NUM_PROCESSES"] = str(cfg.num_processes)
+    env["JAX_PROCESS_ID"] = str(proc)
+    if cfg.heartbeat_dir is not None:
+        env["KSPEC_SHARD_HEARTBEAT_DIR"] = cfg.heartbeat_dir
+    if cfg.devices_per_proc is not None:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={cfg.devices_per_proc}"
+        ).strip()
+    return env
+
+
+def _signal_pg(pid: int, sig) -> None:
+    try:
+        os.killpg(pid, sig)  # pgid == pid (start_new_session)
+    except (OSError, ProcessLookupError):
+        try:
+            os.kill(pid, sig)
+        except (OSError, ProcessLookupError):
+            pass
+
+
+def _teardown_fleet(cfg: FleetConfig, children: list) -> None:
+    """SIGTERM every live process group, grace, then SIGKILL: a partial
+    fleet must never be left holding devices or the checkpoint dir."""
+    live = [c for c in children if c is not None and c.poll() is None]
+    for c in live:
+        _signal_pg(c.pid, signal.SIGTERM)
+    deadline = time.monotonic() + cfg.term_grace
+    for c in live:
+        while c.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if c.poll() is None:
+            _signal_pg(c.pid, signal.SIGKILL)
+            c.wait()
+
+
+def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> bool:
+    """One whole-fleet launch; True iff every process exited 0."""
+    port = _free_port()
+    if cfg.heartbeat_dir is not None:
+        os.makedirs(cfg.heartbeat_dir, exist_ok=True)
+    log_fhs = []
+    children = []
+    try:
+        for i in range(cfg.num_processes):
+            fh = None
+            if cfg.log_dir is not None:
+                os.makedirs(cfg.log_dir, exist_ok=True)
+                fh = open(
+                    os.path.join(
+                        cfg.log_dir, f"attempt-{attempt:02d}-proc{i}.log"
+                    ),
+                    "wb",
+                )
+            log_fhs.append(fh)
+            children.append(
+                subprocess.Popen(
+                    cfg.cmd,
+                    stdout=fh or None,
+                    stderr=subprocess.STDOUT if fh else None,
+                    env=_child_env(cfg, i, port),
+                    start_new_session=True,
+                )
+            )
+        hb_paths = [
+            os.path.join(cfg.heartbeat_dir, f"proc{i}.jsonl")
+            if cfg.heartbeat_dir is not None
+            else None
+            for i in range(cfg.num_processes)
+        ]
+        hb_sizes = [_hb_size(p) for p in hb_paths]
+        last_progress = [time.monotonic()] * cfg.num_processes
+        done = [None] * cfg.num_processes  # rc once exited
+        while True:
+            now = time.monotonic()
+            for i, child in enumerate(children):
+                if done[i] is not None:
+                    continue
+                rc = child.poll()
+                if rc is not None:
+                    if rc == 0:
+                        done[i] = 0
+                        continue
+                    # one shard's process died: the rest are (or will be)
+                    # wedged in a collective — fail the whole attempt
+                    cfg.event(
+                        event="shard-exit",
+                        attempt=attempt,
+                        proc=i,
+                        pid=child.pid,
+                        rc=rc,
+                    )
+                    return False
+                if hb_paths[i] is not None:
+                    size = _hb_size(hb_paths[i])
+                    if size != hb_sizes[i]:
+                        hb_sizes[i] = size
+                        last_progress[i] = now
+                    elif now - last_progress[i] > cfg.stall_timeout:
+                        cfg.event(
+                            event="shard-stall",
+                            attempt=attempt,
+                            proc=i,
+                            pid=child.pid,
+                            stall_timeout=cfg.stall_timeout,
+                            heartbeat=hb_paths[i],
+                        )
+                        return False
+            if all(rc == 0 for rc in done):
+                return True
+            time.sleep(cfg.poll)
+    finally:
+        _teardown_fleet(cfg, children)
+        for fh in log_fhs:
+            if fh is not None:
+                fh.close()
+
+
+def supervise_fleet(cfg: FleetConfig) -> int:
+    """Run the whole fleet to success or budget exhaustion; 0 on success."""
+    for attempt in range(1, cfg.max_restarts + 2):
+        cfg.event(
+            event="fleet-start",
+            attempt=attempt,
+            processes=cfg.num_processes,
+            cmd=cfg.cmd,
+        )
+        t0 = time.time()
+        ok = _run_fleet_attempt(cfg, attempt)
+        cfg.event(
+            event="fleet-teardown",
+            attempt=attempt,
+            ok=ok,
+            seconds=round(time.time() - t0, 1),
+        )
+        if ok:
+            cfg.event(event="fleet-complete", attempt=attempt)
+            return 0
+        if attempt > cfg.max_restarts:
+            break
+        delay = cfg.backoff(attempt)
+        cfg.event(event="restart", attempt=attempt, backoff_s=round(delay, 2))
+        time.sleep(delay)
+    cfg.event(event="fleet-give-up", attempts=cfg.max_restarts + 1)
+    print(
+        f"[supervisor] fleet giving up after {cfg.max_restarts + 1} "
+        f"attempts; see {cfg.events}",
+        file=sys.stderr,
+    )
+    return 1

@@ -356,6 +356,24 @@ def main(argv=None):
         "fallback when no reference checkout exists)",
     )
 
+    pvc = sub.add_parser(
+        "verify-checkpoint",
+        help="offline integrity check of a checkpoint directory: per-array "
+        "CRC manifests of every generation/part, cross-shard depth+mesh "
+        "consistency, and storage-manifest resolvability (disk-tier run "
+        "files).  Never imports jax — usable from CI or an operator shell "
+        "on a box whose accelerator stack is wedged.  Exit 0 iff every "
+        "checkpoint chain has a resumable generation",
+    )
+    pvc.add_argument("ckpt_dir")
+    pvc.add_argument(
+        "--spill-dir",
+        help="disk-tier directory the storage manifests resolve against "
+        "(default: <ckpt_dir>/spill, the engines' default placement)",
+    )
+    pvc.add_argument("--json", action="store_true",
+                     help="machine-readable report")
+
     pr = sub.add_parser(
         "report",
         help="render a run directory (manifest + stats + spans + metrics + "
@@ -419,6 +437,18 @@ def main(argv=None):
     )
 
     args = p.parse_args(argv)
+
+    if args.cmd == "verify-checkpoint":
+        # like `report`, this must run on a box whose accelerator is
+        # wedged (that is when an operator reaches for it): jax-free
+        from ..resilience.checkpoints import verify_checkpoint_dir
+
+        rep = verify_checkpoint_dir(args.ckpt_dir, spill_dir=args.spill_dir)
+        if args.json:
+            print(json.dumps(rep, default=str))
+        else:
+            _print_verify_checkpoint(rep)
+        return 0 if rep["ok"] else 1
 
     if args.cmd == "report":
         # a report must render on a box whose accelerator is wedged (that
@@ -498,6 +528,25 @@ def main(argv=None):
         if os.environ.get(_CLI_CHILD_ENV):
             _mark_platform_ready()
         _enable_compile_cache()
+        if (
+            os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("KSPEC_MULTIHOST") == "1"
+        ):
+            # fleet-launched process (scripts/resilient_run.py --fleet, or
+            # any jax.distributed job): join the job BEFORE anything
+            # initializes the XLA backend — this is what lets the plain
+            # CLI be the per-process command of a supervised fleet
+            from ..parallel.multihost import init_distributed
+
+            info = init_distributed()
+            if info["process_count"] > 1:
+                print(
+                    f"[fleet] process {info['process_id']}/"
+                    f"{info['process_count']} "
+                    f"({info['local_devices']} local / "
+                    f"{info['global_devices']} global devices)",
+                    file=sys.stderr,
+                )
 
     if args.cmd == "validate":
         # structural validation never needs an accelerator, but building
@@ -637,6 +686,38 @@ def main(argv=None):
     _print_result(res, args.json, model_meta=model.meta)
     return 0 if res.violation is None else 1
 
+
+
+def _print_verify_checkpoint(rep: dict) -> None:
+    print(f"Checkpoint directory: {rep['dir']}")
+    if rep.get("error"):
+        print(f"  ERROR: {rep['error']}")
+    if not rep["stores"]:
+        print("  no checkpoint files found")
+    for store in rep["stores"]:
+        print(f"  {store['basename']}: "
+              f"{'OK' if store['ok'] else 'NOT RESUMABLE'}")
+        for g in store["generations"]:
+            bits = [f"gen {g['gen']}", f"depth {g.get('depth')}"]
+            if "mesh_D" in g:
+                bits.append(f"shards {g['mesh_D']} x procs {g.get('mesh_P')}")
+            if g.get("parts"):
+                bits.append(
+                    "parts " + ",".join(
+                        f"{p}@{gen}" if gen is not None else f"{p}@MISSING"
+                        for p, gen in sorted(g["parts"].items())
+                    )
+                )
+            if "spill" in g:
+                bits.append(
+                    f"spill {g['spill']['files_checked']} files "
+                    + ("resolved" if g["spill"]["ok"] else "BROKEN")
+                )
+            status = "ok" if g["ok"] else "FAILED"
+            print(f"    {status:>6}  " + "  ".join(bits))
+            for e in g["errors"]:
+                print(f"            - {e}")
+    print(f"Verdict: {'resumable' if rep['ok'] else 'NOT resumable'}")
 
 
 def _is_obs_coordinator() -> bool:

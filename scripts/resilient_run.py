@@ -24,6 +24,13 @@ Usage:
     # (round-5 verdict item 5): same env pins, Python watchdog
     python scripts/resilient_run.py --preset prod464
 
+    # fleet mode: supervise a whole 4-process jax.distributed sharded
+    # run (one dead/stalled process tears down and restarts the fleet
+    # from the newest cross-shard-consistent checkpoint generation)
+    python scripts/resilient_run.py --fleet 4 --devices-per-proc 1 -- \\
+        python -m kafka_specification_tpu.utils.cli check \\
+            configs/Kip320.cfg --sharded --cpu --checkpoint .ckpt
+
 This script never imports jax (the parent must survive a wedged tunnel).
 """
 
@@ -79,6 +86,25 @@ def main(argv=None):
     ap.add_argument("--max-restarts", type=int, default=8)
     ap.add_argument("--backoff", type=float, default=5.0)
     ap.add_argument("--backoff-cap", type=float, default=300.0)
+    ap.add_argument(
+        "--fleet",
+        type=int,
+        metavar="P",
+        help="supervise a P-process jax.distributed fleet: the command "
+        "after -- is launched P times (JAX_COORDINATOR_ADDRESS / "
+        "JAX_NUM_PROCESSES / JAX_PROCESS_ID injected, fresh coordinator "
+        "port per attempt).  Per-process shard heartbeats land in "
+        "<run-dir>/shards/ (KSPEC_SHARD_HEARTBEAT_DIR); a dead or "
+        "stalled process tears the WHOLE fleet down and restarts it from "
+        "the newest cross-shard-consistent checkpoint generation",
+    )
+    ap.add_argument(
+        "--devices-per-proc",
+        type=int,
+        help="[--fleet] virtual CPU devices per process "
+        "(--xla_force_host_platform_device_count; for CI/rehearsal "
+        "fleets without real accelerators)",
+    )
     ap.add_argument(
         "--preset",
         choices=["prod464"],
@@ -148,6 +174,7 @@ def main(argv=None):
         preset=args.preset,
         cmd=cmd,
         heartbeat=heartbeat,
+        fleet=args.fleet,
         stall_timeout=args.stall_timeout,
         max_restarts=args.max_restarts,
     )
@@ -155,6 +182,29 @@ def main(argv=None):
         f"[obs] run dir: {run_ctx.dir} (run {run_ctx.run_id})",
         file=sys.stderr,
     )
+    if args.fleet:
+        if args.preset:
+            ap.error("--fleet and --preset are mutually exclusive")
+        from kafka_specification_tpu.resilience.supervisor import (
+            FleetConfig,
+            supervise_fleet,
+        )
+
+        fcfg = FleetConfig(
+            cmd=cmd,
+            num_processes=args.fleet,
+            events=args.events or run_ctx.events_path,
+            heartbeat_dir=os.path.join(run_ctx.dir, "shards"),
+            log_dir=args.log_dir or run_ctx.log_dir,
+            stall_timeout=args.stall_timeout,
+            max_restarts=args.max_restarts,
+            backoff_base=args.backoff,
+            backoff_cap=args.backoff_cap,
+            env=env,
+            run_id=run_ctx.run_id,
+            devices_per_proc=args.devices_per_proc,
+        )
+        return supervise_fleet(fcfg)
     cfg = SupervisorConfig(
         cmd=cmd,
         heartbeat=heartbeat,

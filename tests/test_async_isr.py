@@ -76,5 +76,17 @@ def test_async_isr_m3_v3_exhaustive_matches_oracle():
 def test_rejects_five_replicas():
     # the request-set encoding packs a 2^N-subset bitset into one signed
     # int32 element (models/async_isr.make_spec) — N > 4 must fail loudly
-    with pytest.raises(ValueError, match="at most 4 replicas"):
-        async_isr.make_spec(async_isr.AsyncIsrConfig(5, 1, 1))
+    # at EVERY entry point (VERDICT weak #7): the engine spec, the model
+    # builder, and the oracle (which exists to cross-check the engine and
+    # must not silently accept a config the engine cannot encode)
+    cfg = async_isr.AsyncIsrConfig(5, 1, 1)
+    for entry in (async_isr.make_spec, async_isr.make_model,
+                  async_isr.make_oracle, async_isr.check_encoding_bounds):
+        with pytest.raises(ValueError, match="at most 4 replicas"):
+            entry(cfg)
+    # the message must tell the operator what to do about it
+    with pytest.raises(ValueError, match="reduce the replica count"):
+        async_isr.make_model(cfg)
+    # N = 4 is the documented edge and must keep building (16-bit bitset)
+    async_isr.make_spec(async_isr.AsyncIsrConfig(4, 1, 1))
+    async_isr.make_oracle(async_isr.AsyncIsrConfig(4, 1, 1))

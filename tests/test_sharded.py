@@ -103,7 +103,11 @@ def test_sharded_checkpoint_resume(tmp_path):
     assert resumed.total == 49
 
 
-def test_sharded_checkpoint_rejects_other_mesh_or_model(tmp_path):
+def test_sharded_checkpoint_rejects_other_model_but_resharding_mesh(tmp_path):
+    """A different model/constants still refuses to resume; a different
+    MESH SIZE is no longer a mismatch — it takes the elastic re-shard
+    path and completes exactly (tests/test_sharded_resilience.py has the
+    full elastic matrix)."""
     import pytest as _pytest
 
     ckdir = str(tmp_path / "sck")
@@ -111,8 +115,10 @@ def test_sharded_checkpoint_rejects_other_mesh_or_model(tmp_path):
     with _pytest.raises(ValueError, match="different"):
         check_sharded(frl.make_model(2, 3, 2), min_bucket=32, checkpoint_dir=ckdir)
     mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
-    with _pytest.raises(ValueError, match="different"):
-        check_sharded(frl.make_model(2, 2, 2), mesh=mesh4, min_bucket=32, checkpoint_dir=ckdir)
+    res = check_sharded(
+        frl.make_model(2, 2, 2), mesh=mesh4, min_bucket=32, checkpoint_dir=ckdir
+    )
+    assert res.ok and res.total == 49
 
 
 def test_sharded_exchange_modes_agree():
