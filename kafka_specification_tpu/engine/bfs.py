@@ -42,6 +42,11 @@ from ..ops import dedup, hashset
 from ..ops.fingerprint import fingerprint_lanes
 from ..resilience.checkpoints import CheckpointStore
 from ..resilience.faults import FaultPlan
+from ..resilience.resources import (
+    ResourceExhausted,
+    ResourceGovernor,
+    is_disk_full,
+)
 from ..resilience.retry import ChunkRetryHandler
 
 # insert-or-find on the device hash table; table + claim lattice donated so
@@ -778,6 +783,7 @@ def check(
     mem_budget=None,
     spill_dir: Optional[str] = None,
     store: str = "auto",
+    disk_budget=None,
     run=None,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
@@ -871,6 +877,19 @@ def check(
     run=None and a bare stats_path the per-level stream is emitted exactly
     as before the obs subsystem existed (the shim contract,
     tests/test_obs.py).
+
+    disk_budget: byte budget for the spill + checkpoint directories
+    (resilience.resources.ResourceGovernor; KSPEC_DISK_BUDGET is the env
+    twin, KSPEC_RSS_BUDGET / KSPEC_LEVEL_DEADLINE arm the RSS and
+    per-level-deadline watchdogs).  Crossing the soft fraction triggers
+    reclamation (tmp janitor, eager merges, checkpoint-generation prune,
+    deletion-barrier flush); a hard breach — or a real/injected ENOSPC
+    from any storage writer — performs checkpoint-then-clean-exit: the
+    newest consistent state is saved, the run directory is stamped
+    `resource-exhausted`, and a typed ResourceExhausted propagates (the
+    CLI maps it to exit code 75).  The on-disk state still passes `cli
+    verify-checkpoint`, and resuming after the operator frees space is
+    bit-identical to an uninterrupted run (tests/test_resources.py).
     """
     spec = model.spec
     step_builder = _Step(model)
@@ -1200,6 +1219,48 @@ def check(
         )
 
     chunk = _next_pow2(max(min_bucket, chunk_size))
+    chunk_floor = _next_pow2(max(32, min_bucket))
+
+    # Resource governance (resilience.resources): disk/RSS budgets + the
+    # per-level deadline watchdog, with soft-breach reclamation and a
+    # typed checkpoint-then-clean-exit on hard breach
+    governor = ResourceGovernor.from_env(
+        disk_budget=disk_budget,
+        watch_dirs=[disk.dir if disk is not None else None, checkpoint_dir],
+        fault_plan=fault,
+    )
+
+    def _final_save():
+        # checkpoint-then-clean-exit: persist the just-completed level
+        # even off the checkpoint_every cadence, so the operator resumes
+        # from the breach point, not checkpoint_every-1 levels earlier
+        nonlocal last_ckpt_depth
+        if ckpt_store is not None and last_ckpt_depth != depth:
+            _save_checkpoint()
+            last_ckpt_depth = depth
+
+    def _reclaim():
+        # soft-breach reclamation, in dependency order (docs/resilience.md):
+        # tmp janitor -> eager run merge -> fresh checkpoint (references
+        # the merged state) -> prune older generations -> flush the
+        # deletion barrier (everything still pending was referenced only
+        # by the generations just pruned)
+        nonlocal last_ckpt_depth
+        merged = False
+        if disk is not None:
+            disk.sweep_tmp()
+            merged = disk.reclaim_merge()
+        if ckpt_store is not None:
+            # skip the save when the periodic one just ran at this depth
+            # and no merge changed the on-disk state (the newest gen
+            # already references everything the flush keeps) — the
+            # pressure path is exactly where write bandwidth is scarcest
+            if merged or last_ckpt_depth != depth:
+                _save_checkpoint()
+                last_ckpt_depth = depth
+            ckpt_store.prune(keep_gens=1)
+            if disk is not None:
+                disk.flush_deleted()
 
     # Adaptive per-action compact sizing (two-phase expansion, SURVEY §2.3):
     # enablement density varies two orders of magnitude across actions
@@ -1212,434 +1273,475 @@ def check(
     adaptive_fallback = False
     squeeze_full = False
 
-    while _f_rows(frontier_np) > 0:
-        # level-boundary fault injection point (resilience.faults)
-        fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
-        if max_depth is not None and depth >= max_depth:
-            break
-        if max_states is not None and total >= max_states:
-            break
-        f_total = _f_rows(frontier_np)
-        t_level = time.perf_counter()
-        # begin marker (ph=B): a crash mid-level leaves it unmatched, which
-        # is exactly what `cli report` uses to pin where the run died
-        obs_.level_begin(depth + 1, f_total)
-        # A frontier larger than `chunk` is streamed through the same
-        # compiled step in chunk_size pieces: cross-chunk duplicates are
-        # caught because each chunk probes the visited set updated by the
-        # previous one.  This bounds both the number of compiled shapes
-        # (O(log chunk) buckets, ever) and peak device memory (O(chunk*C)).
-        lvl_rows, lvl_parent, lvl_act = [], [], []
-        lvl_new = 0
-        lvl_act_en = np.zeros(len(model.actions), np.int64)
-        verdict = None  # (kind, global_frontier_idx, inv_name)
-        # Host-native backend: assemble the next level in a preallocated
-        # arena via the fused C pass (native.FpSet.insert_compact) — one
-        # cache-friendly sweep per chunk instead of u64 packing + novelty
-        # mask + masked gathers + per-level concatenate.  Growth copies
-        # only the filled prefix (amortized O(level)).
-        if disk is not None:
-            disk.begin_level(depth + 1)
-        use_arena = host_set is not None and host_set.native
-        if use_arena:
-            a_cap = max(1 << 14, int(1.5 * f_total))
-            a_rows = np.empty((a_cap, K), np.uint32)
-            a_parent = np.empty(a_cap, np.int64)
-            a_act = np.empty(a_cap, np.int32)
-            a_w = 0
-        prof_step = prof_host_s = 0.0
-        for start, piece in _f_chunks(frontier_np, chunk):
-            fp_n = piece.shape[0]
-            bucket = _next_pow2(max(fp_n, min_bucket))
-            M = bucket * C
-            if visited_backend == "device":
-                need = int(vn) + M
-                if need > vcap:
-                    new_cap = _next_pow2(need)
-                    pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
-                    vhi = jnp.concatenate([vhi, pad])
-                    vlo = jnp.concatenate([vlo, pad])
-                    # growth is monotonic: steps compiled for the outgrown
-                    # capacity are dead weight in the Model-lifetime cache
-                    # (each is a full compiled program) — evict them
-                    for k in [
-                        k for k in step_builder._cache if k[1] == vcap
-                    ]:
-                        del step_builder._cache[k]
-                    vcap = new_cap
-            elif ht_hi is not None and 2 * hash_n > ht_hi.shape[0]:
-                # keep load factor under ~1/2 so linear probing stays short
-                ht_hi, ht_lo = hashset.rehash_into(
-                    ht_hi, ht_lo, 2 * ht_hi.shape[0]
-                )
-                ht_claim = None
-            # Candidate compaction: expand/pack/sort/probe/merge at the
-            # enabled width (a few % of M) instead of the padded-lattice
-            # width.  On overflow (an action enabled more pairs than its
-            # compact buffer holds) the visited set returned by the step is
-            # discarded and THIS chunk re-runs with the offending buffers
-            # doubled; the learned floors (act_w_floor) and the
-            # squeeze_full flag persist for the rest of the run so a
-            # recurring density doesn't re-pay the retry every chunk —
-            # exact results either way, sizing is purely a performance
-            # knob.
-            compact_arg = adapt.widths_for(bucket)
-            attempt_sq_full = squeeze_full
-            t_attempt = time.perf_counter()
-            chunk_retry.reset_chunk()
-            while True:
-                try:
-                    injected = fault.chunk_error(
-                        escalated=isinstance(compact_arg, (list, tuple))
-                    )
-                    if injected is not None:
-                        raise injected
-                    step = step_builder.get(
-                        bucket,
-                        vcap,
-                        check_invariants,
-                        with_merge=visited_backend == "device",
-                        compact=compact_arg,
-                        squeeze_full=attempt_sq_full,
-                    )
-                    (
-                        out,
-                        out_parent,
-                        out_act,
-                        new_n,
-                        vhi_n,
-                        vlo_n,
-                        vn_n,
-                        viol_any,
-                        viol_idx,
-                        dl_any,
-                        dl_idx,
-                        act_en,
-                        out_hi,
-                        out_lo,
-                        overflow,
-                        act_guard,
-                    ) = step(
-                        jnp.asarray(_pad_rows(piece, bucket)),
-                        jnp.arange(bucket) < fp_n,
-                        vhi,
-                        vlo,
-                        vn,
-                    )
-                except Exception as e:  # noqa: BLE001 — XLA compile/run
-                    # known failure ladder — one policy for both engines
-                    # (resilience.retry.ChunkRetryHandler): transient
-                    # errors re-run the same attempt after bounded backoff
-                    # (the chunk commits nothing until its results are
-                    # read back, so a re-run is exact); a failed ESCALATED
-                    # compile degrades to the uniform path
-                    # (AdaptiveCompact.compile_fallback); anything else —
-                    # including an exhausted transient budget — re-raises
-                    # for the supervisor's restart layer
-                    if (
-                        chunk_retry.handle(
-                            e,
-                            escalated=isinstance(compact_arg, (list, tuple)),
-                            depth=depth,
-                        )
-                        == "retry"
-                    ):
-                        continue
-                    compact_arg = adapt.compile_fallback(bucket)
-                    adaptive_fallback = True
-                    continue
-                ovf = np.asarray(overflow)
-                if compact_arg is None or not ovf.any():
-                    vhi, vlo, vn = vhi_n, vlo_n, vn_n
-                    break
-                # retry this chunk with the offending buffers widened: a
-                # per-action compact overflow doubles that action's width
-                # (floored for the rest of the run); a squeeze overflow
-                # disables the pre-sort width reduction (sticky); a
-                # uniform-shift overflow steps toward the full path
-                if ovf[-1]:
-                    attempt_sq_full = squeeze_full = True
-                if ovf[:-1].any():
-                    # shared escalation policy (AdaptiveCompact): a uniform
-                    # overflow escalates to per-action widths sized from
-                    # THIS attempt's guard counts (phase A sweeps the full
-                    # lattice, so act_guard is complete even on overflow);
-                    # a per-action overflow doubles the offenders, floored
-                    # for the rest of the run
-                    compact_arg = adapt.escalate(
-                        compact_arg,
-                        ovf[:-1],
-                        bucket,
-                        np.asarray(act_guard, np.int64) / max(fp_n, 1),
-                    )
-            # adapt buffer sizing from the committed attempt's
-            # PRE-constraint guard counts (what the buffers actually hold;
-            # act_en is post-constraint and undercounts on pruning models)
-            act_en_np = np.asarray(act_en, np.int64)
-            act_guard_np = np.asarray(act_guard, np.int64)
-            adapt.observe(act_guard_np / max(fp_n, 1))
-            # frontier-level verdicts (states being expanded = level `depth`)
-            if check_invariants:
-                viol_any_np = np.asarray(viol_any)
-                if viol_any_np.any():
-                    inv_i = int(np.argmax(viol_any_np))
-                    idx = start + int(np.asarray(viol_idx)[inv_i])
-                    verdict = ("invariant", idx, model.invariants[inv_i].name)
-                    break
-            if check_deadlock and bool(dl_any):
-                verdict = ("deadlock", start + int(dl_idx), "Deadlock")
+    exhausted: Optional[ResourceExhausted] = None
+    try:
+        while _f_rows(frontier_np) > 0:
+            # level-boundary fault injection point (resilience.faults)
+            fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
+            if max_depth is not None and depth >= max_depth:
                 break
-            nn = int(new_n)
-            step_s = time.perf_counter() - t_attempt
-            prof_step += step_s
-            obs_.chunk_span(
-                "step", step_s, depth=depth, start=start, rows=fp_n,
-                bucket=bucket,
-            )
-            t_host = time.perf_counter()
-            if host_set is not None and nn:
-                if use_arena:
-                    if a_w + nn > a_cap:
-                        a_cap = max(2 * a_cap, a_w + nn)
-                        na = np.empty((a_cap, K), np.uint32)
-                        na[:a_w] = a_rows[:a_w]
-                        a_rows = na
-                        npar = np.empty(a_cap, np.int64)
-                        npar[:a_w] = a_parent[:a_w]
-                        a_parent = npar
-                        nact = np.empty(a_cap, np.int32)
-                        nact[:a_w] = a_act[:a_w]
-                        a_act = nact
-                    w = host_set.insert_compact(
-                        np.ascontiguousarray(out_hi[:nn], np.uint32),
-                        np.ascontiguousarray(out_lo[:nn], np.uint32),
-                        np.ascontiguousarray(out[:nn], np.uint32),
-                        np.ascontiguousarray(out_parent[:nn], np.int32),
-                        start,
-                        np.ascontiguousarray(out_act[:nn], np.int32),
-                        a_rows[a_w:],
-                        a_parent[a_w:],
-                        a_act[a_w:],
-                    )
-                    a_w += w
-                    lvl_new += w
-                else:  # tiered disk store, or no native toolchain
-                    rows = np.asarray(out[:nn])
-                    mask = host_set.insert(
-                        _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
-                    )
-                    if disk is not None:
-                        # novel rows stream straight to the spilled
-                        # frontier + parent log in discovery order (int64
-                        # parents: level-global indices can pass 2^31 at
-                        # the scales this tier exists for)
-                        disk.append(
-                            rows[mask],
-                            np.asarray(out_parent[:nn], np.int64)[mask] + start,
-                            np.asarray(out_act[:nn])[mask],
-                        )
-                    else:
-                        lvl_rows.append(rows[mask])
-                        lvl_parent.append(
-                            np.asarray(out_parent[:nn])[mask] + start
-                        )
-                        lvl_act.append(np.asarray(out_act[:nn])[mask])
-                    lvl_new += int(mask.sum())
-            elif ht_hi is not None and nn:
-                # device-hash backend: insert-or-find on the HBM table; a
-                # probe-budget overflow grows the table and re-runs the
-                # SAME batch, OR-accumulating novelty (rows inserted by the
-                # failed attempt report "seen" on the re-run, so nothing is
-                # double-counted or lost)
-                valid = jnp.arange(out_hi.shape[0]) < new_n
-                isnew = np.zeros(out_hi.shape[0], bool)
-                while True:
-                    # Pallas probe kernel (ops/pallas_hashset) — the actual
-                    # TPU dedup kernel a live hardware window profiles;
-                    # interpret mode on CPU, bit-identical winners
-                    # (tests/test_pallas.py).  It stages the whole table in
-                    # VMEM, so beyond MAX_VMEM_CAP slots it cannot compile
-                    # — fall back to the jnp HBM probe, loudly, and keep
-                    # checking per iteration (a mid-run rehash can cross
-                    # the threshold).
-                    use_p = use_p_hbm = False
-                    if step_builder.use_pallas:
-                        # lazy import: the default (non-pallas) path must
-                        # not depend on jax.experimental.pallas at all
-                        from ..ops import pallas_hashset as pallas_hs
-
-                        use_p = pallas_hs.fits_vmem(ht_hi.shape[0])
-                        # beyond the VMEM gate: the HBM-resident DMA
-                        # kernel (opt-in until a hardware window profiles
-                        # its per-slot descriptor overhead)
-                        use_p_hbm = not use_p and (
-                            os.environ.get("KSPEC_PALLAS_HBM") == "1"
-                        )
-                    if (
-                        step_builder.use_pallas
-                        and not use_p
-                        and not use_p_hbm
-                        and not pallas_vmem_noted
-                    ):
-                        pallas_vmem_noted = True
-                        print(
-                            "[kspec] KSPEC_USE_PALLAS: table capacity "
-                            f"{ht_hi.shape[0]} exceeds the VMEM-staged "
-                            f"kernel's limit ({pallas_hs.MAX_VMEM_CAP}); "
-                            "falling back to the jnp HBM probe path "
-                            "(KSPEC_PALLAS_HBM=1 selects the HBM-resident "
-                            "DMA kernel instead)",
-                            file=sys.stderr,
-                            flush=True,
-                        )
-                    if use_p_hbm:
-                        ht_hi, ht_lo, m, _ni, ovf = (
-                            pallas_hs.probe_insert_pallas_hbm(
-                                ht_hi,
-                                ht_lo,
-                                out_hi,
-                                out_lo,
-                                valid,
-                                interpret=jax.default_backend() == "cpu",
-                            )
-                        )
-                        ht_claim = None
-                    elif use_p:
-                        # KSPEC_PALLAS_GROUP: interleaved probe chains per
-                        # round (memory-level parallelism; winners
-                        # bit-identical — ops/pallas_hashset)
-                        ht_hi, ht_lo, m, _ni, ovf = (
-                            pallas_hs.probe_insert_pallas(
-                                ht_hi,
-                                ht_lo,
-                                out_hi,
-                                out_lo,
-                                valid,
-                                interpret=jax.default_backend() == "cpu",
-                                group=int(
-                                    os.environ.get("KSPEC_PALLAS_GROUP", "8")
-                                ),
-                            )
-                        )
-                        ht_claim = None
-                    else:
-                        if ht_claim is None:
-                            ht_claim = hashset.new_claim(ht_hi.shape[0])
-                        ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
-                            ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
-                        )
-                    isnew |= np.asarray(m)
-                    if not bool(ovf):
-                        break
+            if max_states is not None and total >= max_states:
+                break
+            f_total = _f_rows(frontier_np)
+            t_level = time.perf_counter()
+            # begin marker (ph=B): a crash mid-level leaves it unmatched, which
+            # is exactly what `cli report` uses to pin where the run died
+            obs_.level_begin(depth + 1, f_total)
+            governor.level_begin(depth + 1)  # arm the per-level deadline
+            # A frontier larger than `chunk` is streamed through the same
+            # compiled step in chunk_size pieces: cross-chunk duplicates are
+            # caught because each chunk probes the visited set updated by the
+            # previous one.  This bounds both the number of compiled shapes
+            # (O(log chunk) buckets, ever) and peak device memory (O(chunk*C)).
+            lvl_rows, lvl_parent, lvl_act = [], [], []
+            lvl_new = 0
+            lvl_act_en = np.zeros(len(model.actions), np.int64)
+            verdict = None  # (kind, global_frontier_idx, inv_name)
+            # Host-native backend: assemble the next level in a preallocated
+            # arena via the fused C pass (native.FpSet.insert_compact) — one
+            # cache-friendly sweep per chunk instead of u64 packing + novelty
+            # mask + masked gathers + per-level concatenate.  Growth copies
+            # only the filled prefix (amortized O(level)).
+            if disk is not None:
+                disk.begin_level(depth + 1)
+            use_arena = host_set is not None and host_set.native
+            if use_arena:
+                a_cap = max(1 << 14, int(1.5 * f_total))
+                a_rows = np.empty((a_cap, K), np.uint32)
+                a_parent = np.empty(a_cap, np.int64)
+                a_act = np.empty(a_cap, np.int32)
+                a_w = 0
+            prof_step = prof_host_s = 0.0
+            for start, piece in _f_chunks(frontier_np, chunk):
+                governor.poll(depth)  # deadline watchdog (cheap)
+                fp_n = piece.shape[0]
+                bucket = _next_pow2(max(fp_n, min_bucket))
+                M = bucket * C
+                if visited_backend == "device":
+                    need = int(vn) + M
+                    if need > vcap:
+                        new_cap = _next_pow2(need)
+                        pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
+                        vhi = jnp.concatenate([vhi, pad])
+                        vlo = jnp.concatenate([vlo, pad])
+                        # growth is monotonic: steps compiled for the outgrown
+                        # capacity are dead weight in the Model-lifetime cache
+                        # (each is a full compiled program) — evict them
+                        for k in [
+                            k for k in step_builder._cache if k[1] == vcap
+                        ]:
+                            del step_builder._cache[k]
+                        vcap = new_cap
+                elif ht_hi is not None and 2 * hash_n > ht_hi.shape[0]:
+                    # keep load factor under ~1/2 so linear probing stays short
                     ht_hi, ht_lo = hashset.rehash_into(
                         ht_hi, ht_lo, 2 * ht_hi.shape[0]
                     )
                     ht_claim = None
-                mask = isnew[:nn]
-                hash_n += int(mask.sum())
-                lvl_rows.append(np.asarray(out[:nn])[mask])
-                lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
-                lvl_act.append(np.asarray(out_act[:nn])[mask])
-                lvl_new += int(mask.sum())
-            elif nn:
-                lvl_rows.append(np.asarray(out[:nn]))
-                lvl_parent.append(np.asarray(out_parent[:nn]) + start)
-                lvl_act.append(np.asarray(out_act[:nn]))
-                lvl_new += nn
-            host_s = time.perf_counter() - t_host
-            prof_host_s += host_s
-            obs_.chunk_span(
-                "host-assembly", host_s, depth=depth, start=start, new=nn,
-                backend=visited_backend,
-            )
-            if collect_stats:
-                lvl_act_en += act_en_np
+                # Candidate compaction: expand/pack/sort/probe/merge at the
+                # enabled width (a few % of M) instead of the padded-lattice
+                # width.  On overflow (an action enabled more pairs than its
+                # compact buffer holds) the visited set returned by the step is
+                # discarded and THIS chunk re-runs with the offending buffers
+                # doubled; the learned floors (act_w_floor) and the
+                # squeeze_full flag persist for the rest of the run so a
+                # recurring density doesn't re-pay the retry every chunk —
+                # exact results either way, sizing is purely a performance
+                # knob.
+                compact_arg = adapt.widths_for(bucket)
+                attempt_sq_full = squeeze_full
+                t_attempt = time.perf_counter()
+                chunk_retry.reset_chunk()
+                while True:
+                    try:
+                        injected = fault.chunk_error(
+                            escalated=isinstance(compact_arg, (list, tuple))
+                        )
+                        if injected is not None:
+                            raise injected
+                        step = step_builder.get(
+                            bucket,
+                            vcap,
+                            check_invariants,
+                            with_merge=visited_backend == "device",
+                            compact=compact_arg,
+                            squeeze_full=attempt_sq_full,
+                        )
+                        (
+                            out,
+                            out_parent,
+                            out_act,
+                            new_n,
+                            vhi_n,
+                            vlo_n,
+                            vn_n,
+                            viol_any,
+                            viol_idx,
+                            dl_any,
+                            dl_idx,
+                            act_en,
+                            out_hi,
+                            out_lo,
+                            overflow,
+                            act_guard,
+                        ) = step(
+                            jnp.asarray(_pad_rows(piece, bucket)),
+                            jnp.arange(bucket) < fp_n,
+                            vhi,
+                            vlo,
+                            vn,
+                        )
+                    except Exception as e:  # noqa: BLE001 — XLA compile/run
+                        # known failure ladder — one policy for both engines
+                        # (resilience.retry.ChunkRetryHandler): transient
+                        # errors re-run the same attempt after bounded backoff
+                        # (the chunk commits nothing until its results are
+                        # read back, so a re-run is exact); a device
+                        # RESOURCE_EXHAUSTED re-runs on the uniform compact
+                        # path AND halves the streaming chunk size for the
+                        # rest of the run (same-shape retries would die
+                        # identically); a failed ESCALATED compile degrades to
+                        # the uniform path (AdaptiveCompact.compile_fallback);
+                        # anything else — including an exhausted transient
+                        # budget — re-raises for the supervisor's restart layer
+                        action = chunk_retry.handle(
+                            e,
+                            escalated=isinstance(compact_arg, (list, tuple)),
+                            depth=depth,
+                        )
+                        if action == "retry":
+                            continue
+                        if action == "degrade_chunk":
+                            chunk = max(chunk_floor, chunk >> 1)
+                        compact_arg = adapt.compile_fallback(bucket)
+                        adaptive_fallback = True
+                        continue
+                    ovf = np.asarray(overflow)
+                    if compact_arg is None or not ovf.any():
+                        vhi, vlo, vn = vhi_n, vlo_n, vn_n
+                        break
+                    # retry this chunk with the offending buffers widened: a
+                    # per-action compact overflow doubles that action's width
+                    # (floored for the rest of the run); a squeeze overflow
+                    # disables the pre-sort width reduction (sticky); a
+                    # uniform-shift overflow steps toward the full path
+                    if ovf[-1]:
+                        attempt_sq_full = squeeze_full = True
+                    if ovf[:-1].any():
+                        # shared escalation policy (AdaptiveCompact): a uniform
+                        # overflow escalates to per-action widths sized from
+                        # THIS attempt's guard counts (phase A sweeps the full
+                        # lattice, so act_guard is complete even on overflow);
+                        # a per-action overflow doubles the offenders, floored
+                        # for the rest of the run
+                        compact_arg = adapt.escalate(
+                            compact_arg,
+                            ovf[:-1],
+                            bucket,
+                            np.asarray(act_guard, np.int64) / max(fp_n, 1),
+                        )
+                # adapt buffer sizing from the committed attempt's
+                # PRE-constraint guard counts (what the buffers actually hold;
+                # act_en is post-constraint and undercounts on pruning models)
+                act_en_np = np.asarray(act_en, np.int64)
+                act_guard_np = np.asarray(act_guard, np.int64)
+                adapt.observe(act_guard_np / max(fp_n, 1))
+                # frontier-level verdicts (states being expanded = level `depth`)
+                if check_invariants:
+                    viol_any_np = np.asarray(viol_any)
+                    if viol_any_np.any():
+                        inv_i = int(np.argmax(viol_any_np))
+                        idx = start + int(np.asarray(viol_idx)[inv_i])
+                        verdict = ("invariant", idx, model.invariants[inv_i].name)
+                        break
+                if check_deadlock and bool(dl_any):
+                    verdict = ("deadlock", start + int(dl_idx), "Deadlock")
+                    break
+                nn = int(new_n)
+                step_s = time.perf_counter() - t_attempt
+                prof_step += step_s
+                obs_.chunk_span(
+                    "step", step_s, depth=depth, start=start, rows=fp_n,
+                    bucket=bucket,
+                )
+                t_host = time.perf_counter()
+                if host_set is not None and nn:
+                    if use_arena:
+                        if a_w + nn > a_cap:
+                            a_cap = max(2 * a_cap, a_w + nn)
+                            na = np.empty((a_cap, K), np.uint32)
+                            na[:a_w] = a_rows[:a_w]
+                            a_rows = na
+                            npar = np.empty(a_cap, np.int64)
+                            npar[:a_w] = a_parent[:a_w]
+                            a_parent = npar
+                            nact = np.empty(a_cap, np.int32)
+                            nact[:a_w] = a_act[:a_w]
+                            a_act = nact
+                        w = host_set.insert_compact(
+                            np.ascontiguousarray(out_hi[:nn], np.uint32),
+                            np.ascontiguousarray(out_lo[:nn], np.uint32),
+                            np.ascontiguousarray(out[:nn], np.uint32),
+                            np.ascontiguousarray(out_parent[:nn], np.int32),
+                            start,
+                            np.ascontiguousarray(out_act[:nn], np.int32),
+                            a_rows[a_w:],
+                            a_parent[a_w:],
+                            a_act[a_w:],
+                        )
+                        a_w += w
+                        lvl_new += w
+                    else:  # tiered disk store, or no native toolchain
+                        rows = np.asarray(out[:nn])
+                        mask = host_set.insert(
+                            _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
+                        )
+                        if disk is not None:
+                            # novel rows stream straight to the spilled
+                            # frontier + parent log in discovery order (int64
+                            # parents: level-global indices can pass 2^31 at
+                            # the scales this tier exists for)
+                            disk.append(
+                                rows[mask],
+                                np.asarray(out_parent[:nn], np.int64)[mask] + start,
+                                np.asarray(out_act[:nn])[mask],
+                            )
+                        else:
+                            lvl_rows.append(rows[mask])
+                            lvl_parent.append(
+                                np.asarray(out_parent[:nn])[mask] + start
+                            )
+                            lvl_act.append(np.asarray(out_act[:nn])[mask])
+                        lvl_new += int(mask.sum())
+                elif ht_hi is not None and nn:
+                    # device-hash backend: insert-or-find on the HBM table; a
+                    # probe-budget overflow grows the table and re-runs the
+                    # SAME batch, OR-accumulating novelty (rows inserted by the
+                    # failed attempt report "seen" on the re-run, so nothing is
+                    # double-counted or lost)
+                    valid = jnp.arange(out_hi.shape[0]) < new_n
+                    isnew = np.zeros(out_hi.shape[0], bool)
+                    while True:
+                        # Pallas probe kernel (ops/pallas_hashset) — the actual
+                        # TPU dedup kernel a live hardware window profiles;
+                        # interpret mode on CPU, bit-identical winners
+                        # (tests/test_pallas.py).  It stages the whole table in
+                        # VMEM, so beyond MAX_VMEM_CAP slots it cannot compile
+                        # — fall back to the jnp HBM probe, loudly, and keep
+                        # checking per iteration (a mid-run rehash can cross
+                        # the threshold).
+                        use_p = use_p_hbm = False
+                        if step_builder.use_pallas:
+                            # lazy import: the default (non-pallas) path must
+                            # not depend on jax.experimental.pallas at all
+                            from ..ops import pallas_hashset as pallas_hs
 
-        if verdict is not None:
-            kind, idx, inv_name = verdict
+                            use_p = pallas_hs.fits_vmem(ht_hi.shape[0])
+                            # beyond the VMEM gate: the HBM-resident DMA
+                            # kernel (opt-in until a hardware window profiles
+                            # its per-slot descriptor overhead)
+                            use_p_hbm = not use_p and (
+                                os.environ.get("KSPEC_PALLAS_HBM") == "1"
+                            )
+                        if (
+                            step_builder.use_pallas
+                            and not use_p
+                            and not use_p_hbm
+                            and not pallas_vmem_noted
+                        ):
+                            pallas_vmem_noted = True
+                            print(
+                                "[kspec] KSPEC_USE_PALLAS: table capacity "
+                                f"{ht_hi.shape[0]} exceeds the VMEM-staged "
+                                f"kernel's limit ({pallas_hs.MAX_VMEM_CAP}); "
+                                "falling back to the jnp HBM probe path "
+                                "(KSPEC_PALLAS_HBM=1 selects the HBM-resident "
+                                "DMA kernel instead)",
+                                file=sys.stderr,
+                                flush=True,
+                            )
+                        if use_p_hbm:
+                            ht_hi, ht_lo, m, _ni, ovf = (
+                                pallas_hs.probe_insert_pallas_hbm(
+                                    ht_hi,
+                                    ht_lo,
+                                    out_hi,
+                                    out_lo,
+                                    valid,
+                                    interpret=jax.default_backend() == "cpu",
+                                )
+                            )
+                            ht_claim = None
+                        elif use_p:
+                            # KSPEC_PALLAS_GROUP: interleaved probe chains per
+                            # round (memory-level parallelism; winners
+                            # bit-identical — ops/pallas_hashset)
+                            ht_hi, ht_lo, m, _ni, ovf = (
+                                pallas_hs.probe_insert_pallas(
+                                    ht_hi,
+                                    ht_lo,
+                                    out_hi,
+                                    out_lo,
+                                    valid,
+                                    interpret=jax.default_backend() == "cpu",
+                                    group=int(
+                                        os.environ.get("KSPEC_PALLAS_GROUP", "8")
+                                    ),
+                                )
+                            )
+                            ht_claim = None
+                        else:
+                            if ht_claim is None:
+                                ht_claim = hashset.new_claim(ht_hi.shape[0])
+                            ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
+                                ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
+                            )
+                        isnew |= np.asarray(m)
+                        if not bool(ovf):
+                            break
+                        ht_hi, ht_lo = hashset.rehash_into(
+                            ht_hi, ht_lo, 2 * ht_hi.shape[0]
+                        )
+                        ht_claim = None
+                    mask = isnew[:nn]
+                    hash_n += int(mask.sum())
+                    lvl_rows.append(np.asarray(out[:nn])[mask])
+                    lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
+                    lvl_act.append(np.asarray(out_act[:nn])[mask])
+                    lvl_new += int(mask.sum())
+                elif nn:
+                    lvl_rows.append(np.asarray(out[:nn]))
+                    lvl_parent.append(np.asarray(out_parent[:nn]) + start)
+                    lvl_act.append(np.asarray(out_act[:nn]))
+                    lvl_new += nn
+                host_s = time.perf_counter() - t_host
+                prof_host_s += host_s
+                obs_.chunk_span(
+                    "host-assembly", host_s, depth=depth, start=start, new=nn,
+                    backend=visited_backend,
+                )
+                if collect_stats:
+                    lvl_act_en += act_en_np
+
+            if verdict is not None:
+                kind, idx, inv_name = verdict
+                if disk is not None:
+                    disk.abort_level()  # partial next-level writer: discard
+                if have_trace(depth):
+                    violation = build_violation(inv_name, depth, idx)
+                else:
+                    violation = Violation(
+                        invariant=inv_name,
+                        depth=depth,
+                        state=decode_state(_f_row(frontier_np, idx)),
+                        trace=[],
+                    )
+                break
+
+            new_n = lvl_new
+            if use_arena:
+                next_frontier = a_rows[:a_w]
+                level_parent = a_parent[:a_w]
+                level_act = a_act[:a_w]
+                if (store_trace or collect_levels is not None) and a_w < int(
+                    0.95 * a_cap
+                ):
+                    # retained levels: shrink-copy so the trace store doesn't
+                    # hold the arena's growth headroom for the whole run
+                    next_frontier = next_frontier.copy()
+                    level_parent = level_parent.copy()
+                    level_act = level_act.copy()
+            elif disk is not None:
+                # publish the level: segments + parent-log frame become the
+                # pending frontier; the consumed level's segments go behind
+                # the checkpoint-generation deletion barrier
+                next_frontier = disk.end_level()
+                level_parent = level_act = None  # trace lives in the log
+            else:
+                next_frontier = (
+                    np.concatenate(lvl_rows)
+                    if lvl_rows
+                    else np.empty((0, K), np.uint32)
+                )
+                level_parent = (
+                    np.concatenate(lvl_parent)
+                    if lvl_parent
+                    else np.empty(0, np.int64)
+                )
+                level_act = (
+                    np.concatenate(lvl_act) if lvl_act else np.empty(0, np.int64)
+                )
+            depth += 1
+            if new_n:
+                levels.append(new_n)
+                total += new_n
+            if collect_stats:
+                enabled_total = int(lvl_act_en.sum())
+                # heartbeat-enveloped (kind/ts/unix): the per-level stats
+                # stream doubles as the supervisor's liveness signal.  The obs
+                # shim emits the historical record shape (and, with a run
+                # context, additionally stamps run_id, closes the level span,
+                # and folds the metrics registry + Prometheus export)
+                rec = obs_.level(
+                    depth=depth,
+                    frontier=f_total,
+                    enabled_candidates=enabled_total,
+                    new=new_n,
+                    duplicates=enabled_total - new_n,
+                    total=total,
+                    level_ms=round((time.perf_counter() - t_level) * 1e3, 1),
+                    step_ms=round(prof_step * 1e3, 1),
+                    host_ms=round(prof_host_s * 1e3, 1),
+                    action_enablement={
+                        a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
+                    },
+                )
+                result_stats.setdefault("levels", []).append(rec)
+            if collect_levels is not None and new_n:
+                collect_levels.append(_f_all(next_frontier))
+            if store_trace:
+                trace_store.append((next_frontier, level_parent, level_act))
+            if progress:
+                progress(depth, new_n, total)
+
+            frontier_np = next_frontier
+            if ckpt_store is not None and depth % checkpoint_every == 0:
+                _save_checkpoint()
+                last_ckpt_depth = depth
+            # level-boundary resource governance: pressure gauges, injected
+            # stall, soft-breach reclamation, hard-breach typed clean exit
+            governor.level_end(depth, reclaim=_reclaim, save_hook=_final_save)
+    except ResourceExhausted as e:
+        exhausted = e
+    except OSError as e:
+        if not is_disk_full(e):
+            raise
+        # a real ENOSPC from a storage/checkpoint writer outside the
+        # injected paths: same typed clean exit (every writer cleans
+        # up its tmp on failure, so the promoted state is intact)
+        exhausted = ResourceExhausted("enospc", str(e), depth=depth)
+    if exhausted is not None:
+        # the terminal path itself writes (manifest rewrite, metrics
+        # snapshot) to the same full filesystem — best-effort only, so a
+        # second ENOSPC can't demote the typed exit-75 into a torn crash
+        try:
             if disk is not None:
                 disk.abort_level()  # partial next-level writer: discard
-            if have_trace(depth):
-                violation = build_violation(inv_name, depth, idx)
-            else:
-                violation = Violation(
-                    invariant=inv_name,
-                    depth=depth,
-                    state=decode_state(_f_row(frontier_np, idx)),
-                    trace=[],
-                )
-            break
-
-        new_n = lvl_new
-        if use_arena:
-            next_frontier = a_rows[:a_w]
-            level_parent = a_parent[:a_w]
-            level_act = a_act[:a_w]
-            if (store_trace or collect_levels is not None) and a_w < int(
-                0.95 * a_cap
-            ):
-                # retained levels: shrink-copy so the trace store doesn't
-                # hold the arena's growth headroom for the whole run
-                next_frontier = next_frontier.copy()
-                level_parent = level_parent.copy()
-                level_act = level_act.copy()
-        elif disk is not None:
-            # publish the level: segments + parent-log frame become the
-            # pending frontier; the consumed level's segments go behind
-            # the checkpoint-generation deletion barrier
-            next_frontier = disk.end_level()
-            level_parent = level_act = None  # trace lives in the log
-        else:
-            next_frontier = (
-                np.concatenate(lvl_rows)
-                if lvl_rows
-                else np.empty((0, K), np.uint32)
+            # typed terminal: the run manifest records WHY (`cli report`
+            # renders the RESOURCE_EXHAUSTED verdict beat from it), and the
+            # exception propagates for the CLI's exit-code-75 mapping
+            obs_.abort(
+                "resource-exhausted",
+                reason=exhausted.reason,
+                depth=exhausted.depth,
+                detail=exhausted.detail,
+                distinct_states=total,
+                **governor.stats(),
             )
-            level_parent = (
-                np.concatenate(lvl_parent)
-                if lvl_parent
-                else np.empty(0, np.int64)
-            )
-            level_act = (
-                np.concatenate(lvl_act) if lvl_act else np.empty(0, np.int64)
-            )
-        depth += 1
-        if new_n:
-            levels.append(new_n)
-            total += new_n
-        if collect_stats:
-            enabled_total = int(lvl_act_en.sum())
-            # heartbeat-enveloped (kind/ts/unix): the per-level stats
-            # stream doubles as the supervisor's liveness signal.  The obs
-            # shim emits the historical record shape (and, with a run
-            # context, additionally stamps run_id, closes the level span,
-            # and folds the metrics registry + Prometheus export)
-            rec = obs_.level(
-                depth=depth,
-                frontier=f_total,
-                enabled_candidates=enabled_total,
-                new=new_n,
-                duplicates=enabled_total - new_n,
-                total=total,
-                level_ms=round((time.perf_counter() - t_level) * 1e3, 1),
-                step_ms=round(prof_step * 1e3, 1),
-                host_ms=round(prof_host_s * 1e3, 1),
-                action_enablement={
-                    a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
-                },
-            )
-            result_stats.setdefault("levels", []).append(rec)
-        if collect_levels is not None and new_n:
-            collect_levels.append(_f_all(next_frontier))
-        if store_trace:
-            trace_store.append((next_frontier, level_parent, level_act))
-        if progress:
-            progress(depth, new_n, total)
-
-        frontier_np = next_frontier
-        if ckpt_store is not None and depth % checkpoint_every == 0:
-            _save_checkpoint()
-            last_ckpt_depth = depth
+            obs_.close()
+        except OSError:
+            pass
+        raise exhausted
 
     if violation is None and check_invariants and model.invariants and _f_rows(frontier_np):
         # the loop was cut (max_depth/max_states) before the remaining

@@ -150,6 +150,14 @@ class RunObserver:
             self.run.tracer.emit_span(kind, t1 - seconds, t1, **attrs)
 
     # --- terminal ----------------------------------------------------------
+    def abort(self, status: str, **detail) -> None:
+        """Terminal manifest update for a non-CheckResult ending — the
+        typed RESOURCE_EXHAUSTED clean exit (resilience.resources): the
+        manifest's status is what `cli report`'s verdict keys on, and the
+        detail (reason / depth / states so far) lands under result."""
+        if self.run is not None:
+            self.run.finish(status, **detail)
+
     def finish(self, result) -> None:
         """Fold the terminal CheckResult into metrics + manifest."""
         if self.run is None:

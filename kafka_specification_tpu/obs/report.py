@@ -35,8 +35,12 @@ _SPARK = "▁▂▃▄▅▆▇█"
 _EVENT_KINDS = (
     "retry",
     "compile-fallback",
+    "chunk-degrade",
     "checkpoint-fallback",
     "elastic-reshard",
+    "resource-pressure",
+    "reclaim",
+    "resource-exhausted",
     "xprof-start",
     "xprof-stop",
 )
@@ -82,6 +86,9 @@ def load_run(run_dir: str) -> dict:
         "spans": [s for s in spans if s.get("kind") == "span"],
         "obs_events": [s for s in spans if s.get("kind") == "event"],
         "metrics": metrics[-1] if metrics else None,
+        # full snapshot history: the resource-pressure timeline reads the
+        # disk/RSS gauges ACROSS snapshots, not just the last one
+        "metrics_history": metrics,
         "shard_heartbeats": shard_streams,
     }
 
@@ -103,7 +110,10 @@ def verdict(data: dict, now: Optional[float] = None) -> dict:
     growth within the stall timeout), so report and sentry agree."""
     man = data["manifest"]
     status = man.get("status")
-    if status in ("complete", "violation", "error"):
+    if status in ("complete", "violation", "error", "resource-exhausted"):
+        # resource-exhausted is TERMINAL, not a crash: the run checkpointed
+        # and exited clean (exit code 75); it resumes once the operator
+        # frees space — the detail says what ran out and where
         return {"status": status, "detail": man.get("result", {})}
     now = time.time() if now is None else now
     beats = [r.get("unix") for r in data["levels"] if r.get("unix")]
@@ -301,6 +311,7 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
                 and s.get("depth") not in closed:
             open_level = s.get("depth")
     shard_procs = _shard_proc_summary(data)
+    resource = _resource_pressure(data)
     vd = verdict(data, now=now)
     died = (
         _died_shards(shard_procs)
@@ -321,7 +332,59 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
         "open_level": open_level,
         "shard_procs": shard_procs,
         "died_shards": died,
+        "resource": resource,
     }
+
+
+def _resource_pressure(data: dict) -> dict:
+    """Disk/RSS pressure timeline (resilience.resources): gauge history
+    across metric snapshots + reclaim / exhaustion events."""
+    series: dict = {}
+    for snap in data.get("metrics_history") or ():
+        for key in (
+            "kspec_disk_used_bytes",
+            "kspec_rss_bytes",
+        ):
+            v = (snap.get("gauges") or {}).get(key)
+            if v is not None:
+                series.setdefault(key, []).append(v)
+    last = data.get("metrics") or {}
+    gauges = last.get("gauges") or {}
+    events = [
+        e
+        for e in data["obs_events"]
+        if e.get("event") in ("resource-pressure", "reclaim",
+                              "resource-exhausted", "chunk-degrade")
+    ]
+    out = {
+        "disk_used": gauges.get("kspec_disk_used_bytes"),
+        "disk_budget": gauges.get("kspec_disk_budget_bytes"),
+        "rss": gauges.get("kspec_rss_bytes"),
+        "rss_budget": gauges.get("kspec_rss_budget_bytes"),
+        "series": series,
+        "events": events,
+        "reclaims": (last.get("counters") or {}).get(
+            "kspec_reclaims_total", 0
+        ),
+    }
+    out["present"] = bool(
+        events
+        or out["disk_budget"]
+        or out["rss_budget"]
+        or any(series.values())
+    )
+    return out
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024:
+            return f"{n:,.0f}{unit}" if unit == "B" else f"{n:,.1f}{unit}"
+        n /= 1024
+    return f"{n:,.1f}TiB"
 
 
 def render_report(run_dir: str, now: Optional[float] = None,
@@ -351,6 +414,22 @@ def render_report(run_dir: str, now: Optional[float] = None,
     out.append("  " + "  ".join(bits))
     if v["detail"]:
         out.append("  " + json.dumps(v["detail"], default=str))
+    if v["status"] == "resource-exhausted":
+        # the verdict beat: this run did NOT crash — it checkpointed and
+        # exited typed (exit code 75) because it ran out of something;
+        # tell the operator exactly what to do next
+        d = v["detail"] or {}
+        out.append(
+            f"  RESOURCE EXHAUSTED: {d.get('reason', '?')} at level "
+            f"{d.get('depth', '?')} after {d.get('distinct_states', '?')} "
+            f"distinct states — clean typed exit, checkpoint intact."
+        )
+        out.append(
+            "  next: free space (or raise --disk-budget), confirm with "
+            "`cli verify-checkpoint`, then re-run the same command to "
+            "resume — or supervise with --reclaim for one automatic "
+            "prune-and-retry."
+        )
     if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
         out.append(f"  died mid-level: level {r['open_level']} began but "
                    f"never completed")
@@ -440,6 +519,42 @@ def render_report(run_dir: str, now: Optional[float] = None,
                 out.append(
                     f"  {k}: {a['count']}x, {_fmt_dur(a['ms'] / 1e3)} total"
                 )
+    # --- resource pressure ------------------------------------------------
+    res = r.get("resource") or {}
+    if res.get("present"):
+        out.append("")
+        out.append("Resource pressure (disk / RSS gauges, "
+                   "reclaim + exhaustion events):")
+        if res.get("disk_budget"):
+            used, bud = res.get("disk_used"), res["disk_budget"]
+            pct = 100.0 * used / bud if used is not None and bud else 0.0
+            out.append(
+                f"  disk  {_fmt_bytes(used)} / {_fmt_bytes(bud)} budget "
+                f"({pct:.0f}%)  {_spark(res['series'].get('kspec_disk_used_bytes', []))}"
+            )
+        elif res["series"].get("kspec_disk_used_bytes"):
+            out.append(
+                f"  disk  {_fmt_bytes(res.get('disk_used'))} used "
+                f"(no budget)  "
+                f"{_spark(res['series'].get('kspec_disk_used_bytes', []))}"
+            )
+        if res.get("rss") is not None:
+            bud = res.get("rss_budget")
+            out.append(
+                f"  rss   {_fmt_bytes(res['rss'])}"
+                + (f" / {_fmt_bytes(bud)} budget" if bud else "")
+                + f"  {_spark(res['series'].get('kspec_rss_bytes', []))}"
+            )
+        if res.get("reclaims"):
+            out.append(f"  reclaims: {res['reclaims']}")
+        for ev in res.get("events", [])[-8:]:
+            extra = {
+                k: v2
+                for k, v2 in ev.items()
+                if k not in ("kind", "ts", "unix", "event", "run_id")
+            }
+            out.append(f"  {ev.get('ts', '?')}  {ev.get('event')}  "
+                       f"{json.dumps(extra, default=str)}")
     # --- timeline ---------------------------------------------------------
     if r["timeline"]:
         out.append("")

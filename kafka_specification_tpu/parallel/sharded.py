@@ -69,6 +69,11 @@ from ..ops import dedup, hashset
 from ..resilience.checkpoints import CheckpointStore
 from ..resilience.faults import FaultPlan
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..resilience.resources import (
+    ResourceExhausted,
+    ResourceGovernor,
+    is_disk_full,
+)
 from ..resilience.retry import ChunkRetryHandler
 from ..storage.parent_log import ShardedParentLog
 from .multihost import (
@@ -544,6 +549,7 @@ def check_sharded(
     mem_budget=None,
     spill_dir: Optional[str] = None,
     store: str = "auto",
+    disk_budget=None,
     run=None,
     shard_heartbeat_dir: Optional[str] = None,
 ) -> CheckResult:
@@ -620,6 +626,17 @@ def check_sharded(
     gauge; spans/metrics/manifest land in the run directory.  In a
     multi-process job only the coordinator observes (the replicated host
     loops would otherwise write D copies of every artifact).
+
+    disk_budget: spill + checkpoint directory byte budget
+    (resilience.resources) — soft breach reclaims (tmp janitor, eager
+    per-shard merges, checkpoint-generation prune, deletion-barrier
+    flush), hard breach (or a real/injected ENOSPC from any storage
+    writer, incl. the `enospc@...` / `stall@level:N` faults with
+    `shard<d>:` scopes) performs checkpoint-then-clean-exit with a typed
+    ResourceExhausted (CLI exit code 75).  In a multi-process fleet the
+    breaching process exits typed, its peers wedge in the next
+    collective, and the fleet supervisor classifies the rc-75 exit as a
+    resource verdict instead of restarting into the same full disk.
     """
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
@@ -920,6 +937,7 @@ def check_sharded(
                 D,
                 local_shards=my_shards,
                 epoch_writer=is_coordinator(),
+                fault_plan=fault,
             )
 
         def _parts_for(main):
@@ -1241,6 +1259,53 @@ def check_sharded(
             ),
         )
 
+    # Resource governance (resilience.resources): disk budget over the
+    # spill + checkpoint dirs, RSS/deadline watchdogs, injected stall —
+    # per process (each host watches its own disk/RSS; in a fleet the
+    # breaching process exits typed and the supervisor classifies it)
+    governor = ResourceGovernor.from_env(
+        disk_budget=disk_budget,
+        watch_dirs=[spill_base, checkpoint_dir],
+        fault_plan=fault,
+    )
+
+    def _final_save():
+        # checkpoint-then-clean-exit: persist the just-completed level
+        # even off the checkpoint_every cadence
+        nonlocal last_ckpt_depth
+        if ckpt_store is not None and last_ckpt_depth != depth:
+            _save_checkpoint()
+            last_ckpt_depth = depth
+
+    def _reclaim():
+        # soft-breach reclamation (docs/resilience.md): tmp janitor ->
+        # eager per-shard merges -> fresh checkpoint -> prune generations
+        # (coordinator; parts of pruned gens go with them) -> flush each
+        # owned shard's deletion barrier
+        nonlocal last_ckpt_depth
+        merged = False
+        if use_disk:
+            from ..storage.atomic import sweep_tmp
+
+            for s in host_sets:
+                if s is not None:
+                    sweep_tmp(s.dir)
+                    if len(s.runs) > 1:
+                        s.merge()
+                        merged = True
+        if ckpt_store is not None:
+            # save only when something changed since the periodic save at
+            # this depth (same guard as engine.bfs._reclaim)
+            if merged or last_ckpt_depth != depth:
+                _save_checkpoint()
+                last_ckpt_depth = depth
+            if is_coordinator():
+                ckpt_store.prune(keep_gens=1)
+            if use_disk:
+                for s in host_sets:
+                    if s is not None:
+                        s.deleter.flush()
+
     if elastic_resumed:
         # persist one generation in the NEW layout immediately: a crash
         # before the next periodic save then resumes into this layout
@@ -1290,394 +1355,453 @@ def check_sharded(
 
     _shard_beat(depth, event="start", resumed=bool(resumed))
     cut = False
-    while any(p.shape[0] for p in pending):
-        # level-boundary fault injection point (resilience.faults); the
-        # plan derives from the replicated env, so every process raises
-        # (or not) in lockstep
-        fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
-        if max_depth is not None and depth >= max_depth:
-            cut = True
-            break
-        if max_states is not None and total >= max_states:
-            cut = True
-            break
-        t_level = time.perf_counter()
-        obs_.level_begin(depth + 1, int(sum(p.shape[0] for p in pending)))
-        next_pending = [[] for _ in range(D)]
-        next_parent = [[] for _ in range(D)]
-        next_act = [[] for _ in range(D)]
-        lvl_act_en = np.zeros(len(model.actions), np.int64)
-        lvl_new_per_shard = np.zeros(D, np.int64)
-        # per-shard breakdowns for the stats stream (exchange imbalance is
-        # invisible in coordinator-aggregated totals): enabled candidates
-        # per SOURCE shard, and — host backend, where the coordinator sees
-        # the novelty masks — received candidates per OWNER shard
-        lvl_en_per_shard = np.zeros(D, np.int64)
-        lvl_recv_per_shard = np.zeros(D, np.int64)
-        offs = [0] * D
-        # base offset of each shard's rows in this level's shard-major order
-        prev_base = np.concatenate([[0], np.cumsum([p.shape[0] for p in pending])])
-        verdict = None  # (inv_name, frontier_row_np, global_idx)
-        while verdict is None:
-            rem = max(p.shape[0] - o for p, o in zip(pending, offs))
-            if rem <= 0:
+    exhausted: Optional[ResourceExhausted] = None
+    try:
+        while any(p.shape[0] for p in pending):
+            # level-boundary fault injection point (resilience.faults); the
+            # plan derives from the replicated env, so every process raises
+            # (or not) in lockstep
+            fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
+            if max_depth is not None and depth >= max_depth:
+                cut = True
                 break
-            bucket = min(_next_pow2(max(rem, min_bucket // D, 32)), chunk)
-            frontier = np.zeros((D, bucket, K), np.uint32)
-            took = np.zeros(D, np.int32)
-            chunk_off = np.asarray(offs, np.int64)
-            for d in range(D):
-                rows = pending[d][offs[d] : offs[d] + bucket]
-                frontier[d, : rows.shape[0]] = rows
-                took[d] = rows.shape[0]
-                offs[d] += rows.shape[0]
-            fvalid = np.arange(bucket)[None, :] < took[:, None]
+            if max_states is not None and total >= max_states:
+                cut = True
+                break
+            t_level = time.perf_counter()
+            obs_.level_begin(depth + 1, int(sum(p.shape[0] for p in pending)))
+            governor.level_begin(depth + 1)  # arm the per-level deadline
+            next_pending = [[] for _ in range(D)]
+            next_parent = [[] for _ in range(D)]
+            next_act = [[] for _ in range(D)]
+            lvl_act_en = np.zeros(len(model.actions), np.int64)
+            lvl_new_per_shard = np.zeros(D, np.int64)
+            # per-shard breakdowns for the stats stream (exchange imbalance is
+            # invisible in coordinator-aggregated totals): enabled candidates
+            # per SOURCE shard, and — host backend, where the coordinator sees
+            # the novelty masks — received candidates per OWNER shard
+            lvl_en_per_shard = np.zeros(D, np.int64)
+            lvl_recv_per_shard = np.zeros(D, np.int64)
+            offs = [0] * D
+            # base offset of each shard's rows in this level's shard-major order
+            prev_base = np.concatenate([[0], np.cumsum([p.shape[0] for p in pending])])
+            verdict = None  # (inv_name, frontier_row_np, global_idx)
+            while verdict is None:
+                rem = max(p.shape[0] - o for p, o in zip(pending, offs))
+                if rem <= 0:
+                    break
+                governor.poll(depth)  # deadline watchdog (cheap)
+                bucket = min(_next_pow2(max(rem, min_bucket // D, 32)), chunk)
+                frontier = np.zeros((D, bucket, K), np.uint32)
+                took = np.zeros(D, np.int32)
+                chunk_off = np.asarray(offs, np.int64)
+                for d in range(D):
+                    rows = pending[d][offs[d] : offs[d] + bucket]
+                    frontier[d, : rows.shape[0]] = rows
+                    took[d] = rows.shape[0]
+                    offs[d] += rows.shape[0]
+                fvalid = np.arange(bucket)[None, :] < took[:, None]
 
-            # overflow-retry loop: a uniform-shift expansion overflow
-            # escalates to per-action adaptive widths seeded from the
-            # overflowing attempt's guard counts (or, with adaptation off,
-            # steps the shift toward the full path); a per-action overflow
-            # doubles the offending buffers (floored for the rest of the
-            # run); destination-bucket overflow doubles the per-dest width.
-            # A failed attempt's visited arrays are simply discarded (the
-            # step is functional), so results stay exact at every width.
-            # Width retries are CHUNK-LOCAL (learned floors persist): one
-            # dense or skew-routed chunk must not pin the whole remaining
-            # run to a wider shape (the compiled steps stay cached).
-            attempt, w_try = adapt.widths_for(bucket), w_extra
-            chunk_retry.reset_chunk()
-            t_chunk = time.perf_counter()
-            while True:
-                if isinstance(attempt, int):
-                    ca = _norm_shift(bucket, attempt) or None
-                else:
-                    ca = attempt  # per-action width tuple, or None (full)
-                T = expander.expand_width(bucket, ca)
-                W = min(T, _default_dest_w(T, D) << w_try)
-                R = D * W if exchange == "all_to_all" else D * T
-                if visited_backend == "device-hash":
-                    # keep every shard's table under ~1/2 load so linear
-                    # probing stays short (shard_visited is host-tracked)
-                    if 2 * int(shard_visited.max()) > vcap:
-                        dev_vhi, dev_vlo, vcap = _grow_hash_tables(
-                            dev_vhi, dev_vlo, 2 * vcap, shard1
-                        )
-                if visited_backend == "device":
-                    # grow per-shard visited capacity for the worst-case merge
-                    need = int(fetch_global(dev_vn).max()) + R
-                    if need > vcap:
-                        vcap = _next_pow2(need)
-                        if is_multiprocess():
-                            # host round-trip: every process needs the full
-                            # global array to contribute its shards
-                            grown_hi = fetch_global(dev_vhi)
-                            grown_lo = fetch_global(dev_vlo)
-                            pad = np.full(
-                                (D, vcap - grown_hi.shape[1]), 0xFFFFFFFF, np.uint32
+                # overflow-retry loop: a uniform-shift expansion overflow
+                # escalates to per-action adaptive widths seeded from the
+                # overflowing attempt's guard counts (or, with adaptation off,
+                # steps the shift toward the full path); a per-action overflow
+                # doubles the offending buffers (floored for the rest of the
+                # run); destination-bucket overflow doubles the per-dest width.
+                # A failed attempt's visited arrays are simply discarded (the
+                # step is functional), so results stay exact at every width.
+                # Width retries are CHUNK-LOCAL (learned floors persist): one
+                # dense or skew-routed chunk must not pin the whole remaining
+                # run to a wider shape (the compiled steps stay cached).
+                attempt, w_try = adapt.widths_for(bucket), w_extra
+                chunk_retry.reset_chunk()
+                t_chunk = time.perf_counter()
+                while True:
+                    if isinstance(attempt, int):
+                        ca = _norm_shift(bucket, attempt) or None
+                    else:
+                        ca = attempt  # per-action width tuple, or None (full)
+                    T = expander.expand_width(bucket, ca)
+                    W = min(T, _default_dest_w(T, D) << w_try)
+                    R = D * W if exchange == "all_to_all" else D * T
+                    if visited_backend == "device-hash":
+                        # keep every shard's table under ~1/2 load so linear
+                        # probing stays short (shard_visited is host-tracked)
+                        if 2 * int(shard_visited.max()) > vcap:
+                            dev_vhi, dev_vlo, vcap = _grow_hash_tables(
+                                dev_vhi, dev_vlo, 2 * vcap, shard1
                             )
-                            dev_vhi = put_global(
-                                np.concatenate([grown_hi, pad], axis=1), shard1
-                            )
-                            dev_vlo = put_global(
-                                np.concatenate([grown_lo, pad], axis=1), shard1
-                            )
-                        else:
-                            # single-process: grow on device, no host copy
-                            pad = jnp.full(
-                                (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
-                            )
-                            dev_vhi = jax.device_put(
-                                jnp.concatenate([dev_vhi, pad], axis=1), shard1
-                            )
-                            dev_vlo = jax.device_put(
-                                jnp.concatenate([dev_vlo, pad], axis=1), shard1
-                            )
+                    if visited_backend == "device":
+                        # grow per-shard visited capacity for the worst-case merge
+                        need = int(fetch_global(dev_vn).max()) + R
+                        if need > vcap:
+                            vcap = _next_pow2(need)
+                            if is_multiprocess():
+                                # host round-trip: every process needs the full
+                                # global array to contribute its shards
+                                grown_hi = fetch_global(dev_vhi)
+                                grown_lo = fetch_global(dev_vlo)
+                                pad = np.full(
+                                    (D, vcap - grown_hi.shape[1]), 0xFFFFFFFF, np.uint32
+                                )
+                                dev_vhi = put_global(
+                                    np.concatenate([grown_hi, pad], axis=1), shard1
+                                )
+                                dev_vlo = put_global(
+                                    np.concatenate([grown_lo, pad], axis=1), shard1
+                                )
+                            else:
+                                # single-process: grow on device, no host copy
+                                pad = jnp.full(
+                                    (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
+                                )
+                                dev_vhi = jax.device_put(
+                                    jnp.concatenate([dev_vhi, pad], axis=1), shard1
+                                )
+                                dev_vlo = jax.device_put(
+                                    jnp.concatenate([dev_vlo, pad], axis=1), shard1
+                                )
 
-                key = (bucket, vcap, ca, exchange, W)
-                try:
-                    # exchange-step fault injection point (the jitted step
-                    # below carries the all_to_all/all_gather exchange)
-                    injected = fault.chunk_error(
-                        escalated=isinstance(ca, (list, tuple))
-                    )
-                    if injected is not None:
-                        raise injected
-                    if key not in steps:
-                        steps[key] = _make_sharded_step(
-                            model,
-                            mesh,
-                            bucket,
-                            vcap,
-                            compact=ca,
-                            exchange=exchange,
-                            dest_w=W,
-                            with_merge=visited_backend == "device",
-                            hash_table=visited_backend == "device-hash",
+                    key = (bucket, vcap, ca, exchange, W)
+                    try:
+                        # exchange-step fault injection point (the jitted step
+                        # below carries the all_to_all/all_gather exchange)
+                        injected = fault.chunk_error(
+                            escalated=isinstance(ca, (list, tuple))
                         )
-                    (
-                        out,
-                        out_parent,
-                        out_act,
-                        new_n,
-                        vhi_n,
-                        vlo_n,
-                        vn_n,
-                        viol_any,
-                        viol_idx,
-                        dl_any,
-                        dl_idx,
-                        act_en,
-                        ovf_expand,
-                        act_guard,
-                        ovf_dest,
-                        ovf_probe,
-                        out_hi,
-                        out_lo,
-                    ) = steps[key](
-                        put_global(frontier.reshape(D * bucket, K), shard1),
-                        put_global(fvalid.reshape(D * bucket), shard1),
-                        dev_vhi,
-                        dev_vlo,
-                        dev_vn,
-                    )
-                except Exception as e:  # noqa: BLE001 — XLA compile/run
-                    # one failure policy for both engines (resilience
-                    # .retry.ChunkRetryHandler): transient -> bounded-
-                    # backoff re-run of the same attempt (the functional
-                    # step committed nothing); failed ESCALATED compile ->
-                    # uniform fallback; else re-raise.  Transient retry is
-                    # single-process only: a REAL transient error is
-                    # per-host, and one host re-issuing the collective
-                    # while its peers don't would desync the replicated
-                    # lockstep loop — multi-process jobs surface it to the
-                    # supervisor's restart-from-checkpoint layer instead.
-                    if (
-                        chunk_retry.handle(
+                        if injected is not None:
+                            raise injected
+                        if key not in steps:
+                            steps[key] = _make_sharded_step(
+                                model,
+                                mesh,
+                                bucket,
+                                vcap,
+                                compact=ca,
+                                exchange=exchange,
+                                dest_w=W,
+                                with_merge=visited_backend == "device",
+                                hash_table=visited_backend == "device-hash",
+                            )
+                        (
+                            out,
+                            out_parent,
+                            out_act,
+                            new_n,
+                            vhi_n,
+                            vlo_n,
+                            vn_n,
+                            viol_any,
+                            viol_idx,
+                            dl_any,
+                            dl_idx,
+                            act_en,
+                            ovf_expand,
+                            act_guard,
+                            ovf_dest,
+                            ovf_probe,
+                            out_hi,
+                            out_lo,
+                        ) = steps[key](
+                            put_global(frontier.reshape(D * bucket, K), shard1),
+                            put_global(fvalid.reshape(D * bucket), shard1),
+                            dev_vhi,
+                            dev_vlo,
+                            dev_vn,
+                        )
+                    except Exception as e:  # noqa: BLE001 — XLA compile/run
+                        # one failure policy for both engines (resilience
+                        # .retry.ChunkRetryHandler): transient -> bounded-
+                        # backoff re-run of the same attempt (the functional
+                        # step committed nothing); failed ESCALATED compile ->
+                        # uniform fallback; else re-raise.  Transient retry is
+                        # single-process only: a REAL transient error is
+                        # per-host, and one host re-issuing the collective
+                        # while its peers don't would desync the replicated
+                        # lockstep loop — multi-process jobs surface it to the
+                        # supervisor's restart-from-checkpoint layer instead.
+                        action = chunk_retry.handle(
                             e,
                             escalated=isinstance(ca, (list, tuple)),
                             depth=depth,
                             retry_transient=not is_multiprocess(),
                         )
-                        == "retry"
-                    ):
+                        if action == "retry":
+                            continue
+                        if action == "degrade_chunk":
+                            # device RESOURCE_EXHAUSTED: identical shapes would
+                            # die identically — halve the streaming chunk for
+                            # the rest of the run (single-process only: the
+                            # handler re-raises under multiprocess, where a
+                            # lone process shrinking would desync the fleet)
+                            chunk = max(_next_pow2(max(32, min_bucket // D)),
+                                        chunk >> 1)
+                        steps.pop(key, None)
+                        attempt = adapt.compile_fallback(bucket)
+                        adaptive_fallback = True
                         continue
-                    steps.pop(key, None)
-                    attempt = adapt.compile_fallback(bucket)
-                    adaptive_fallback = True
-                    continue
-                if ca is not None:
-                    ovf_np = fetch_global(ovf_expand)  # [D, n_actions]
-                    if ovf_np.any():
-                        # shared escalation policy (engine.bfs
-                        # .AdaptiveCompact): uniform overflow escalates to
-                        # per-action widths from THIS attempt's complete
-                        # guard counts; per-action overflow doubles the
-                        # offenders, floored for the rest of the run
-                        attempt = adapt.escalate(
-                            attempt,  # == ca: _norm_shift only zeroes
-                            ovf_np.any(axis=0),
-                            bucket,
-                            _shard_density(fetch_global(act_guard), took),
+                    if ca is not None:
+                        ovf_np = fetch_global(ovf_expand)  # [D, n_actions]
+                        if ovf_np.any():
+                            # shared escalation policy (engine.bfs
+                            # .AdaptiveCompact): uniform overflow escalates to
+                            # per-action widths from THIS attempt's complete
+                            # guard counts; per-action overflow doubles the
+                            # offenders, floored for the rest of the run
+                            attempt = adapt.escalate(
+                                attempt,  # == ca: _norm_shift only zeroes
+                                ovf_np.any(axis=0),
+                                bucket,
+                                _shard_density(fetch_global(act_guard), took),
+                            )
+                            continue
+                    if exchange == "all_to_all" and W < T and fetch_global(ovf_dest).any():
+                        w_try += 1
+                        continue
+                    if visited_backend == "device-hash" and bool(
+                        fetch_global(ovf_probe).any()
+                    ):
+                        # a shard exhausted its probe budget: grow every
+                        # shard's table and re-run the chunk (the attempt's
+                        # returned tables are discarded — the step is
+                        # functional, so nothing was committed)
+                        dev_vhi, dev_vlo, vcap = _grow_hash_tables(
+                            dev_vhi, dev_vlo, 2 * vcap, shard1
                         )
                         continue
-                if exchange == "all_to_all" and W < T and fetch_global(ovf_dest).any():
-                    w_try += 1
-                    continue
-                if visited_backend == "device-hash" and bool(
-                    fetch_global(ovf_probe).any()
-                ):
-                    # a shard exhausted its probe budget: grow every
-                    # shard's table and re-run the chunk (the attempt's
-                    # returned tables are discarded — the step is
-                    # functional, so nothing was committed)
-                    dev_vhi, dev_vlo, vcap = _grow_hash_tables(
-                        dev_vhi, dev_vlo, 2 * vcap, shard1
-                    )
-                    continue
-                dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
-                break
-            # adapt buffer sizing from the committed attempt's guard counts
-            # (mirrors engine.check; no-op until escalation activates)
-            adapt.observe(_shard_density(fetch_global(act_guard), took))
-            obs_.chunk_span(
-                "exchange",
-                time.perf_counter() - t_chunk,
-                depth=depth,
-                bucket=bucket,
-                exchange=exchange,
-            )
-            # frontier-level verdicts (states being expanded = level `depth`)
-            viol_any_np = fetch_global(viol_any)  # [D, n_inv]
-            if viol_any_np.any():
-                inv_i = int(np.argmax(viol_any_np.any(axis=0)))
-                d = int(np.argmax(viol_any_np[:, inv_i]))
-                idx = int(fetch_global(viol_idx)[d, inv_i])
-                gidx = int(prev_base[d] + chunk_off[d] + idx)
-                verdict = (model.invariants[inv_i].name, frontier[d, idx], gidx)
-                break
-            if check_deadlock and fetch_global(dl_any).any():
-                d = int(np.argmax(fetch_global(dl_any)))
-                idx = int(fetch_global(dl_idx)[d])
-                gidx = int(prev_base[d] + chunk_off[d] + idx)
-                verdict = ("Deadlock", frontier[d, idx], gidx)
-                break
-            counts = fetch_global(new_n)
-            # received candidates per OWNER shard (post-exchange, pre-host-
-            # dedup on the host backend; == novel on device backends)
-            lvl_recv_per_shard += counts.astype(np.int64)
-            M_per = out.shape[0] // D
-            # device-side slice to the widest shard before the host copy —
-            # the padded buffer is mostly empty
-            cmax = int(counts.max())
-            out3 = fetch_global(out.reshape(D, M_per, K)[:, :cmax])
-            if collect_trace:
-                parent_np = fetch_global(out_parent.reshape(D, M_per)[:, :cmax])
-                act_np = fetch_global(out_act.reshape(D, M_per)[:, :cmax])
-            if host_sets is not None and cmax:
-                hi3 = fetch_global(out_hi.reshape(D, M_per)[:, :cmax])
-                lo3 = fetch_global(out_lo.reshape(D, M_per)[:, :cmax])
-                # global dedup: each shard's OWNER process inserts into its
-                # FpSet (batch dedup already happened on device; insert()
-                # returns the first-time mask); the masks are OR-merged so
-                # every process sees the identical novelty decision
-                masks = np.zeros((D, cmax), bool)
+                    dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
+                    break
+                # adapt buffer sizing from the committed attempt's guard counts
+                # (mirrors engine.check; no-op until escalation activates)
+                adapt.observe(_shard_density(fetch_global(act_guard), took))
+                obs_.chunk_span(
+                    "exchange",
+                    time.perf_counter() - t_chunk,
+                    depth=depth,
+                    bucket=bucket,
+                    exchange=exchange,
+                )
+                # frontier-level verdicts (states being expanded = level `depth`)
+                viol_any_np = fetch_global(viol_any)  # [D, n_inv]
+                if viol_any_np.any():
+                    inv_i = int(np.argmax(viol_any_np.any(axis=0)))
+                    d = int(np.argmax(viol_any_np[:, inv_i]))
+                    idx = int(fetch_global(viol_idx)[d, inv_i])
+                    gidx = int(prev_base[d] + chunk_off[d] + idx)
+                    verdict = (model.invariants[inv_i].name, frontier[d, idx], gidx)
+                    break
+                if check_deadlock and fetch_global(dl_any).any():
+                    d = int(np.argmax(fetch_global(dl_any)))
+                    idx = int(fetch_global(dl_idx)[d])
+                    gidx = int(prev_base[d] + chunk_off[d] + idx)
+                    verdict = ("Deadlock", frontier[d, idx], gidx)
+                    break
+                counts = fetch_global(new_n)
+                # received candidates per OWNER shard (post-exchange, pre-host-
+                # dedup on the host backend; == novel on device backends)
+                lvl_recv_per_shard += counts.astype(np.int64)
+                M_per = out.shape[0] // D
+                # device-side slice to the widest shard before the host copy —
+                # the padded buffer is mostly empty
+                cmax = int(counts.max())
+                out3 = fetch_global(out.reshape(D, M_per, K)[:, :cmax])
+                if collect_trace:
+                    parent_np = fetch_global(out_parent.reshape(D, M_per)[:, :cmax])
+                    act_np = fetch_global(out_act.reshape(D, M_per)[:, :cmax])
+                if host_sets is not None and cmax:
+                    hi3 = fetch_global(out_hi.reshape(D, M_per)[:, :cmax])
+                    lo3 = fetch_global(out_lo.reshape(D, M_per)[:, :cmax])
+                    # global dedup: each shard's OWNER process inserts into its
+                    # FpSet (batch dedup already happened on device; insert()
+                    # returns the first-time mask); the masks are OR-merged so
+                    # every process sees the identical novelty decision
+                    masks = np.zeros((D, cmax), bool)
+                    for d in range(D):
+                        c = int(counts[d])
+                        if c and host_sets[d] is not None:
+                            masks[d, :c] = host_sets[d].insert(
+                                _u64(hi3[d, :c], lo3[d, :c])
+                            ).astype(bool)
+                    masks = or_across_processes(masks)
+                newc = np.zeros(D, np.int64)
                 for d in range(D):
                     c = int(counts[d])
-                    if c and host_sets[d] is not None:
-                        masks[d, :c] = host_sets[d].insert(
-                            _u64(hi3[d, :c], lo3[d, :c])
-                        ).astype(bool)
-                masks = or_across_processes(masks)
-            newc = np.zeros(D, np.int64)
-            for d in range(D):
-                c = int(counts[d])
-                if not c:
-                    continue
-                rows = out3[d, :c]
-                p = parent_np[d, :c].astype(np.int64) if collect_trace else None
-                a = act_np[d, :c].astype(np.int64) if collect_trace else None
-                if host_sets is not None:
-                    mask = masks[d, :c]
-                    rows = rows[mask]
-                    if collect_trace:
-                        p, a = p[mask], a[mask]
-                    c = rows.shape[0]
                     if not c:
                         continue
-                next_pending[d].append(rows)
-                if collect_trace:
-                    # step parents are d_src*bucket + i within this padded
-                    # chunk -> level-global index in shard-major order
-                    src_d = p // bucket
-                    src_i = p % bucket
-                    next_parent[d].append(
-                        prev_base[src_d] + chunk_off[src_d] + src_i
-                    )
-                    next_act[d].append(a)
-                newc[d] = c
-            lvl_new_per_shard += newc
-            shard_visited += newc
-            if obs_.collect:
-                act_en_np = fetch_global(act_en).astype(np.int64)
-                lvl_act_en += act_en_np.sum(axis=0)
-                lvl_en_per_shard += act_en_np.sum(axis=1)
+                    rows = out3[d, :c]
+                    p = parent_np[d, :c].astype(np.int64) if collect_trace else None
+                    a = act_np[d, :c].astype(np.int64) if collect_trace else None
+                    if host_sets is not None:
+                        mask = masks[d, :c]
+                        rows = rows[mask]
+                        if collect_trace:
+                            p, a = p[mask], a[mask]
+                        c = rows.shape[0]
+                        if not c:
+                            continue
+                    next_pending[d].append(rows)
+                    if collect_trace:
+                        # step parents are d_src*bucket + i within this padded
+                        # chunk -> level-global index in shard-major order
+                        src_d = p // bucket
+                        src_i = p % bucket
+                        next_parent[d].append(
+                            prev_base[src_d] + chunk_off[src_d] + src_i
+                        )
+                        next_act[d].append(a)
+                    newc[d] = c
+                lvl_new_per_shard += newc
+                shard_visited += newc
+                if obs_.collect:
+                    act_en_np = fetch_global(act_en).astype(np.int64)
+                    lvl_act_en += act_en_np.sum(axis=0)
+                    lvl_en_per_shard += act_en_np.sum(axis=1)
 
-        if verdict is not None:
-            inv_name, row, gidx = verdict
-            violation = build_violation(inv_name, depth, gidx) or Violation(
-                invariant=inv_name,
-                depth=depth,
-                state=decode_row(row),
-                trace=[],
-            )
-            break
-
-        n_new = int(lvl_new_per_shard.sum())
-        depth += 1
-        if n_new:
-            levels.append(n_new)
-            total += n_new
-        if obs_.collect and is_coordinator():
-            enabled_total = int(lvl_act_en.sum())
-            # heartbeat-enveloped (kind/ts/unix): the per-level stats
-            # stream doubles as the supervisor's liveness signal.  Beyond
-            # the coordinator-aggregated totals, the record carries the
-            # per-shard breakdowns (frontier rows expanded per shard,
-            # enabled per source shard, new per owner shard, and — host
-            # backend, where the coordinator computes the novelty masks —
-            # duplicates per owner shard) so exchange imbalance is
-            # visible without re-running the level
-            shard_extra = {}
-            if host_sets is not None:
-                shard_extra["shard_duplicates"] = (
-                    lvl_recv_per_shard - lvl_new_per_shard
-                ).tolist()
-            rec = obs_.level(
-                depth=depth,
-                frontier=int(prev_base[-1]),
-                enabled_candidates=enabled_total,
-                new=n_new,
-                duplicates=enabled_total - n_new,
-                total=total,
-                level_ms=round((time.perf_counter() - t_level) * 1e3, 1),
-                shard_new=lvl_new_per_shard.tolist(),
-                shard_frontier=np.diff(prev_base).astype(np.int64).tolist(),
-                shard_enabled=lvl_en_per_shard.tolist(),
-                **shard_extra,
-                action_enablement={
-                    a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
-                },
-            )
-            result_levels.append(rec)
-        if progress:
-            progress(depth, n_new, total)
-        _shard_beat(depth, new=n_new, total=total)
-        pending = [
-            np.concatenate(next_pending[d])
-            if next_pending[d]
-            else np.empty((0, K), np.uint32)
-            for d in range(D)
-        ]
-        if plog is not None:
-            # publish the level's per-shard parent-log segments BEFORE the
-            # checkpoint save: a checkpoint at depth R then implies the
-            # log resolves every level <= R (segments past a crash are
-            # rewritten byte-identically by the deterministic re-run)
-            plog.write_level(
-                depth,
-                pending,
-                [
-                    np.concatenate(next_parent[d])
-                    if next_parent[d]
-                    else np.empty(0, np.int64)
-                    for d in range(D)
-                ],
-                [
-                    np.concatenate(next_act[d])
-                    if next_act[d]
-                    else np.empty(0, np.int64)
-                    for d in range(D)
-                ],
-            )
-        if ckpt_store is not None and depth % checkpoint_every == 0:
-            _save_checkpoint()
-            last_ckpt_depth = depth
-        if store_trace:
-            trace_store.append(
-                (
-                    np.concatenate(pending)
-                    if n_new
-                    else np.empty((0, K), np.uint32),
-                    np.concatenate(
-                        [x for lst in next_parent for x in lst]
-                        or [np.empty(0, np.int64)]
-                    ),
-                    np.concatenate(
-                        [x for lst in next_act for x in lst]
-                        or [np.empty(0, np.int64)]
-                    ),
+            if verdict is not None:
+                inv_name, row, gidx = verdict
+                violation = build_violation(inv_name, depth, gidx) or Violation(
+                    invariant=inv_name,
+                    depth=depth,
+                    state=decode_row(row),
+                    trace=[],
                 )
+                break
+
+            n_new = int(lvl_new_per_shard.sum())
+            depth += 1
+            if n_new:
+                levels.append(n_new)
+                total += n_new
+            if obs_.collect and is_coordinator():
+                enabled_total = int(lvl_act_en.sum())
+                # heartbeat-enveloped (kind/ts/unix): the per-level stats
+                # stream doubles as the supervisor's liveness signal.  Beyond
+                # the coordinator-aggregated totals, the record carries the
+                # per-shard breakdowns (frontier rows expanded per shard,
+                # enabled per source shard, new per owner shard, and — host
+                # backend, where the coordinator computes the novelty masks —
+                # duplicates per owner shard) so exchange imbalance is
+                # visible without re-running the level
+                shard_extra = {}
+                if host_sets is not None:
+                    shard_extra["shard_duplicates"] = (
+                        lvl_recv_per_shard - lvl_new_per_shard
+                    ).tolist()
+                rec = obs_.level(
+                    depth=depth,
+                    frontier=int(prev_base[-1]),
+                    enabled_candidates=enabled_total,
+                    new=n_new,
+                    duplicates=enabled_total - n_new,
+                    total=total,
+                    level_ms=round((time.perf_counter() - t_level) * 1e3, 1),
+                    shard_new=lvl_new_per_shard.tolist(),
+                    shard_frontier=np.diff(prev_base).astype(np.int64).tolist(),
+                    shard_enabled=lvl_en_per_shard.tolist(),
+                    **shard_extra,
+                    action_enablement={
+                        a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
+                    },
+                )
+                result_levels.append(rec)
+            if progress:
+                progress(depth, n_new, total)
+            _shard_beat(depth, new=n_new, total=total)
+            pending = [
+                np.concatenate(next_pending[d])
+                if next_pending[d]
+                else np.empty((0, K), np.uint32)
+                for d in range(D)
+            ]
+            if plog is not None:
+                # publish the level's per-shard parent-log segments BEFORE the
+                # checkpoint save: a checkpoint at depth R then implies the
+                # log resolves every level <= R (segments past a crash are
+                # rewritten byte-identically by the deterministic re-run)
+                plog.write_level(
+                    depth,
+                    pending,
+                    [
+                        np.concatenate(next_parent[d])
+                        if next_parent[d]
+                        else np.empty(0, np.int64)
+                        for d in range(D)
+                    ],
+                    [
+                        np.concatenate(next_act[d])
+                        if next_act[d]
+                        else np.empty(0, np.int64)
+                        for d in range(D)
+                    ],
+                )
+            if ckpt_store is not None and depth % checkpoint_every == 0:
+                _save_checkpoint()
+                last_ckpt_depth = depth
+            if store_trace:
+                trace_store.append(
+                    (
+                        np.concatenate(pending)
+                        if n_new
+                        else np.empty((0, K), np.uint32),
+                        np.concatenate(
+                            [x for lst in next_parent for x in lst]
+                            or [np.empty(0, np.int64)]
+                        ),
+                        np.concatenate(
+                            [x for lst in next_act for x in lst]
+                            or [np.empty(0, np.int64)]
+                        ),
+                    )
+                )
+            # level-boundary resource governance: pressure gauges, injected
+            # stall, soft-breach reclamation, hard-breach typed clean exit.
+            # Multi-process: NO reclaim/save hooks — both reach
+            # _save_checkpoint, whose device-backend dumps are collectives,
+            # and a breach can be process-LOCAL (RSS, a host's own disk),
+            # so a lone breacher issuing a collective would wedge forever
+            # instead of exiting typed; it exits rc-75 from the last
+            # lockstep checkpoint instead, which the fleet supervisor
+            # classifies as the resource verdict
+            multi = is_multiprocess()
+            governor.level_end(
+                depth,
+                reclaim=None if multi else _reclaim,
+                save_hook=None if multi else _final_save,
             )
+    except ResourceExhausted as e:
+        exhausted = e
+    except OSError as e:
+        if not is_disk_full(e):
+            raise
+        # a real ENOSPC from a storage/checkpoint writer outside the
+        # injected paths: same typed clean exit (every writer cleans
+        # up its tmp on failure, so the promoted state is intact)
+        exhausted = ResourceExhausted("enospc", str(e), depth=depth)
+    if exhausted is not None:
+        # typed terminal: stamp the run manifest, mark the shard
+        # heartbeat (fleet supervisors and `cli report` attribute the
+        # exit to this process), and propagate for the exit-75 mapping.
+        # All best-effort: these writes hit the same full filesystem, and
+        # a second ENOSPC must not demote the typed exit into a crash
+        try:
+            _shard_beat(
+                depth,
+                event="resource-exhausted",
+                reason=exhausted.reason,
+                detail=exhausted.detail[:200],
+            )
+            obs_.abort(
+                "resource-exhausted",
+                reason=exhausted.reason,
+                depth=exhausted.depth,
+                detail=exhausted.detail,
+                distinct_states=total,
+                **governor.stats(),
+            )
+            obs_.close()
+        except OSError:
+            pass
+        raise exhausted
 
     if violation is None and cut and model.invariants:
         # cutoff left the last frontier unexpanded — run its invariant pass

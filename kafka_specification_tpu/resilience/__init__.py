@@ -10,9 +10,14 @@ share:
 - `checkpoints` — checksummed, keep-last-K rotating checkpoint store with
                   atomic promote and automatic fallback to the newest
                   verifying generation on load corruption;
-- `retry`       — error classification (transient backend error vs the
-                  reproducible wide-product compile OOM vs fatal) and a
-                  bounded exponential-backoff policy;
+- `retry`       — error classification (transient backend error vs device
+                  RESOURCE_EXHAUSTED vs the reproducible wide-product
+                  compile OOM vs fatal) and a bounded exponential-backoff
+                  policy;
+- `resources`   — resource-exhaustion governance (disk/RSS budgets,
+                  per-level deadline watchdog, soft-breach reclamation,
+                  the typed RESOURCE_EXHAUSTED clean exit, and the
+                  supervisor's --reclaim sweep);
 - `heartbeat`   — the shared JSONL heartbeat envelope ({kind, ts, unix})
                   written by the engines' per-level stats streams and
                   consumed by the supervisor's stall detector;
@@ -28,17 +33,29 @@ tunnel.
 from .checkpoints import CheckpointCorrupt, CheckpointStore
 from .faults import FaultPlan, InjectedCrash, InjectedFault, corrupt_file
 from .heartbeat import append_jsonl, heartbeat_record
+from .resources import (
+    EXIT_RESOURCE_EXHAUSTED,
+    ResourceExhausted,
+    ResourceGovernor,
+    is_disk_full,
+    reclaim_disk,
+)
 from .retry import RetryPolicy, classify
 
 __all__ = [
     "CheckpointCorrupt",
     "CheckpointStore",
+    "EXIT_RESOURCE_EXHAUSTED",
     "FaultPlan",
     "InjectedCrash",
     "InjectedFault",
+    "ResourceExhausted",
+    "ResourceGovernor",
     "RetryPolicy",
     "append_jsonl",
     "classify",
     "corrupt_file",
     "heartbeat_record",
+    "is_disk_full",
+    "reclaim_disk",
 ]

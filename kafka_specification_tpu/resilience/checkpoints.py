@@ -130,6 +130,17 @@ class CheckpointStore:
         self.keep = max(1, int(keep))
         self.fault_plan = fault_plan
         os.makedirs(directory, exist_ok=True)
+        # startup janitor: a save killed mid-tmp-write leaves
+        # `<name>.tmp.npz` behind (no manifest ever references it) —
+        # sweep our own stem's strays so they never masquerade as disk
+        # usage or confuse directory listings
+        stem = basename[: -len(".npz")]
+        for name in os.listdir(directory):
+            if name.startswith(stem) and ".tmp." in name:
+                try:
+                    os.unlink(os.path.join(directory, name))
+                except OSError:
+                    pass
 
     # --- paths ---------------------------------------------------------
     def path(self, gen: int = 0, part: Optional[str] = None) -> str:
@@ -158,30 +169,67 @@ class CheckpointStore:
         arrays["depth"] = depth
         path = self.path(0, part)
         tmp = path + ".tmp.npz"
-        with _obs.span("checkpoint-write", depth=depth, part=part or ""):
-            # uncompressed (live fingerprints are high-entropy; zlib only
-            # burns time — same rationale as the seed writer)
-            np.savez(
-                tmp, **{MANIFEST_KEY: json.dumps(build_manifest(arrays))},
-                **arrays,
-            )
-            if self.fault_plan is not None:
-                # torn-write rehearsal point: tmp written, nothing promoted
-                self.fault_plan.crash("ckpt", depth)
-            # shift existing generations up (newest-first so each replace's
-            # target is the already-vacated slot); generation keep-1 falls
-            # off
-            for g in range(self.keep - 1, 0, -1):
-                src = self.path(g - 1, part)
-                if os.path.exists(src):
-                    os.replace(src, self.path(g, part))
-            os.replace(tmp, path)
+        try:
+            with _obs.span("checkpoint-write", depth=depth, part=part or ""):
+                # uncompressed (live fingerprints are high-entropy; zlib
+                # only burns time — same rationale as the seed writer)
+                np.savez(
+                    tmp, **{MANIFEST_KEY: json.dumps(build_manifest(arrays))},
+                    **arrays,
+                )
+                if self.fault_plan is not None:
+                    # torn-write rehearsal points: tmp written, nothing
+                    # promoted (crash@ckpt:N and the full-disk twin
+                    # enospc@ckpt:N — resilience.resources)
+                    self.fault_plan.crash("ckpt", depth)
+                    self.fault_plan.enospc("ckpt", depth)
+                # shift existing generations up (newest-first so each
+                # replace's target is the already-vacated slot);
+                # generation keep-1 falls off
+                for g in range(self.keep - 1, 0, -1):
+                    src = self.path(g - 1, part)
+                    if os.path.exists(src):
+                        os.replace(src, self.path(g, part))
+                os.replace(tmp, path)
+        except BaseException:
+            # a failed save (ENOSPC, injected fault, kill) must not leave
+            # its tmp behind: the promoted generations are the durable
+            # state and they are untouched
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         _met.inc("kspec_checkpoint_writes_total")
         if self.fault_plan is not None and self.fault_plan.should_corrupt(depth):
             from .faults import corrupt_file
 
             corrupt_file(path)
         return path
+
+    def prune(self, keep_gens: int = 1) -> list:
+        """Resource reclamation: unlink every rotated generation (mains
+        AND parts) at index >= `keep_gens`, keeping the newest.  Used by
+        the engines' soft-breach reclaim right after a fresh save — the
+        surviving generation's manifest is the one the deletion barrier
+        may then be flushed against — and by the supervisor's --reclaim
+        policy between attempts.  Returns the removed paths."""
+        removed = []
+        stem = self.basename[: -len(".npz")]
+        pat = re.compile(
+            re.escape(stem) + r"\.(\d+)\.npz(\..+)?$"
+        )
+        for name in os.listdir(self.directory):
+            m = pat.match(name)
+            if m is None or int(m.group(1)) < keep_gens:
+                continue
+            p = os.path.join(self.directory, name)
+            try:
+                os.unlink(p)
+                removed.append(p)
+            except OSError:
+                pass
+        return removed
 
     # --- load ----------------------------------------------------------
     def _verify(self, path: str) -> dict:

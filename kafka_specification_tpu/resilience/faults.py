@@ -30,6 +30,27 @@ Grammar (comma-separated specs in `KSPEC_FAULT` or `--fault`):
     transient_device_err:N    the next N chunk/exchange step executions
                               raise a transient-classified backend error
 
+Resource faults (the out-of-things failure family — resilience.resources;
+every one must end in a typed RESOURCE_EXHAUSTED clean exit whose on-disk
+state still passes `cli verify-checkpoint`):
+
+    enospc@spill:N            the Nth spill-run write of this process
+                              raises OSError(ENOSPC) after the tmp write,
+                              before the atomic promote (the full-disk
+                              rehearsal for storage/tiered.py; like
+                              crash@merge, N is a per-process ordinal)
+    enospc@merge:N            same, mid-way through the Nth disk-run merge
+    enospc@ckpt:N             OSError(ENOSPC) mid-checkpoint-write at
+                              level N (after the tmp write, before the
+                              atomic promote — previous generations stay
+                              intact and verifiable)
+    enospc@plog:N             OSError(ENOSPC) publishing the level-N
+                              parent-log segment
+    stall@level:N             the per-level deadline watchdog reports
+                              level N as stalled (the silent-stall
+                              rehearsal; fires at the level-N boundary
+                              once the run is durably past it)
+
 Shard scoping (the distributed engine's fault surface): any `@` fault may
 carry a `shard<d>:` scope immediately after the `@`, and the bare faults
 accept `@shard<d>` — the fault then fires only on the process that hosts
@@ -68,6 +89,7 @@ in-process and do not persist across restarts.
 
 from __future__ import annotations
 
+import errno
 import os
 from dataclasses import dataclass
 from typing import Optional
@@ -148,6 +170,10 @@ def _parse_token(tok: str) -> _Spec:
             return _Spec("crash", point, level, 1, shard)
         if name == "corrupt_ckpt" and point == "ckpt":
             return _Spec("corrupt_ckpt", "ckpt", level, 1, shard)
+        if name == "enospc" and point in ("spill", "ckpt", "merge", "plog"):
+            return _Spec("enospc", point, level, 1, shard)
+        if name == "stall" and point == "level":
+            return _Spec("stall", "level", level, 1, shard)
         raise ValueError(f"unknown fault {tok!r}")
     name, _, count = tok.partition(":")
     if name == "corrupt_ckpt":
@@ -163,7 +189,8 @@ def _parse_token(tok: str) -> _Spec:
     raise ValueError(
         f"unknown fault {tok!r} (grammar: crash@level:N, crash@ckpt:N, "
         f"crash@merge:N, corrupt_ckpt[@ckpt:N], compile_oom, "
-        f"transient_device_err:N, each '@'-scopeable as "
+        f"transient_device_err:N, enospc@spill|ckpt|merge|plog:N, "
+        f"stall@level:N, each '@'-scopeable as "
         f"crash@shard<d>:level:N / corrupt_ckpt@shard<d> / "
         f"transient_device_err@shard<d>:N)"
     )
@@ -261,6 +288,45 @@ class FaultPlan:
                 + (f" on shard {s.shard}" if s.shard is not None else "")
                 + " (KSPEC_FAULT)"
             )
+
+    def enospc(self, point: str, n: int) -> None:
+        """Raise an injected OSError(ENOSPC) if an `enospc@<point>:N`
+        fault matches.  `n` is the BFS level for ckpt/plog (resume-depth
+        relief applies, like crash@level) and a per-process ordinal for
+        spill/merge (in-process test use, like crash@merge).  Raised at
+        each writer's pre-promote point, so the on-disk state it leaves
+        is exactly what a real full disk leaves: old files intact, tmp
+        cleaned up, every promoted generation verifiable."""
+        for s in self.specs:
+            if s.kind != "enospc" or s.point != point or s.budget <= 0:
+                continue
+            if not self._is_local(s):
+                continue
+            if point in ("ckpt", "plog") and self.start_depth >= s.arg:
+                continue  # resumed at/past the target: counts as fired
+            if n != s.arg:
+                continue
+            s.budget -= 1
+            raise OSError(
+                errno.ENOSPC,
+                f"No space left on device (injected by KSPEC_FAULT "
+                f"enospc@{point}:{n})",
+            )
+
+    def stalled(self, depth: int) -> bool:
+        """True once per `stall@level:N` fault when level N is done: the
+        resource governor's deadline watchdog then reports the level as
+        stalled (resilience.resources).  Resume-depth relief applies, so
+        a post-reclaim resume converges instead of stall-looping."""
+        for s in self.specs:
+            if s.kind != "stall" or s.budget <= 0 or not self._is_local(s):
+                continue
+            if self.start_depth >= s.arg:
+                continue
+            if depth >= s.arg:
+                s.budget -= 1
+                return True
+        return False
 
     def chunk_error(self, escalated: bool) -> Optional[Exception]:
         """Error to inject into the next chunk/exchange step, or None.

@@ -10,7 +10,15 @@ The engines' known failure ladder (TODO.md, RUNPROD464_r5.log):
   escalated per-action programs.  Retrying identically cannot help; the
   engines instead pin adaptation off (`AdaptiveCompact.compile_fallback`)
   and record the degradation in `result.stats`.
-- **other**: a real bug or resource exhaustion — propagate.
+- **device_resource**: the backend ran out of device memory executing a
+  chunk (`RESOURCE_EXHAUSTED` in the XLA status).  Re-running the
+  identical chunk would allocate the identical buffers and die
+  identically, so the engines degrade the WORK SHAPE instead: the
+  current chunk re-runs on the uniform compact path (smaller device
+  buffers) and the streaming chunk size halves for the rest of the run —
+  both recorded in `result.stats["degradations"]`
+  (`kind: "chunk_degrade"`).
+- **other**: a real bug — propagate.
 
 Classification is substring-based over the exception text (JAX surfaces
 backend errors as `XlaRuntimeError` with the gRPC status name embedded),
@@ -38,15 +46,21 @@ OOM_PATTERNS = (
     "LLVM ERROR",
     "out of memory",
     "bad_alloc",
-    "RESOURCE_EXHAUSTED",
 )
+# device allocation failure at chunk-execute time: its own class (it used
+# to be lumped into the compile-OOM family, but pinning *adaptation* off
+# does nothing for a table/buffer that simply doesn't fit — the right
+# degradation is a smaller chunk)
+DEVICE_RESOURCE_PATTERNS = ("RESOURCE_EXHAUSTED",)
 
 
 def classify(exc: BaseException) -> str:
-    """-> 'transient' | 'compile_oom' | 'other'."""
+    """-> 'transient' | 'device_resource' | 'compile_oom' | 'other'."""
     text = f"{type(exc).__name__}: {exc}"
     if any(p in text for p in TRANSIENT_PATTERNS):
         return "transient"
+    if any(p in text for p in DEVICE_RESOURCE_PATTERNS):
+        return "device_resource"
     if any(p in text for p in OOM_PATTERNS):
         return "compile_oom"
     return "other"
@@ -87,6 +101,15 @@ class ChunkRetryHandler:
     - 'degrade' — non-transient failure of an ESCALATED (per-action tuple)
                   program: records the degradation and tells the caller to
                   fall back to the uniform compact path;
+    - 'degrade_chunk' — a device RESOURCE_EXHAUSTED on a NON-escalated
+                  attempt: the identical chunk would allocate the
+                  identical buffers and die again, so the caller re-runs
+                  it on the uniform compact path AND halves its streaming
+                  chunk size for the rest of the run (bounded by
+                  `max_chunk_degrades`; recorded in
+                  result.stats["degradations"]).  An escalated attempt's
+                  RESOURCE_EXHAUSTED instead takes the 'degrade' path
+                  below (lockstep-safe, same as before the class split);
     - re-raise  — anything else, including a transient error that exhausted
                   its retry budget (the supervisor's restart-from-checkpoint
                   layer owns that case; degrading on it would mislabel an
@@ -101,6 +124,8 @@ class ChunkRetryHandler:
     tag: str  # "[engine]" / "[sharded]" stderr prefix
     transient_try: int = 0
     retries_total: int = 0
+    chunk_degrades: int = 0
+    max_chunk_degrades: int = 6  # 64x shrink, then surface the outage
     degradations: list = field(default_factory=list)
 
     @classmethod
@@ -149,6 +174,43 @@ class ChunkRetryHandler:
             )
             time.sleep(pause)
             return "retry"
+        if kind == "device_resource" and not escalated:
+            # (an ESCALATED attempt's RESOURCE_EXHAUSTED falls through to
+            # the uniform-path degrade below — the family it shared with
+            # compile_oom before this class existed; that response is
+            # deterministic and replicated, hence lockstep-safe, whereas
+            # the chunk shrink here is only sound where a lone
+            # retry-in-place is: a multi-process peer shrinking its chunk
+            # alone would desync the lockstep loop, so fleets surface it
+            # to the supervisor instead)
+            if not retry_transient:
+                raise e
+            if self.chunk_degrades >= self.max_chunk_degrades:
+                raise e  # shrinking isn't helping: a real capacity wall
+            self.chunk_degrades += 1
+            print(
+                f"{self.tag} device RESOURCE_EXHAUSTED executing a chunk "
+                f"({type(e).__name__}); degrading work shape "
+                f"({self.chunk_degrades}/{self.max_chunk_degrades}: uniform "
+                f"compact now, half chunk size from the next level)",
+                file=sys.stderr,
+            )
+            self.degradations.append(
+                {
+                    "kind": "chunk_degrade",
+                    "depth": depth,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+            from ..obs import tracer as _obs
+
+            _obs.event(
+                "chunk-degrade",
+                depth=depth,
+                attempt=self.chunk_degrades,
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
+            return "degrade_chunk"
         if not escalated:
             raise e
         print(

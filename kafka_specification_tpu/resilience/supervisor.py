@@ -12,8 +12,14 @@ a watchdog that actually observes progress instead of only exit codes:
   rounds hangs without exiting, which a bash `for` loop never notices,
 - restarts from the engine checkpoint with a bounded restart budget and
   jittered exponential backoff (thundering-herd hygiene even for one box),
+- classifies a RESOURCE_EXHAUSTED child exit (code 75: full disk /
+  breached budget, checkpointed clean — resilience.resources) separately
+  from crashes: restarting into the same full disk would hot-loop, so it
+  halts with an actionable verdict, or under `reclaim=True` prunes the
+  reclaim dirs and retries exactly once,
 - appends one heartbeat-enveloped JSONL event per transition (start /
-  stall-kill / exit / complete / give-up) to the event log.
+  stall-kill / exit / resource-exhausted / reclaim / resource-verdict /
+  complete / give-up) to the event log.
 
 The child is responsible for its own resume: engines resume automatically
 from `checkpoint_dir` (hardened, checksummed, keep-last-K — see
@@ -35,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .heartbeat import append_jsonl, heartbeat_record
+from .resources import EXIT_RESOURCE_EXHAUSTED, reclaim_disk
 
 
 @dataclass
@@ -52,6 +59,14 @@ class SupervisorConfig:
     term_grace: float = 10.0  # SIGTERM -> SIGKILL grace
     env: Optional[dict] = None
     run_id: Optional[str] = None  # obs correlation key (stamped per event)
+    # resource-exit policy (resilience.resources): a child exiting
+    # EXIT_RESOURCE_EXHAUSTED ran out of disk/RSS/time and checkpointed —
+    # restarting it into the same full disk would hot-loop, so the
+    # supervisor either halts with an actionable verdict (default) or,
+    # with reclaim=True, prunes reclaim_dirs (stale tmps + rotated
+    # checkpoint generations) and retries EXACTLY once
+    reclaim: bool = False
+    reclaim_dirs: tuple = ()
     rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def backoff(self, restart: int) -> float:
@@ -145,10 +160,56 @@ def _run_attempt(cfg: SupervisorConfig, attempt: int) -> int:
             log_fh.close()
 
 
+def _resource_verdict(cfg, attempt: int, rc: int, reclaimed: bool) -> int:
+    """Halt on a RESOURCE_EXHAUSTED child exit: restarting into the same
+    full disk would hot-loop (each attempt re-fills what little space the
+    backoff freed and dies at the same level).  The verdict event + stderr
+    line tell the operator exactly what to do; the supervisor's own exit
+    code stays EXIT_RESOURCE_EXHAUSTED so callers can classify too."""
+    cfg.event(
+        event="resource-verdict",
+        attempt=attempt,
+        rc=rc,
+        reclaim_tried=reclaimed,
+    )
+    print(
+        f"[supervisor] child exited RESOURCE_EXHAUSTED (rc={rc})"
+        + (" after one reclaim-retry" if reclaimed else "")
+        + "; NOT restarting into an unreclaimed full disk.  Free space "
+        "(or raise --disk-budget), check `cli verify-checkpoint`, then "
+        "re-run to resume"
+        + ("" if reclaimed or cfg.reclaim else
+           "; or re-run the supervisor with --reclaim for one automatic "
+           "prune-and-retry")
+        + f".  Events: {cfg.events}",
+        file=sys.stderr,
+    )
+    return EXIT_RESOURCE_EXHAUSTED
+
+
+def _try_reclaim(cfg, attempt: int) -> None:
+    removed = reclaim_disk(cfg.reclaim_dirs)
+    cfg.event(
+        event="reclaim",
+        attempt=attempt,
+        files_removed=len(removed),
+        dirs=list(cfg.reclaim_dirs),
+    )
+
+
 def supervise(cfg: SupervisorConfig) -> int:
     """Run cfg.cmd to success or budget exhaustion; returns the final rc."""
     rc = None
-    for attempt in range(1, cfg.max_restarts + 2):
+    reclaimed = False
+    attempt = 0
+    restarts_used = 0
+    # while-loop with explicit restart accounting (not a for-range): the
+    # one --reclaim retry must happen even when the resource exit lands
+    # on the final budgeted attempt — it is a different recovery lever
+    # than a crash restart and must never be silently dropped (nor ever
+    # consume the crash-restart budget)
+    while True:
+        attempt += 1
         cfg.event(event="start", attempt=attempt, cmd=cfg.cmd)
         t0 = time.time()
         rc = _run_attempt(cfg, attempt)
@@ -161,16 +222,27 @@ def supervise(cfg: SupervisorConfig) -> int:
         if rc == 0:
             cfg.event(event="complete", attempt=attempt)
             return 0
-        if attempt > cfg.max_restarts:
+        if rc == EXIT_RESOURCE_EXHAUSTED:
+            # resource exits are NOT crashes: never burn the restart
+            # budget hot-looping into the same full disk — at most one
+            # reclaim-retry (--reclaim), else halt with the verdict
+            cfg.event(event="resource-exhausted", attempt=attempt, rc=rc)
+            if cfg.reclaim and not reclaimed:
+                reclaimed = True
+                _try_reclaim(cfg, attempt)
+                continue
+            return _resource_verdict(cfg, attempt, rc, reclaimed)
+        if restarts_used >= cfg.max_restarts:
             break
-        delay = cfg.backoff(attempt)
+        restarts_used += 1
+        delay = cfg.backoff(restarts_used)
         cfg.event(
             event="restart", attempt=attempt, backoff_s=round(delay, 2)
         )
         time.sleep(delay)
-    cfg.event(event="give-up", attempts=cfg.max_restarts + 1, rc=rc)
+    cfg.event(event="give-up", attempts=attempt, rc=rc)
     print(
-        f"[supervisor] giving up after {cfg.max_restarts + 1} attempts "
+        f"[supervisor] giving up after {attempt} attempts "
         f"(last rc={rc}); see {cfg.events}",
         file=sys.stderr,
     )
@@ -230,6 +302,12 @@ class FleetConfig:
     # CPU fleets (CI / rehearsals): virtual devices per process via
     # --xla_force_host_platform_device_count; None = leave XLA_FLAGS alone
     devices_per_proc: Optional[int] = None
+    # resource-exit policy, same contract as SupervisorConfig: one
+    # process exiting EXIT_RESOURCE_EXHAUSTED (its peers wedge in the
+    # next collective and are torn down) halts the fleet with a verdict,
+    # or reclaims + retries exactly once under reclaim=True
+    reclaim: bool = False
+    reclaim_dirs: tuple = ()
     rng: random.Random = field(default_factory=random.Random, repr=False)
 
     backoff = SupervisorConfig.backoff
@@ -276,8 +354,13 @@ def _teardown_fleet(cfg: FleetConfig, children: list) -> None:
             c.wait()
 
 
-def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> bool:
-    """One whole-fleet launch; True iff every process exited 0."""
+def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> str:
+    """One whole-fleet launch -> 'ok' | 'dead' | 'resource'.
+
+    'resource': some process performed a RESOURCE_EXHAUSTED clean exit
+    (full disk / breached budget — resilience.resources); its wedged
+    peers are torn down like any fleet failure, but the *classification*
+    must survive so supervise_fleet never restarts into the full disk."""
     port = _free_port()
     if cfg.heartbeat_dir is not None:
         os.makedirs(cfg.heartbeat_dir, exist_ok=True)
@@ -315,41 +398,81 @@ def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> bool:
         done = [None] * cfg.num_processes  # rc once exited
         while True:
             now = time.monotonic()
+            stalled = None
             for i, child in enumerate(children):
                 if done[i] is not None:
                     continue
                 rc = child.poll()
                 if rc is not None:
-                    if rc == 0:
-                        done[i] = 0
-                        continue
-                    # one shard's process died: the rest are (or will be)
-                    # wedged in a collective — fail the whole attempt
-                    cfg.event(
-                        event="shard-exit",
-                        attempt=attempt,
-                        proc=i,
-                        pid=child.pid,
-                        rc=rc,
-                    )
-                    return False
+                    done[i] = rc
+                    continue
                 if hb_paths[i] is not None:
                     size = _hb_size(hb_paths[i])
                     if size != hb_sizes[i]:
                         hb_sizes[i] = size
                         last_progress[i] = now
-                    elif now - last_progress[i] > cfg.stall_timeout:
-                        cfg.event(
-                            event="shard-stall",
-                            attempt=attempt,
-                            proc=i,
-                            pid=child.pid,
-                            stall_timeout=cfg.stall_timeout,
-                            heartbeat=hb_paths[i],
-                        )
-                        return False
+                    elif (
+                        stalled is None
+                        and now - last_progress[i] > cfg.stall_timeout
+                    ):
+                        stalled = i
+            # classify only AFTER a full sweep: a peer noticing a lost
+            # rc-75 process can itself die non-zero within the same poll
+            # window, and child-index order must never let that crash mask
+            # the typed exit (the "restart into a full disk" hot-loop)
+            failed = next(
+                (i for i, rc in enumerate(done) if rc not in (0, None)), None
+            )
+            if failed is not None and done[failed] != EXIT_RESOURCE_EXHAUSTED:
+                # one extra poll cycle of grace for the reverse ordering —
+                # the peer's crash landing just before the typed exit
+                time.sleep(cfg.poll)
+                for i, child in enumerate(children):
+                    if done[i] is None:
+                        done[i] = child.poll()
+            resource = next(
+                (
+                    i
+                    for i, rc in enumerate(done)
+                    if rc == EXIT_RESOURCE_EXHAUSTED
+                ),
+                None,
+            )
+            if resource is not None:
+                # one process ran out of disk/RSS/time and exited typed;
+                # its peers wedge in the next collective — tear down like
+                # any fleet failure, but carry the classification up
+                cfg.event(
+                    event="shard-resource-exhausted",
+                    attempt=attempt,
+                    proc=resource,
+                    pid=children[resource].pid,
+                    rc=done[resource],
+                )
+                return "resource"
+            if failed is not None:
+                # one shard's process died: the rest are (or will be)
+                # wedged in a collective — fail the whole attempt
+                cfg.event(
+                    event="shard-exit",
+                    attempt=attempt,
+                    proc=failed,
+                    pid=children[failed].pid,
+                    rc=done[failed],
+                )
+                return "dead"
+            if stalled is not None:
+                cfg.event(
+                    event="shard-stall",
+                    attempt=attempt,
+                    proc=stalled,
+                    pid=children[stalled].pid,
+                    stall_timeout=cfg.stall_timeout,
+                    heartbeat=hb_paths[stalled],
+                )
+                return "dead"
             if all(rc == 0 for rc in done):
-                return True
+                return "ok"
             time.sleep(cfg.poll)
     finally:
         _teardown_fleet(cfg, children)
@@ -360,7 +483,13 @@ def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> bool:
 
 def supervise_fleet(cfg: FleetConfig) -> int:
     """Run the whole fleet to success or budget exhaustion; 0 on success."""
-    for attempt in range(1, cfg.max_restarts + 2):
+    reclaimed = False
+    attempt = 0
+    restarts_used = 0
+    # same while-loop restart accounting as supervise(): the one
+    # --reclaim retry is guaranteed even on the final budgeted attempt
+    while True:
+        attempt += 1
         cfg.event(
             event="fleet-start",
             attempt=attempt,
@@ -368,24 +497,37 @@ def supervise_fleet(cfg: FleetConfig) -> int:
             cmd=cfg.cmd,
         )
         t0 = time.time()
-        ok = _run_fleet_attempt(cfg, attempt)
+        status = _run_fleet_attempt(cfg, attempt)
         cfg.event(
             event="fleet-teardown",
             attempt=attempt,
-            ok=ok,
+            ok=status == "ok",
+            status=status,
             seconds=round(time.time() - t0, 1),
         )
-        if ok:
+        if status == "ok":
             cfg.event(event="fleet-complete", attempt=attempt)
             return 0
-        if attempt > cfg.max_restarts:
+        if status == "resource":
+            # same contract as the single-process supervisor: resource
+            # exits never burn the restart budget into a full disk —
+            # one reclaim-retry at most, else halt with the verdict
+            if cfg.reclaim and not reclaimed:
+                reclaimed = True
+                _try_reclaim(cfg, attempt)
+                continue
+            return _resource_verdict(
+                cfg, attempt, EXIT_RESOURCE_EXHAUSTED, reclaimed
+            )
+        if restarts_used >= cfg.max_restarts:
             break
-        delay = cfg.backoff(attempt)
+        restarts_used += 1
+        delay = cfg.backoff(restarts_used)
         cfg.event(event="restart", attempt=attempt, backoff_s=round(delay, 2))
         time.sleep(delay)
-    cfg.event(event="fleet-give-up", attempts=cfg.max_restarts + 1)
+    cfg.event(event="fleet-give-up", attempts=attempt)
     print(
-        f"[supervisor] fleet giving up after {cfg.max_restarts + 1} "
+        f"[supervisor] fleet giving up after {attempt} "
         f"attempts; see {cfg.events}",
         file=sys.stderr,
     )

@@ -3,9 +3,18 @@
 Every file this package publishes — runs, bloom sidecars, frontier
 segments, parent-log levels — goes through the same sequence: write to a
 `.tmp` sibling, flush + fsync, then atomically `os.replace` into the
-final name.  A crash at any point leaves either the old file or no file,
-never a torn one.  Centralized here so a future hardening (e.g. fsyncing
-the parent directory entry) lands everywhere at once.
+final name, then fsync the parent directory so the *rename itself* is
+durable (a power loss after the replace but before the directory entry
+hits disk would otherwise resurrect the old name).  A crash at any point
+leaves either the old file or no file, never a torn one; a failed write
+(ENOSPC, injected or real) additionally cleans up its own tmp so the
+directory stays exactly what the last manifest describes.
+
+`sweep_tmp` is the startup janitor for the one gap cleanup-on-raise
+cannot cover: a process killed *mid-write* leaves its `.tmp` sibling
+behind with no except block left to run.  Every storage structure sweeps
+its directory at open — tmp files are never referenced by any manifest,
+so removing them is always safe.
 """
 
 from __future__ import annotations
@@ -13,16 +22,63 @@ from __future__ import annotations
 import os
 
 
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a directory entry (some filesystems refuse
+    O_RDONLY dir fsync; the data-file fsync already happened either way)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def atomic_write(path: str, write_fn, before_replace=None) -> None:
     """Write `path` crash-safely: `write_fn(fh)` fills the tmp file, then
-    it is fsync'd and atomically promoted.  `before_replace` (if given)
-    runs between the durable tmp write and the promote — the torn-write
-    fault-injection point (`KSPEC_FAULT=crash@merge:N`)."""
+    it is fsync'd, atomically promoted, and the parent directory entry is
+    fsync'd.  `before_replace` (if given) runs between the durable tmp
+    write and the promote — the torn-write fault-injection point
+    (`KSPEC_FAULT=crash@merge:N` / `enospc@...:N`).  Any failure unlinks
+    the tmp before propagating, so a caller that survives the error (the
+    engines' RESOURCE_EXHAUSTED clean-exit path) leaves no orphan."""
     tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        write_fn(fh)
-        fh.flush()
-        os.fsync(fh.fileno())
-    if before_replace is not None:
-        before_replace()
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as fh:
+            write_fn(fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        if before_replace is not None:
+            before_replace()
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(os.path.dirname(path))
+
+
+def sweep_tmp(directory: str) -> list:
+    """Startup janitor: remove stale `.tmp` siblings (and `.tmp.npz`
+    checkpoint tmps) left by a mid-write death.  Safe by construction —
+    no manifest ever references a tmp name.  Returns the removed paths."""
+    removed = []
+    if not os.path.isdir(directory):
+        return removed
+    for name in os.listdir(directory):
+        if not (name.endswith(".tmp") or ".tmp." in name):
+            continue
+        p = os.path.join(directory, name)
+        if not os.path.isfile(p):
+            continue
+        try:
+            os.unlink(p)
+            removed.append(p)
+        except OSError:
+            pass
+    return removed

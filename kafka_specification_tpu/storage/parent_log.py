@@ -29,7 +29,7 @@ import zlib
 
 import numpy as np
 
-from .atomic import atomic_write
+from .atomic import atomic_write, sweep_tmp
 
 _HDR_LEN = 256
 _MAGIC = "KPLG1"
@@ -75,12 +75,14 @@ class _LevelView:
 
 
 class ParentLog:
-    def __init__(self, directory: str, lanes: int):
+    def __init__(self, directory: str, lanes: int, fault_plan=None):
         self.dir = directory
         self.K = int(lanes)
+        self.fault_plan = fault_plan  # enospc@plog:N injection
         self._parts: list = []  # buffered (rows, parent, act) per append
         self._level = None
         os.makedirs(directory, exist_ok=True)
+        sweep_tmp(directory)  # mid-write death janitor (storage/atomic)
 
     # --- write side -----------------------------------------------------
     def begin_level(self, level: int) -> None:
@@ -128,6 +130,14 @@ class ParentLog:
         blob = json.dumps(hdr).encode("ascii")
         assert len(blob) < _HDR_LEN, "parent-log header overflow"
         path = os.path.join(self.dir, _level_name(self._level))
+        hook = None
+        if self.fault_plan is not None:
+            level = self._level
+
+            def hook():
+                # full-disk rehearsal (enospc@plog:N): pre-promote, so the
+                # published log still ends at the last complete level
+                self.fault_plan.enospc("plog", level)
 
         def write(fh):
             fh.write(blob.ljust(_HDR_LEN))
@@ -135,7 +145,7 @@ class ParentLog:
             fh.write(parent.tobytes())
             fh.write(act.tobytes())
 
-        atomic_write(path, write)
+        atomic_write(path, write, before_replace=hook)
         self._parts = []
         self._level = None
 
@@ -193,10 +203,12 @@ class ShardedParentLog:
     """
 
     def __init__(self, directory: str, lanes: int, shard_count: int,
-                 local_shards=None, epoch_writer: bool = True):
+                 local_shards=None, epoch_writer: bool = True,
+                 fault_plan=None):
         self.dir = directory
         self.K = int(lanes)
         self.D = int(shard_count)
+        self.fault_plan = fault_plan  # enospc@plog:N (per-shard writers)
         self.local = (
             set(range(self.D))
             if local_shards is None
@@ -236,7 +248,8 @@ class ShardedParentLog:
     def _log(self, d: int) -> ParentLog:
         if d not in self._logs:
             self._logs[d] = ParentLog(
-                os.path.join(self.dir, f"shard{d}"), self.K
+                os.path.join(self.dir, f"shard{d}"), self.K,
+                fault_plan=self.fault_plan,
             )
         return self._logs[d]
 

@@ -31,10 +31,13 @@ class RunCorrupt(Exception):
     """A run file failed its manifest (count/CRC) verification."""
 
 
-def write_run(path: str, fps: np.ndarray, bloom_path=None) -> dict:
+def write_run(path: str, fps: np.ndarray, bloom_path=None,
+              before_replace=None) -> dict:
     """Atomically write sorted fingerprints `fps` as a run; -> manifest
     entry {name, count, crc32, lo, hi}.  `fps` must already be sorted and
-    duplicate-free (the tiered set guarantees disjoint spills)."""
+    duplicate-free (the tiered set guarantees disjoint spills).
+    `before_replace` is the pre-promote fault-injection point
+    (`KSPEC_FAULT=enospc@spill:N`)."""
     fps = np.ascontiguousarray(fps, np.uint64)
     payload = fps.tobytes()
 
@@ -43,7 +46,7 @@ def write_run(path: str, fps: np.ndarray, bloom_path=None) -> dict:
         fh.write(np.uint64(fps.shape[0]).tobytes())
         fh.write(payload)
 
-    atomic_write(path, write)
+    atomic_write(path, write, before_replace=before_replace)
     if bloom_path is not None:
         BloomFilter.build(fps).save(bloom_path)
     return {

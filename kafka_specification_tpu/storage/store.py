@@ -26,6 +26,7 @@ from typing import Optional
 
 import numpy as np
 
+from .atomic import sweep_tmp
 from .frontier import FrontierReader, FrontierWriter
 from .parent_log import ParentLog
 from .tiered import TieredFpSet
@@ -59,7 +60,14 @@ class DiskTierStore:
             fault_plan=fault_plan,
         )
         self.frontier_dir = os.path.join(spill_dir, "frontier")
-        self.plog = ParentLog(os.path.join(spill_dir, "plog"), lanes) if trace else None
+        sweep_tmp(self.frontier_dir)  # mid-write death janitor
+        self.plog = (
+            ParentLog(
+                os.path.join(spill_dir, "plog"), lanes, fault_plan=fault_plan
+            )
+            if trace
+            else None
+        )
         self._writer: Optional[FrontierWriter] = None
         self._reader: Optional[FrontierReader] = None
         # consumed frontier levels ride the same deletion barrier as
@@ -121,6 +129,30 @@ class DiskTierStore:
 
     def on_checkpoint_saved(self) -> None:
         self.fpset.on_checkpoint_saved()
+
+    def reclaim_merge(self) -> bool:
+        """Soft-breach reclamation step: eagerly k-way merge all runs
+        (superseded inputs go behind the deletion barrier; the caller's
+        fresh checkpoint + generation prune then makes them deletable).
+        Returns whether a merge actually ran — the caller skips its fresh
+        checkpoint when nothing changed the on-disk state."""
+        if len(self.fpset.runs) < 2:
+            return False
+        self.fpset.merge()
+        return True
+
+    def flush_deleted(self) -> int:
+        """Delete every barrier-pending file now — legal only right after
+        the caller pruned all generations but the newest (see
+        DeferredDeleter.flush).  Returns the number of files freed."""
+        return self._deleter.flush()
+
+    def sweep_tmp(self) -> list:
+        """Janitor pass over every directory this store writes."""
+        out = sweep_tmp(os.path.join(self.dir, "fps"))
+        out += sweep_tmp(self.frontier_dir)
+        out += sweep_tmp(os.path.join(self.dir, "plog"))
+        return out
 
     # --- per-level flow -------------------------------------------------
     def pending(self) -> FrontierReader:

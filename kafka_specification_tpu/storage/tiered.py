@@ -26,6 +26,7 @@ import os
 import numpy as np
 
 from ..native import FpSet
+from .atomic import sweep_tmp
 from .runs import SortedRun, merge_runs, write_run
 
 # ~bytes of host residency per fingerprint: 8 B/slot at <=1/2 open-
@@ -63,6 +64,17 @@ class DeferredDeleter:
             else:
                 keep.append(item)
         self.pending = keep
+
+    def flush(self) -> int:
+        """Delete every pending file NOW.  Legal only when the caller has
+        just pruned all checkpoint generations older than the newest one
+        (resource reclamation): the files' barrier counts protected
+        exactly those generations' manifests."""
+        n = len(self.pending)
+        for _, p in self.pending:
+            _unlink_quiet(p)
+        self.pending = []
+        return n
 
     def manifest(self, directory: str) -> list:
         return [[n, os.path.relpath(p, directory)] for n, p in self.pending]
@@ -126,6 +138,9 @@ class TieredFpSet:
         # per-run counters die with them; totals must not)
         self._retired_probes = {"probes": 0, "bloom_maybe": 0, "hits": 0}
         os.makedirs(directory, exist_ok=True)
+        # startup janitor: a mid-write death leaves a .tmp sibling no
+        # manifest references; sweep it before it masquerades as usage
+        sweep_tmp(self.dir)
 
     # --- lifecycle ------------------------------------------------------
     def start_fresh(self) -> None:
@@ -296,8 +311,22 @@ class TieredFpSet:
         from ..obs import tracer as _obs
 
         path = self._run_path()
+        hook = None
+        if self.fault_plan is not None:
+            ordinal = self.spills + 1
+
+            def hook():
+                # full-disk rehearsal (enospc@spill:N): fires after the
+                # tmp write, before the promote — atomic_write cleans up
+                # the tmp and the hot set is untouched (it restarts empty
+                # only after a successful promote), so the engines'
+                # RESOURCE_EXHAUSTED exit leaves a verifiable state
+                self.fault_plan.enospc("spill", ordinal)
+
         with _obs.span("spill-run-write", rows=int(fps.shape[0])):
-            meta = write_run(path, fps, bloom_path=path + ".bloom")
+            meta = write_run(
+                path, fps, bloom_path=path + ".bloom", before_replace=hook
+            )
         _met.inc("kspec_spill_runs_total")
         self.runs.append(SortedRun(self.dir, meta, verify=False))
         self.disk_n += fps.shape[0]
@@ -325,6 +354,7 @@ class TieredFpSet:
 
             def hook():
                 self.fault_plan.crash("merge", ordinal)
+                self.fault_plan.enospc("merge", ordinal)
 
         with _obs.span(
             "spill-merge",

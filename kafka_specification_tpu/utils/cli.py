@@ -41,6 +41,10 @@ _CLI_MARKER_ENV = "KSPEC_CLI_PLATFORM_MARKER"
 _INIT_TIMEOUT = int(os.environ.get("KSPEC_CLI_PLATFORM_TIMEOUT", "45"))
 _COMPUTE_TIMEOUT = int(os.environ.get("KSPEC_CLI_COMPUTE_TIMEOUT", "90"))
 
+# typed resource exit (resilience.resources) — duplicated as a literal for
+# help strings; asserted equal at the use site
+_EXIT_RESOURCE_EXHAUSTED = 75
+
 
 def _enable_compile_cache():
     """Persistent XLA compilation cache for the CLI's engine paths.
@@ -333,6 +337,26 @@ def main(argv=None):
         "= disk exactly when --mem-budget is set (default)",
     )
     pc.add_argument(
+        "--disk-budget",
+        metavar="BYTES",
+        help="byte budget for the spill + checkpoint directories "
+        "(suffixes K/M/G).  Crossing the soft fraction triggers "
+        "reclamation (eager merges, generation pruning); a hard breach "
+        "checkpoints and exits with the typed RESOURCE_EXHAUSTED status "
+        f"(exit code {_EXIT_RESOURCE_EXHAUSTED}), resumable after space "
+        "is freed (docs/resilience.md).  KSPEC_DISK_BUDGET is the env "
+        "twin; KSPEC_RSS_BUDGET / KSPEC_LEVEL_DEADLINE arm the RSS and "
+        "per-level-deadline watchdogs",
+    )
+    pc.add_argument(
+        "--reclaim",
+        action="store_true",
+        help="[--resilient] on a RESOURCE_EXHAUSTED child exit, prune "
+        "stale tmp files + rotated checkpoint generations and retry "
+        "exactly once (default: halt with an actionable verdict; the "
+        "supervisor never restarts into an unreclaimed full disk)",
+    )
+    pc.add_argument(
         "--profile",
         metavar="DIR",
         help="wrap the run in a jax.profiler trace (TensorBoard format)",
@@ -482,6 +506,15 @@ def main(argv=None):
 
         try:
             args.mem_budget = parse_mem_budget(args.mem_budget)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    if args.cmd == "check" and args.disk_budget is not None:
+        from ..resilience.resources import parse_bytes
+
+        try:
+            args.disk_budget = parse_bytes(args.disk_budget)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
@@ -673,9 +706,38 @@ def main(argv=None):
 
         prof = jax.profiler.trace(args.profile)
     chunk_kw = {} if args.chunk_size is None else {"chunk_size": args.chunk_size}
-    with prof:
-        res = _run_engine(args, model, tlc_cfg, progress, chunk_kw,
-                          run=run_ctx)
+    from ..resilience.resources import (
+        EXIT_RESOURCE_EXHAUSTED,
+        ResourceExhausted,
+    )
+
+    assert EXIT_RESOURCE_EXHAUSTED == _EXIT_RESOURCE_EXHAUSTED
+    try:
+        with prof:
+            res = _run_engine(args, model, tlc_cfg, progress, chunk_kw,
+                              run=run_ctx)
+    except ResourceExhausted as e:
+        # the typed terminal: the engine already checkpointed what it
+        # could, stamped the run manifest, and left every promoted
+        # generation verifiable — tell the operator what ran out and how
+        # to resume, and exit with the distinct resource code (75) so
+        # supervisors never classify this as a crash
+        print(f"RESOURCE EXHAUSTED: {e}", file=sys.stderr)
+        if args.checkpoint:
+            print(
+                f"  checkpoint intact at {args.checkpoint} — verify with "
+                f"`... verify-checkpoint {args.checkpoint}`, free space "
+                f"(or raise --disk-budget), then re-run the same command "
+                f"to resume",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "  no --checkpoint was configured: a re-run starts over "
+                "(add --checkpoint to make resource exits resumable)",
+                file=sys.stderr,
+            )
+        return EXIT_RESOURCE_EXHAUSTED
     if run_ctx is not None and spill_defaulted:
         # completed run: the spilled fingerprint data is dead weight (the
         # spill accounting lives on in metrics/spans); only a crash —
@@ -790,6 +852,12 @@ def _run_resilient(args, argv) -> int:
         max_restarts=args.max_restarts,
         env=dict(os.environ),
         run_id=run_ctx.run_id,
+        # resource-exit policy: halt with a verdict, or prune + retry
+        # once under --reclaim (never restart into a full disk)
+        reclaim=bool(args.reclaim),
+        reclaim_dirs=tuple(
+            d for d in (args.checkpoint, args.spill_dir) if d
+        ),
     )
     return supervise(cfg)
 
@@ -840,6 +908,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw, run=None):
         mem_budget=args.mem_budget,
         spill_dir=args.spill_dir,
         store=args.store,
+        disk_budget=args.disk_budget,
         run=run,
     )
     if args.sharded:

@@ -87,6 +87,24 @@ def main(argv=None):
     ap.add_argument("--backoff", type=float, default=5.0)
     ap.add_argument("--backoff-cap", type=float, default=300.0)
     ap.add_argument(
+        "--reclaim",
+        action="store_true",
+        help="on a child RESOURCE_EXHAUSTED exit (code 75: full disk / "
+        "breached budget, checkpointed clean — docs/resilience.md), prune "
+        "stale tmp files + rotated checkpoint generations under "
+        "--reclaim-dir and retry EXACTLY once.  Default: halt with an "
+        "actionable verdict; the supervisor never hot-loops restarts "
+        "into an unreclaimed full disk",
+    )
+    ap.add_argument(
+        "--reclaim-dir",
+        action="append",
+        default=[],
+        metavar="DIR",
+        help="directory the --reclaim sweep prunes (repeatable; typically "
+        "the checkpoint and spill dirs)",
+    )
+    ap.add_argument(
         "--fleet",
         type=int,
         metavar="P",
@@ -203,6 +221,8 @@ def main(argv=None):
             env=env,
             run_id=run_ctx.run_id,
             devices_per_proc=args.devices_per_proc,
+            reclaim=args.reclaim,
+            reclaim_dirs=tuple(args.reclaim_dir),
         )
         return supervise_fleet(fcfg)
     cfg = SupervisorConfig(
@@ -216,6 +236,8 @@ def main(argv=None):
         backoff_cap=args.backoff_cap,
         env=env,
         run_id=run_ctx.run_id,
+        reclaim=args.reclaim,
+        reclaim_dirs=tuple(args.reclaim_dir),
     )
     return supervise(cfg)
 

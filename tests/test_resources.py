@@ -1,0 +1,571 @@
+"""Resource-exhaustion governance (resilience/resources.py, ISSUE 5).
+
+The acceptance bar: every injected resource fault (`enospc@spill|merge|
+ckpt|plog:N`, `stall@level:N`, incl. a `shard<d>:`-scoped case) must
+produce a clean typed RESOURCE_EXHAUSTED exit whose checkpoint passes the
+offline verifier, and the post-"free space" resume must be bit-identical
+(counts AND counterexample trace values) to the fault-free run — on both
+engines.  The supervisor must classify resource exits separately from
+crashes: halt with a verdict, or at most ONE reclaim-retry under
+--reclaim, never a restart hot-loop into an unreclaimed full disk.
+
+Trace identity is pinned per engine (parent choice among multiple valid
+parents is a per-backend property — same convention as test_storage).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.parallel.sharded import check_sharded
+from kafka_specification_tpu.resilience import (
+    EXIT_RESOURCE_EXHAUSTED,
+    FaultPlan,
+    ResourceExhausted,
+    ResourceGovernor,
+    reclaim_disk,
+)
+from kafka_specification_tpu.resilience.checkpoints import (
+    CheckpointStore,
+    verify_checkpoint_dir,
+)
+from kafka_specification_tpu.resilience.resources import (
+    dir_usage_bytes,
+    is_disk_full,
+    parse_bytes,
+    rss_bytes,
+)
+from kafka_specification_tpu.resilience.retry import (
+    ChunkRetryHandler,
+    RetryPolicy,
+    classify,
+)
+from kafka_specification_tpu.resilience.supervisor import (
+    SupervisorConfig,
+    supervise,
+)
+from kafka_specification_tpu.storage.atomic import atomic_write, sweep_tmp
+
+pytestmark = pytest.mark.resource
+
+TINY = Config(2, 2, 1, 1)
+
+
+@pytest.fixture(autouse=True)
+def _tiny_spill_shapes(monkeypatch):
+    """Force spills/segment cuts/merges at toy state counts (same scheme
+    as test_storage) so every disk write path runs in tier-1."""
+    monkeypatch.setenv("KSPEC_SPILL_SEG_ROWS", "13")
+    monkeypatch.setenv("KSPEC_SPILL_RUNS_PER_MERGE", "2")
+
+
+def _mk():
+    # TruncateToHW violates WeakIsr @ depth 8: the resume must reproduce
+    # not just counts but the full counterexample trace
+    return variants.make_model(
+        "KafkaTruncateToHighWatermark", TINY, ("TypeOk", "WeakIsr")
+    )
+
+
+def _verdict(res):
+    return (
+        res.total,
+        res.diameter,
+        tuple(res.levels),
+        res.ok,
+        (res.violation.invariant, res.violation.depth) if res.violation else None,
+    )
+
+
+@pytest.fixture(scope="module")
+def golden_single():
+    return check(_mk(), min_bucket=32, visited_backend="host")
+
+
+@pytest.fixture(scope="module")
+def golden_sharded():
+    return check_sharded(_mk(), min_bucket=32, visited_backend="host")
+
+
+# --- unit: grammar ---------------------------------------------------------
+
+
+def test_resource_fault_grammar():
+    p = FaultPlan(
+        "enospc@spill:2,enospc@merge:1,enospc@ckpt:3,enospc@plog:4,"
+        "stall@level:5,enospc@shard1:spill:2"
+    )
+    assert len(p.specs) == 6
+    with pytest.raises(OSError) as ei:
+        p.enospc("spill", 2)
+    assert ei.value.errno == 28 and is_disk_full(ei.value)
+    with pytest.raises(OSError):
+        p.enospc("spill", 2)  # the shard-scoped twin (no topology wired)
+    p.enospc("spill", 2)  # both budgets consumed: no re-fire
+    p.enospc("merge", 2)  # wrong ordinal: no fire
+    assert not p.stalled(4)
+    assert p.stalled(5)
+    assert not p.stalled(5)  # budget consumed
+    for bad in ("enospc@frontier:1", "stall@ckpt:1", "enospc@spill",
+                "stall@level:0"):
+        with pytest.raises(ValueError):
+            FaultPlan(bad)
+
+
+def test_resource_faults_respect_resume_depth_and_shard_scope():
+    p = FaultPlan("enospc@ckpt:2,stall@level:3")
+    p.set_start_depth(5)  # resumed past both targets: counts as fired
+    p.enospc("ckpt", 2)
+    assert not p.stalled(3)
+    p2 = FaultPlan("enospc@shard1:spill:1")
+    p2.set_local_shards([0])  # shard 1 lives elsewhere: never local
+    p2.enospc("spill", 1)  # no fire
+    p2.set_local_shards([1])
+    with pytest.raises(OSError):
+        p2.enospc("spill", 1)
+
+
+# --- unit: governor + helpers ----------------------------------------------
+
+
+def test_parse_bytes_and_dir_usage(tmp_path):
+    assert parse_bytes("1.5K") == 1536
+    assert parse_bytes(4096) == 4096
+    with pytest.raises(ValueError):
+        parse_bytes("-1G")
+    sub = tmp_path / "a" / "b"
+    sub.mkdir(parents=True)
+    (sub / "x").write_bytes(b"\x00" * 100)
+    (tmp_path / "y").write_bytes(b"\x00" * 50)
+    # nested watch dirs are counted once
+    assert dir_usage_bytes([str(tmp_path), str(sub)]) == 150
+    assert dir_usage_bytes([str(tmp_path / "missing")]) == 0
+    assert rss_bytes() is None or rss_bytes() > 0
+
+
+def test_governor_soft_breach_reclaims_then_hard_exits(tmp_path):
+    d = tmp_path / "spill"
+    d.mkdir()
+    (d / "junk").write_bytes(b"\x00" * 900)
+    gov = ResourceGovernor(disk_budget=1000, soft_frac=0.5,
+                           watch_dirs=[str(d)])
+    calls = []
+
+    def reclaim():
+        calls.append(1)
+        (d / "junk").write_bytes(b"\x00" * 100)  # "freed" space
+
+    gov.level_end(3, reclaim=reclaim)  # soft breach -> reclaim saves it
+    assert calls == [1] and gov.reclaims == 1
+    (d / "junk").write_bytes(b"\x00" * 2000)
+    saved = []
+    with pytest.raises(ResourceExhausted) as ei:
+        gov.level_end(4, reclaim=lambda: None,
+                      save_hook=lambda: saved.append(1))
+    assert ei.value.reason == "disk" and ei.value.at_boundary
+    assert saved == [1]  # checkpoint-then-clean-exit
+
+
+def test_governor_deadline_and_rss(monkeypatch):
+    gov = ResourceGovernor(level_deadline=0.0)
+    gov.level_begin(7)
+    with pytest.raises(ResourceExhausted) as ei:
+        gov.poll(7)
+    assert ei.value.reason == "deadline"
+    gov2 = ResourceGovernor(rss_budget=1)
+    with pytest.raises(ResourceExhausted) as ei:
+        gov2.level_end(2)
+    assert ei.value.reason == "rss"
+
+
+# --- unit: atomic hardening + janitor (satellite) --------------------------
+
+
+def test_atomic_write_cleans_tmp_on_failure(tmp_path):
+    p = str(tmp_path / "out.bin")
+
+    def boom(fh):
+        fh.write(b"partial")
+        raise OSError(28, "No space left on device")
+
+    with pytest.raises(OSError):
+        atomic_write(p, boom)
+    assert os.listdir(str(tmp_path)) == []  # tmp cleaned, nothing promoted
+    atomic_write(p, lambda fh: fh.write(b"ok"))
+    with pytest.raises(RuntimeError):
+        atomic_write(p, lambda fh: fh.write(b"new"),
+                     before_replace=lambda: (_ for _ in ()).throw(
+                         RuntimeError("injected")))
+    with open(p, "rb") as fh:  # old content intact, no tmp sibling
+        assert fh.read() == b"ok"
+    assert os.listdir(str(tmp_path)) == ["out.bin"]
+
+
+def test_sweep_tmp_janitor(tmp_path):
+    (tmp_path / "run-000001.fps").write_bytes(b"keep")
+    (tmp_path / "run-000002.fps.tmp").write_bytes(b"stale")
+    (tmp_path / "ck.npz.tmp.npz").write_bytes(b"stale")
+    removed = sweep_tmp(str(tmp_path))
+    assert len(removed) == 2
+    assert sorted(os.listdir(str(tmp_path))) == ["run-000001.fps"]
+
+
+def test_checkpoint_store_sweeps_and_prunes(tmp_path):
+    d = str(tmp_path)
+    stale = os.path.join(d, "ck.npz.tmp.npz")
+    open(stale, "wb").write(b"torn")
+    st = CheckpointStore(d, "ck.npz", ident="x", keep=3)
+    assert not os.path.exists(stale)  # startup janitor
+    for depth in (1, 2, 3):
+        st.save(depth, {"a": np.arange(depth)})
+    assert st.generations() == [0, 1, 2]
+    removed = st.prune(keep_gens=1)
+    assert len(removed) == 2 and st.generations() == [0]
+    assert st.load()[0]["depth"] == 3  # newest survives, verifies
+
+
+# --- unit: device RESOURCE_EXHAUSTED degradation (satellite) ----------------
+
+
+def test_classify_device_resource_is_its_own_class():
+    assert classify(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 8589934592 bytes"
+    )) == "device_resource"
+    assert classify(RuntimeError("LLVM ERROR: out of memory")) == "compile_oom"
+    assert classify(RuntimeError("UNAVAILABLE: socket closed")) == "transient"
+
+
+def test_device_resource_degrades_chunk_not_identical_retry():
+    h = ChunkRetryHandler(policy=RetryPolicy(max_retries=0), tag="[t]")
+    e = RuntimeError("RESOURCE_EXHAUSTED: out of device memory")
+    for i in range(h.max_chunk_degrades):
+        assert h.handle(e, escalated=False, depth=4) == "degrade_chunk"
+    with pytest.raises(RuntimeError):  # shrinking stopped helping
+        h.handle(e, escalated=False, depth=4)
+    assert h.chunk_degrades == h.max_chunk_degrades
+    assert all(d["kind"] == "chunk_degrade" for d in h.degradations)
+    # multiprocess: degrading one process alone would desync -> re-raise
+    h2 = ChunkRetryHandler(policy=RetryPolicy(max_retries=0), tag="[t]")
+    with pytest.raises(RuntimeError):
+        h2.handle(e, escalated=False, depth=4, retry_transient=False)
+    # ESCALATED attempts keep the pre-split behavior (review finding):
+    # uniform-path degrade, deterministic hence lockstep-safe — even in
+    # multiprocess, where the chunk shrink would be unsound
+    h3 = ChunkRetryHandler(policy=RetryPolicy(max_retries=0), tag="[t]")
+    assert h3.handle(e, escalated=True, depth=4,
+                     retry_transient=False) == "degrade"
+    assert h3.chunk_degrades == 0
+
+
+# --- engine matrix: typed exit + verifiable checkpoint + exact resume ------
+
+
+def _drill(engine, golden, fault, monkeypatch, tmp_path, budget):
+    """Inject `fault`, require the typed exit, verify the checkpoint
+    offline, 'free space' (clear the fault), resume, pin bit-identity."""
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", fault)
+    with pytest.raises(ResourceExhausted) as ei:
+        engine(_mk(), min_bucket=32, mem_budget=budget, checkpoint_dir=ck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    rep = verify_checkpoint_dir(ck)
+    assert rep["ok"], f"{fault}: checkpoint not verifiable: {rep}"
+    resumed = engine(_mk(), min_bucket=32, mem_budget=budget,
+                     checkpoint_dir=ck)
+    assert _verdict(resumed) == _verdict(golden)
+    assert resumed.violation.trace == golden.violation.trace
+    assert resumed.violation.trace[0][0] == "<init>"
+    return ei.value
+
+
+@pytest.mark.parametrize(
+    "fault,reason",
+    [
+        ("enospc@spill:2", "enospc"),
+        ("enospc@merge:1", "enospc"),
+        ("enospc@ckpt:3", "enospc"),
+        ("enospc@plog:4", "enospc"),
+        ("stall@level:4", "stall"),
+    ],
+)
+def test_resource_fault_matrix_single_device(
+    fault, reason, golden_single, monkeypatch, tmp_path
+):
+    e = _drill(check, golden_single, fault, monkeypatch, tmp_path, 300)
+    assert e.reason == reason
+
+
+@pytest.mark.parametrize(
+    "fault,reason",
+    [
+        ("enospc@shard0:spill:2", "enospc"),  # shard-scoped resource fault
+        ("enospc@ckpt:3", "enospc"),
+        ("enospc@plog:4", "enospc"),
+        ("stall@level:4", "stall"),
+    ],
+)
+def test_resource_fault_matrix_sharded(
+    fault, reason, golden_sharded, monkeypatch, tmp_path
+):
+    e = _drill(check_sharded, golden_sharded, fault, monkeypatch, tmp_path,
+               2048)
+    assert e.reason == reason
+
+
+def test_disk_budget_hard_breach_checkpoints_then_resumes(
+    golden_single, tmp_path
+):
+    """A real (not injected) budget breach: tiny --disk-budget trips at
+    the first level boundary, the forced final save makes the breach
+    level resumable, and the resume (budget lifted) is bit-identical."""
+    ck = str(tmp_path / "ck")
+    with pytest.raises(ResourceExhausted) as ei:
+        check(_mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck,
+              disk_budget=1)
+    assert ei.value.reason == "disk" and ei.value.at_boundary
+    assert verify_checkpoint_dir(ck)["ok"]
+    resumed = check(_mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    assert _verdict(resumed) == _verdict(golden_single)
+    assert resumed.violation.trace == golden_single.violation.trace
+
+
+def test_soft_breach_reclaims_and_run_completes(golden_single, monkeypatch,
+                                                tmp_path):
+    """Soft breach without hard breach: KSPEC_RESOURCE_SOFT=0 makes every
+    level a soft breach under a roomy budget, so the engine reclaims
+    (tmp janitor -> eager merge -> fresh checkpoint -> generation prune ->
+    barrier flush) every level — and the run still finishes bit-identical,
+    with the checkpoint chain pruned to the newest generation."""
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_RESOURCE_SOFT", "0")
+    res = check(_mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck,
+                disk_budget="64M")
+    assert _verdict(res) == _verdict(golden_single)
+    assert res.violation.trace == golden_single.violation.trace
+    # reclamation pruned rotated generations: only the newest main remains
+    mains = [n for n in os.listdir(ck) if n.endswith(".npz")]
+    assert mains == ["bfs_checkpoint.npz"]
+    assert verify_checkpoint_dir(ck)["ok"]
+
+
+def test_level_deadline_exits_typed_and_resumes(golden_single, monkeypatch,
+                                                tmp_path):
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_LEVEL_DEADLINE", "0")
+    with pytest.raises(ResourceExhausted) as ei:
+        check(_mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    assert ei.value.reason == "deadline"
+    monkeypatch.delenv("KSPEC_LEVEL_DEADLINE")
+    resumed = check(_mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck)
+    assert _verdict(resumed) == _verdict(golden_single)
+    assert resumed.violation.trace == golden_single.violation.trace
+
+
+# --- obs: manifest status + report verdict beat + pressure timeline --------
+
+
+def test_resource_exit_stamps_manifest_and_report(monkeypatch, tmp_path):
+    from kafka_specification_tpu.obs import RunContext
+    from kafka_specification_tpu.obs.report import render_report, report_data
+
+    run_dir = str(tmp_path / "run")
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", "stall@level:3")
+    with pytest.raises(ResourceExhausted):
+        check(_mk(), min_bucket=32, mem_budget=300, checkpoint_dir=ck,
+              disk_budget="1G", run=RunContext(run_dir))
+    monkeypatch.delenv("KSPEC_FAULT")
+    with open(os.path.join(run_dir, "manifest.json")) as fh:
+        man = json.load(fh)
+    assert man["status"] == "resource-exhausted"
+    assert man["result"]["reason"] == "stall"
+    data = report_data(run_dir)
+    assert data["verdict"]["status"] == "resource-exhausted"
+    assert data["resource"]["present"]
+    assert data["resource"]["disk_budget"] == 1 << 30
+    text = render_report(run_dir)
+    assert "RESOURCE-EXHAUSTED" in text  # header verdict beat
+    assert "RESOURCE EXHAUSTED: stall at level 3" in text
+    assert "Resource pressure" in text
+
+
+# --- supervisor: classification + at-most-one reclaim-retry ----------------
+
+_CHILD = """\
+import os, sys
+# exits 75 while the sentinel exists (the "full disk"), else succeeds;
+# appends a heartbeat line so the stall detector sees progress
+open(sys.argv[2], "a").write("beat\\n")
+sys.exit(75 if os.path.exists(sys.argv[1]) else 0)
+"""
+
+
+def _sup_cfg(tmp_path, sentinel, hb, events, **kw):
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    return SupervisorConfig(
+        cmd=[sys.executable, str(child), str(sentinel), str(hb)],
+        heartbeat=str(hb),
+        events=str(events),
+        stall_timeout=30.0,
+        max_restarts=4,
+        backoff_base=0.01,
+        backoff_cap=0.02,
+        **kw,
+    )
+
+
+def _events(path):
+    with open(path) as fh:
+        return [json.loads(line)["event"] for line in fh]
+
+
+def test_supervisor_halts_on_resource_exit_without_reclaim(tmp_path):
+    sentinel = tmp_path / "disk_full.marker"
+    sentinel.write_text("x")
+    events = tmp_path / "events.jsonl"
+    cfg = _sup_cfg(tmp_path, sentinel, tmp_path / "hb.jsonl", events)
+    rc = supervise(cfg)
+    assert rc == EXIT_RESOURCE_EXHAUSTED
+    evs = _events(events)
+    assert "resource-exhausted" in evs and "resource-verdict" in evs
+    assert "restart" not in evs  # never a restart into the full disk
+    assert evs.count("start") == 1
+
+
+def test_supervisor_reclaim_retries_exactly_once_then_succeeds(tmp_path):
+    # the sentinel is a stale .tmp file INSIDE the reclaim dir: the sweep
+    # removes it ("frees the disk"), so the single retry succeeds
+    rdir = tmp_path / "ckpt"
+    rdir.mkdir()
+    sentinel = rdir / "disk_full.tmp"
+    sentinel.write_text("x")
+    events = tmp_path / "events.jsonl"
+    cfg = _sup_cfg(tmp_path, sentinel, tmp_path / "hb.jsonl", events,
+                   reclaim=True, reclaim_dirs=(str(rdir),))
+    assert supervise(cfg) == 0
+    evs = _events(events)
+    assert "reclaim" in evs and "complete" in evs
+    assert not sentinel.exists()
+    assert evs.count("start") == 2  # original + the one reclaim-retry
+
+
+def test_supervisor_reclaim_retry_survives_exhausted_budget(tmp_path):
+    """Review-finding regression: the one reclaim-retry is a different
+    lever than a crash restart and must run even with max_restarts=0 —
+    it must never be silently dropped by budget accounting."""
+    rdir = tmp_path / "ckpt"
+    rdir.mkdir()
+    sentinel = rdir / "disk_full.tmp"
+    sentinel.write_text("x")
+    events = tmp_path / "events.jsonl"
+    cfg = _sup_cfg(tmp_path, sentinel, tmp_path / "hb.jsonl", events,
+                   reclaim=True, reclaim_dirs=(str(rdir),))
+    cfg.max_restarts = 0
+    assert supervise(cfg) == 0
+    evs = _events(events)
+    assert evs.count("start") == 2 and "reclaim" in evs
+    assert "give-up" not in evs
+
+
+def test_supervisor_reclaim_retry_is_bounded(tmp_path):
+    # reclaim can't free anything (sentinel outside the reclaim dirs):
+    # retry once, then halt with the verdict — never a third attempt
+    sentinel = tmp_path / "disk_full.marker"
+    sentinel.write_text("x")
+    events = tmp_path / "events.jsonl"
+    rdir = tmp_path / "empty"
+    rdir.mkdir()
+    cfg = _sup_cfg(tmp_path, sentinel, tmp_path / "hb.jsonl", events,
+                   reclaim=True, reclaim_dirs=(str(rdir),))
+    assert supervise(cfg) == EXIT_RESOURCE_EXHAUSTED
+    evs = _events(events)
+    assert evs.count("start") == 2 and "resource-verdict" in evs
+
+
+def test_fleet_supervisor_classifies_resource_exit(tmp_path):
+    """One fleet process exiting 75 (its peers 'wedge', i.e. sleep) must
+    classify as a resource verdict — fleet torn down once, no restart."""
+    from kafka_specification_tpu.resilience.supervisor import (
+        FleetConfig,
+        supervise_fleet,
+    )
+
+    child = (
+        "import os, sys, time\n"
+        "if os.environ['JAX_PROCESS_ID'] == '0':\n"
+        "    sys.exit(75)\n"
+        "time.sleep(60)\n"  # a peer wedged in its 'collective'
+    )
+    events = tmp_path / "events.jsonl"
+    cfg = FleetConfig(
+        cmd=[sys.executable, "-c", child],
+        num_processes=3,
+        events=str(events),
+        stall_timeout=30.0,
+        max_restarts=3,
+        backoff_base=0.01,
+        backoff_cap=0.02,
+        term_grace=2.0,
+    )
+    assert supervise_fleet(cfg) == EXIT_RESOURCE_EXHAUSTED
+    evs = _events(events)
+    assert "shard-resource-exhausted" in evs and "resource-verdict" in evs
+    assert "restart" not in evs
+    assert evs.count("fleet-start") == 1
+
+
+def test_reclaim_disk_prunes_tmp_and_old_generations(tmp_path):
+    (tmp_path / "ck.npz").write_bytes(b"newest")
+    (tmp_path / "ck.npz.host0").write_bytes(b"newest part")
+    (tmp_path / "ck.1.npz").write_bytes(b"old gen")
+    (tmp_path / "ck.2.npz.host0").write_bytes(b"old part")
+    (tmp_path / "run-000001.fps").write_bytes(b"referenced run")
+    (tmp_path / "run-000002.fps.tmp").write_bytes(b"stale")
+    removed = reclaim_disk([str(tmp_path)])
+    assert sorted(os.path.basename(p) for p in removed) == [
+        "ck.1.npz", "ck.2.npz.host0", "run-000002.fps.tmp",
+    ]
+    assert (tmp_path / "ck.npz").exists()
+    assert (tmp_path / "run-000001.fps").exists()
+
+
+# --- CLI: distinct exit code -----------------------------------------------
+
+
+def test_cli_maps_resource_exhausted_to_exit_75(tmp_path, capsys):
+    """End-to-end through the CLI front door: an injected resource fault
+    exits with the distinct typed code (75), the checkpoint verifies, and
+    the post-free-space re-run of the SAME command resumes to exit 0."""
+    from kafka_specification_tpu.utils.cli import main as cli_main
+
+    ck = str(tmp_path / "ck")
+    argv = [
+        "check", "configs/FiniteReplicatedLog.cfg", "--hand",
+        "--min-bucket", "32", "--mem-budget", "300", "--checkpoint", ck,
+        "--run-dir", str(tmp_path / "run"),
+    ]
+    try:
+        rc = cli_main(argv + ["--fault", "enospc@spill:1"])
+        err = capsys.readouterr().err
+        assert rc == EXIT_RESOURCE_EXHAUSTED
+        assert "RESOURCE EXHAUSTED" in err and "verify-checkpoint" in err
+        # --fault exports KSPEC_FAULT into this process; pop it directly
+        # (monkeypatch.delenv would RESTORE the CLI-set value at teardown
+        # and leak the fault plan into every later test)
+        os.environ.pop("KSPEC_FAULT", None)
+        assert verify_checkpoint_dir(ck)["ok"]
+        rc2 = cli_main(argv)
+        out = capsys.readouterr().out
+        assert rc2 == 0 and "Exhaustive check complete" in out
+        # the resumed manifest closed out the lineage with a clean status
+        with open(os.path.join(str(tmp_path / "run"), "manifest.json")) as fh:
+            assert json.load(fh)["status"] == "complete"
+    finally:
+        os.environ.pop("KSPEC_FAULT", None)
