@@ -1,3 +1,3 @@
-from .bfs import CheckResult, Violation, check
+from .bfs import CheckResult, PreparedKernels, Violation, check, prepare
 
-__all__ = ["CheckResult", "Violation", "check"]
+__all__ = ["CheckResult", "PreparedKernels", "Violation", "check", "prepare"]

@@ -66,6 +66,36 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
+class _CompileOnFirstCall:
+    """Cache entry for a freshly built jitted step: the FIRST call is where
+    jax traces + XLA compiles (jax.jit is lazy), so exactly that call is
+    wrapped in a ``compile`` span — then the wrapper replaces itself with
+    the bare jitted function.  This is what lets a warm serving path PROVE
+    its cache hits: a job that re-uses every step shows zero compile spans
+    in its trace (service/kernel_cache, docs/service.md).  With no active
+    tracer the wrapper costs one dict store and disappears."""
+
+    def __init__(self, fn, cache: dict, key, **attrs):
+        self.fn = fn
+        self._cache = cache
+        self._key = key
+        self._attrs = attrs
+
+    def __call__(self, *args):
+        from ..obs import tracer as _tr
+
+        t0 = time.time()
+        out = self.fn(*args)
+        cur = _tr.current_tracer()
+        if cur is not None:
+            cur.emit_span("compile", t0, time.time(), **self._attrs)
+        # swap in the bare jitted fn iff this entry is still current (a
+        # capacity-growth eviction may already have dropped the key)
+        if self._cache.get(self._key) is self:
+            self._cache[self._key] = self.fn
+        return out
+
+
 def _round256(w: int) -> int:
     """Round up to the fingerprint-block alignment (single source of
     truth for widths_for and norm_widths — round-5 advisor item)."""
@@ -252,6 +282,16 @@ class _Step:
             except AttributeError:
                 pass  # exotic model object without attribute support
         self._cache = cache
+        # every key ever BUILT for this model, growth evictions included —
+        # what PreparedKernels.rewarm replays at the capacity fixed point
+        log = getattr(model, "_step_compiled_log", None)
+        if log is None:
+            log = set()
+            try:
+                model._step_compiled_log = log
+            except AttributeError:
+                pass
+        self._compiled_log = log
 
     def norm_widths(self, bucket: int, compact):
         """Normalize a compact spec to per-action buffer widths (rows).
@@ -463,11 +503,19 @@ class _Step:
             self.use_pallas,
         )
         if key not in self._cache:
-            self._cache[key] = jax.jit(
-                self.build_raw(
-                    bucket, vcap, with_invariants, with_merge, compact,
-                    squeeze_full,
-                )
+            self._compiled_log.add(key)
+            self._cache[key] = _CompileOnFirstCall(
+                jax.jit(
+                    self.build_raw(
+                        bucket, vcap, with_invariants, with_merge, compact,
+                        squeeze_full,
+                    )
+                ),
+                self._cache,
+                key,
+                bucket=bucket,
+                vcap=vcap,
+                compact=repr(compact_key),
             )
         return self._cache[key]
 
@@ -712,6 +760,124 @@ class _Step:
         return step
 
 
+class PreparedKernels:
+    """Reusable, warm engine kernels for one model — the serving daemon's
+    unit of caching (service/kernel_cache.py), split out of :func:`check`.
+
+    ``check`` builds a ``_Step`` per call; because the jitted-step cache
+    lives on the Model object it already re-warms across calls, but the
+    serving path needs the preparation to be an explicit, inspectable
+    artifact: ``prepare(model)`` once, then ``check(model,
+    prepared=pk)`` any number of times — the second and every later check
+    of the same schema shape re-uses every compiled step (zero ``compile``
+    spans in its trace, the daemon's warm-path proof).  ``warmup``
+    optionally pre-compiles the step for a given frontier bucket so even
+    the FIRST job of a shape pays no compile inside its latency budget.
+    """
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.step = _Step(model)
+        # The last run's FINAL visited capacity, fed back as check()'s
+        # visited_capacity_hint so WARM runs preallocate the device
+        # visited set at exactly the size the shape needs.  Without it
+        # every run replays the capacity-doubling ladder, and each
+        # doubling EVICTS the steps compiled for the outgrown capacity —
+        # i.e. a "warm" run would recompile the whole ladder again
+        # (measured 5s/run on the tiny truncate model).  Feeding back the
+        # final CAPACITY (a power of two the engine itself derived) makes
+        # the hint a fixed point: the next run of the same knobs starts
+        # at the same vcap, so every step-cache key matches and the warm
+        # trace shows zero compile spans.  The hash backend sizes its
+        # table from a state count instead, so non-device runs feed back
+        # res.total.
+        self.capacity_hint = None
+        self._hint_is_capacity = False  # True iff hint is a device vcap
+
+    def note_result(self, res: "CheckResult") -> None:
+        """Feed a finished run's visited sizing back into the hint."""
+        stats = res.stats or {}
+        if stats.get("visited_backend") == "device":
+            cap = stats.get("visited_capacity") or res.total
+            self._hint_is_capacity = True
+        else:
+            cap = res.total
+            self._hint_is_capacity = False
+        self.capacity_hint = max(self.capacity_hint or 0, cap)
+
+    def rewarm(self) -> int:
+        """Close the warm-capacity gap left by a run that GREW the device
+        visited set: growth evicts the steps compiled at every outgrown
+        capacity, but the buckets those steps served (the small early
+        levels) recur on the next run of this shape — which starts at the
+        new capacity fixed point and would pay one compile per missing
+        (bucket, final-capacity) variant.  Re-compile them now, off any
+        job's latency path, so the second job of a shape shows zero
+        compile spans even when the first had to grow (the serving
+        warm-path contract; the daemon calls this right after a run,
+        still inside its busy-heartbeat window).  Returns the number of
+        variants compiled."""
+        cap = self.capacity_hint
+        if not cap or not getattr(self, "_hint_is_capacity", False):
+            return 0  # non-device backends never evict on growth
+        done = 0
+        for key in list(self.step._compiled_log):
+            (bucket, vcap, with_inv, with_merge, compact_key, squeeze,
+             _pallas) = key
+            if vcap == cap:
+                continue
+            target = (bucket, cap, with_inv, with_merge, compact_key,
+                      squeeze, self.step.use_pallas)
+            if target in self.step._cache:
+                continue
+            self.warmup(
+                bucket, cap, with_inv, with_merge=with_merge,
+                compact=compact_key, squeeze_full=squeeze,
+            )
+            done += 1
+        return done
+
+    @property
+    def compiled_steps(self) -> int:
+        """Distinct (shape, variant) step programs built so far."""
+        return len(self.step._cache)
+
+    def warmup(
+        self,
+        bucket: int = 256,
+        vcap: int = 1 << 12,
+        check_invariants: bool = True,
+        with_merge: bool = True,
+        compact=None,
+        squeeze_full: bool = False,
+    ) -> None:
+        """Force trace + XLA compile of one step shape by running it on an
+        all-invalid frontier (fvalid all False: no successor is enabled, no
+        verdict can fire — pure compilation, results discarded)."""
+        bucket = _next_pow2(max(32, bucket))
+        vcap = _next_pow2(max(64, vcap))
+        step = self.step.get(
+            bucket, vcap, check_invariants, with_merge=with_merge,
+            compact=compact, squeeze_full=squeeze_full,
+        )
+        K = self.model.spec.num_lanes
+        out = step(
+            jnp.zeros((bucket, K), jnp.uint32),
+            jnp.zeros((bucket,), bool),
+            jnp.full(vcap, 0xFFFFFFFF, jnp.uint32),
+            jnp.full(vcap, 0xFFFFFFFF, jnp.uint32),
+            jnp.int32(0),
+        )
+        jax.block_until_ready(out)
+
+
+def prepare(model: Model) -> PreparedKernels:
+    """Prepare (and cache on the model) the reusable jitted engine kernels
+    for `model` — the explicit warm entry point ``check(...,
+    prepared=...)`` consumes."""
+    return PreparedKernels(model)
+
+
 def _pad_rows(arr: np.ndarray, n: int, fill=0):
     if arr.shape[0] == n:
         return arr
@@ -779,12 +945,16 @@ def check(
     visited_backend: str = "device",
     chunk_size: int = 32768,
     visited_capacity_hint: Optional[int] = None,
+    visited_capacity_exact: Optional[int] = None,
     compact_shift: int = 2,
     mem_budget=None,
     spill_dir: Optional[str] = None,
     store: str = "auto",
     disk_budget=None,
     run=None,
+    prepared: Optional[PreparedKernels] = None,
+    collect_trace: Optional[list] = None,
+    governor: Optional[ResourceGovernor] = None,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -820,8 +990,14 @@ def check(
     state-space size.
 
     visited_capacity_hint: preallocate the device visited set for ~this many
-    states so capacity doubling (one recompile per doubling) never triggers
-    on runs whose state-space size is roughly known.
+    states (plus one chunk of insert headroom) so capacity doubling (one
+    recompile per doubling) never triggers on runs whose state-space size
+    is roughly known.
+
+    visited_capacity_exact: preallocate the device visited set at exactly
+    this capacity (no headroom added) — for callers replaying a PRIOR
+    run's final capacity (PreparedKernels.capacity_hint), where an exact
+    fixed point is what keeps every warm step-cache key identical.
 
     compact_shift: two-phase expansion — sweep guards over the full padded
     lattice (state updates dead-code-eliminated), then run each action's
@@ -878,6 +1054,21 @@ def check(
     as before the obs subsystem existed (the shim contract,
     tests/test_obs.py).
 
+    prepared: a :class:`PreparedKernels` for this model (``prepare``):
+    the serving daemon's warm path — every compiled step is re-used, so a
+    warm check pays zero trace/compile (its span trace shows zero
+    ``compile`` spans).  Must wrap the SAME model object.
+
+    collect_trace: external list receiving the per-level trace store
+    ``(rows, parent, act)`` tuples (filled only while store_trace is on) —
+    the batched multi-config runner (service/batch.py) derives per-job
+    counterexample traces from a shared exploration through this.
+
+    governor: a pre-built :class:`ResourceGovernor` to use instead of the
+    env-derived one — the serving daemon's per-TENANT budget instances
+    (service/scheduler.py); a breach inside this check raises the same
+    typed ResourceExhausted without touching any other job's budgets.
+
     disk_budget: byte budget for the spill + checkpoint directories
     (resilience.resources.ResourceGovernor; KSPEC_DISK_BUDGET is the env
     twin, KSPEC_RSS_BUDGET / KSPEC_LEVEL_DEADLINE arm the RSS and
@@ -892,7 +1083,9 @@ def check(
     bit-identical to an uninterrupted run (tests/test_resources.py).
     """
     spec = model.spec
-    step_builder = _Step(model)
+    if prepared is not None and prepared.model is not model:
+        raise ValueError("prepared kernels wrap a different model object")
+    step_builder = prepared.step if prepared is not None else _Step(model)
     K, C = spec.num_lanes, step_builder.C
 
     # unified telemetry: run_id-stamped stats/spans/metrics when a run
@@ -1004,7 +1197,11 @@ def check(
             np.asarray(hi0),
             np.asarray(lo0),
             min_cap=_next_pow2(
-                max(_HASH_MIN_CAP, 4 * (visited_capacity_hint or 0))
+                max(
+                    _HASH_MIN_CAP,
+                    4 * (visited_capacity_hint
+                         or visited_capacity_exact or 0),
+                )
             ),
         )
         ht_claim = None
@@ -1016,11 +1213,17 @@ def check(
     else:
         order = np.lexsort((np.asarray(lo0), np.asarray(hi0)))
         chunk_clamped = _next_pow2(max(min_bucket, chunk_size))
+        # hint: ~state count, padded with one chunk's worth of insert
+        # headroom so the growth check never fires on a roughly-known run.
+        # exact: a capacity floor (a prior run's FINAL vcap) used
+        # verbatim, so warm serving runs land on the exact same capacity —
+        # same step-cache keys, zero recompiles (PreparedKernels)
         vcap = _next_pow2(
             max(
                 n0,
                 min_bucket * C,
                 2,
+                visited_capacity_exact or 0,
                 (visited_capacity_hint + chunk_clamped * C)
                 if visited_capacity_hint
                 else 0,
@@ -1035,7 +1238,10 @@ def check(
 
     levels = [n0]
     total = n0
-    trace_store = []  # per level: (packed[np], parent[np], act[np])
+    # per level: (packed[np], parent[np], act[np]); aliased to the
+    # caller's list when collect_trace is given (service/batch.py)
+    trace_store = collect_trace if collect_trace is not None else []
+    trace_store.clear()
     if store_trace:
         trace_store.append((init_packed, np.full(n0, -1), np.full(n0, -1)))
     if collect_levels is not None:
@@ -1223,12 +1429,15 @@ def check(
 
     # Resource governance (resilience.resources): disk/RSS budgets + the
     # per-level deadline watchdog, with soft-breach reclamation and a
-    # typed checkpoint-then-clean-exit on hard breach
-    governor = ResourceGovernor.from_env(
-        disk_budget=disk_budget,
-        watch_dirs=[disk.dir if disk is not None else None, checkpoint_dir],
-        fault_plan=fault,
-    )
+    # typed checkpoint-then-clean-exit on hard breach.  A caller-supplied
+    # governor (the serving daemon's per-tenant instances) takes
+    # precedence over the env-derived one
+    if governor is None:
+        governor = ResourceGovernor.from_env(
+            disk_budget=disk_budget,
+            watch_dirs=[disk.dir if disk is not None else None, checkpoint_dir],
+            fault_plan=fault,
+        )
 
     def _final_save():
         # checkpoint-then-clean-exit: persist the just-completed level

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Optional
 
 from ..resilience.heartbeat import heartbeat_record
@@ -49,51 +50,59 @@ class MetricsRegistry:
         self.counters: dict = {}
         self.gauges: dict = {}
         self.hists: dict = {}  # name -> {buckets, counts[], sum, count}
+        # one registry may be updated from concurrent in-process jobs (the
+        # serving daemon): read-modify-write counters and histogram cells
+        # would otherwise drop increments under the interleaving
+        self._lock = threading.Lock()
 
     # --- instruments ------------------------------------------------------
     def inc(self, name: str, value=1, **labels) -> None:
         k = _key(name, labels)
-        self.counters[k] = self.counters.get(k, 0) + value
+        with self._lock:
+            self.counters[k] = self.counters.get(k, 0) + value
 
     def set_gauge(self, name: str, value, **labels) -> None:
-        self.gauges[_key(name, labels)] = value
+        with self._lock:
+            self.gauges[_key(name, labels)] = value
 
     def observe(self, name: str, value, buckets=DEFAULT_MS_BUCKETS) -> None:
-        h = self.hists.get(name)
-        if h is None:
-            h = self.hists[name] = {
-                "buckets": list(buckets),
-                "counts": [0] * (len(buckets) + 1),
-                "sum": 0.0,
-                "count": 0,
-            }
-        i = 0
-        for i, b in enumerate(h["buckets"]):
-            if value <= b:
-                break
-        else:
-            i = len(h["buckets"])
-        h["counts"][i] += 1
-        h["sum"] += value
-        h["count"] += 1
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = {
+                    "buckets": list(buckets),
+                    "counts": [0] * (len(buckets) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            i = 0
+            for i, b in enumerate(h["buckets"]):
+                if value <= b:
+                    break
+            else:
+                i = len(h["buckets"])
+            h["counts"][i] += 1
+            h["sum"] += value
+            h["count"] += 1
 
     # --- export -----------------------------------------------------------
     def snapshot(self) -> dict:
-        return {
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "histograms": {
-                n: {
-                    "sum": round(h["sum"], 3),
-                    "count": h["count"],
-                    "buckets": dict(
-                        zip([str(b) for b in h["buckets"]] + ["+Inf"],
-                            _cum(h["counts"]))
-                    ),
-                }
-                for n, h in self.hists.items()
-            },
-        }
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {
+                    n: {
+                        "sum": round(h["sum"], 3),
+                        "count": h["count"],
+                        "buckets": dict(
+                            zip([str(b) for b in h["buckets"]] + ["+Inf"],
+                                _cum(h["counts"]))
+                        ),
+                    }
+                    for n, h in self.hists.items()
+                },
+            }
 
     def write_jsonl(self, path: str) -> None:
         rec = heartbeat_record("metrics", run_id=self.run_id,
@@ -105,6 +114,18 @@ class MetricsRegistry:
         """Atomic Prometheus textfile export (tmp + rename: a scraper
         re-reading the path mid-write never sees a torn file)."""
         rid = f'run_id="{self.run_id}"'
+        with self._lock:  # consistent copies: no size-change mid-iteration
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hists = {
+                n: {
+                    "buckets": list(h["buckets"]),
+                    "counts": list(h["counts"]),
+                    "sum": h["sum"],
+                    "count": h["count"],
+                }
+                for n, h in self.hists.items()
+            }
 
         def sample(key, value):
             # merge the run_id label into an existing {labels} suffix
@@ -121,14 +142,14 @@ class MetricsRegistry:
                 seen_types.add(base)
                 lines.append(f"# TYPE {base} {mtype}")
 
-        for k in sorted(self.counters):
+        for k in sorted(counters):
             type_line(k, "counter")
-            lines.append(sample(k, self.counters[k]))
-        for k in sorted(self.gauges):
+            lines.append(sample(k, counters[k]))
+        for k in sorted(gauges):
             type_line(k, "gauge")
-            lines.append(sample(k, self.gauges[k]))
-        for n in sorted(self.hists):
-            h = self.hists[n]
+            lines.append(sample(k, gauges[k]))
+        for n in sorted(hists):
+            h = hists[n]
             type_line(n, "histogram")
             for le, c in zip([str(b) for b in h["buckets"]] + ["+Inf"],
                              _cum(h["counts"])):
@@ -139,7 +160,9 @@ class MetricsRegistry:
         with open(tmp, "w") as fh:
             fh.write("\n".join(lines) + "\n")
             fh.flush()
-            os.fsync(fh.fileno())
+        # atomicity (the scraper's guarantee) comes from the rename; no
+        # fsync — a scrape artifact needs no power-loss durability, and
+        # the serving daemon exports per verdict (bench.py --serve)
         os.replace(tmp, path)
 
 
@@ -152,23 +175,27 @@ def _cum(counts):
 
 
 # --- module-level current registry (deep call sites, zero plumbing) -------
-_current: Optional[MetricsRegistry] = None
+#
+# Thread-LOCAL like the tracer's current (obs/tracer.py): concurrent
+# in-process jobs each activate their own registry without cross-stamping.
+_active = threading.local()
 
 
 def set_registry(reg: Optional[MetricsRegistry]) -> None:
-    global _current
-    _current = reg
+    _active.registry = reg
 
 
 def current_registry() -> Optional[MetricsRegistry]:
-    return _current
+    return getattr(_active, "registry", None)
 
 
 def inc(name: str, value=1, **labels) -> None:
-    if _current is not None:
-        _current.inc(name, value, **labels)
+    reg = current_registry()
+    if reg is not None:
+        reg.inc(name, value, **labels)
 
 
 def set_gauge(name: str, value, **labels) -> None:
-    if _current is not None:
-        _current.set_gauge(name, value, **labels)
+    reg = current_registry()
+    if reg is not None:
+        reg.set_gauge(name, value, **labels)

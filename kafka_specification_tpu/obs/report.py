@@ -376,6 +376,101 @@ def _resource_pressure(data: dict) -> dict:
     return out
 
 
+def _last_level_record(stats_path: str, tail_bytes: int = 65536) -> dict:
+    """Last "level" record of a stats.jsonl, reading only a bounded tail
+    of the file — the run index must stay O(runs), not O(levels), and a
+    long run's stats stream is thousands of lines.  The first line of the
+    tail window may be torn by the seek (and the writer may have torn the
+    final line mid-crash); both parse-fail and are skipped."""
+    try:
+        with open(stats_path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - tail_bytes))
+            lines = fh.read().splitlines()
+    except OSError:
+        return {}
+    for raw in reversed(lines):
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and rec.get("kind") == "level":
+            return rec
+    return {}
+
+
+def list_runs(root: str, limit: int = 20) -> list:
+    """Index the run directories under `root`, newest first — the
+    operator's ls once a serving daemon multiplies run dirs.  Each row is
+    built from the manifest + last stats line only (no full report load:
+    the index must stay O(runs), not O(levels))."""
+    rows = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return rows
+    for name in names:
+        d = os.path.join(root, name)
+        man_path = os.path.join(d, "manifest.json")
+        if not os.path.isfile(man_path):
+            continue
+        try:
+            with open(man_path) as fh:
+                man = json.load(fh)
+        except (OSError, ValueError):
+            man = {}
+        cfg = man.get("config") or {}
+        result = man.get("result") or {}
+        last_level = _last_level_record(os.path.join(d, "stats.jsonl"))
+        status = man.get("status", "?")
+        if status == "running":
+            # refine cheaply: a dead pid means crashed, not live
+            if _pid_alive(man.get("pid")) is False:
+                status = "crashed"
+        try:
+            mtime = os.path.getmtime(man_path)
+        except OSError:
+            mtime = 0
+        rows.append({
+            "run_id": man.get("run_id") or name,
+            "dir": d,
+            "status": status,
+            "module": cfg.get("module") or cfg.get("model"),
+            "engine": cfg.get("engine"),
+            "service": (cfg.get("service") or {}).get("job_id"),
+            "states": result.get("distinct_states")
+            or last_level.get("total"),
+            "states_per_sec": result.get("states_per_sec"),
+            "depth": result.get("diameter") or last_level.get("depth"),
+            "created": man.get("created"),
+            "mtime": mtime,
+        })
+    rows.sort(key=lambda r: r["mtime"], reverse=True)
+    return rows[:limit]
+
+
+def render_run_index(root: str, rows: list) -> str:
+    if not rows:
+        return f"no runs under {root}"
+    out = [f"Runs under {root} ({len(rows)} most recent):"]
+    out.append(
+        f"  {'run_id':<28} {'status':<12} {'module':<22} "
+        f"{'states':>12} {'k/s':>8}  job"
+    )
+    for r in rows:
+        sps = r.get("states_per_sec")
+        out.append(
+            f"  {str(r['run_id'])[:28]:<28} {str(r['status'])[:12]:<12} "
+            f"{str(r.get('module') or '?')[:22]:<22} "
+            f"{r.get('states') if r.get('states') is not None else '?':>12} "
+            f"{(sps / 1e3 if sps else 0.0):>8.1f}  "
+            f"{r.get('service') or ''}"
+        )
+    out.append("  (render one with `cli report <dir>` or `--latest`)")
+    return "\n".join(out)
+
+
 def _fmt_bytes(n) -> str:
     if n is None:
         return "?"
@@ -395,6 +490,30 @@ def render_report(run_dir: str, now: Optional[float] = None,
     out = []
     v = r["verdict"]
     out.append(f"Run {r['run_id']}  [{v['status'].upper()}]")
+    svc = cfg.get("service") or {}
+    if svc:
+        # checking-as-a-service run: which job/tenant this run served and
+        # whether it rode the warm compile cache / a batched group
+        out.append(
+            "  service: job "
+            + str(svc.get("job_id", "?"))
+            + f"  tenant {svc.get('tenant', '?')}"
+            + (
+                f"  batched x{svc['group_size']}"
+                if svc.get("group_size", 1) > 1
+                else ""
+            )
+            + (
+                "  compile-cache HIT"
+                if svc.get("cache_hit")
+                else "  compile-cache miss (cold shape)"
+            )
+            + (
+                f"  leader run {svc['leader_run_id']}"
+                if svc.get("leader_run_id")
+                else ""
+            )
+        )
     bits = [
         f"module={cfg.get('module') or cfg.get('model') or '?'}",
         f"engine={cfg.get('engine', '?')}",

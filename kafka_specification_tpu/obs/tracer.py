@@ -40,6 +40,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -107,28 +108,48 @@ class SpanTracer:
         self.run_id = run_id
         self._fd = None
         self._seq = 0
-        self._stack: list = []  # open span ids (nesting)
+        # one tracer may be shared by concurrent in-process jobs (the
+        # serving daemon's worker threads): the fd open / seq allocation /
+        # close races are guarded here, and each record is a SINGLE
+        # os.write on the O_APPEND fd — lines interleave whole, never torn.
+        # The NESTING stack is per-thread (not merely locked): a shared
+        # stack would attribute thread A's span to thread B's open parent,
+        # which is nesting that never happened
+        self._lock = threading.Lock()
+        self._tls = threading.local()
         self._xprof = parse_xprof(os.environ.get(XPROF_ENV))
         self._xprof_dir = os.path.join(os.path.dirname(path), "xprof")
         self._xprof_live = False
 
     # --- untearable append ------------------------------------------------
     def _write(self, rec: dict) -> None:
-        if self._fd is None:
-            self._fd = os.open(
-                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
-            )
-        os.write(self._fd, (json.dumps(rec) + "\n").encode())
+        payload = (json.dumps(rec) + "\n").encode()
+        with self._lock:
+            if self._fd is None:
+                self._fd = os.open(
+                    self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+                )
+            os.write(self._fd, payload)
 
     def close(self) -> None:
-        if self._fd is not None:
-            os.close(self._fd)
-            self._fd = None
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
 
     # --- span protocol ----------------------------------------------------
+    @property
+    def _stack(self) -> list:
+        """This thread's open-span-id stack (parent attribution)."""
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
     def _next_id(self) -> int:
-        self._seq += 1
-        return self._seq
+        with self._lock:
+            self._seq += 1
+            return self._seq
 
     def _enter(self, kind: str, attrs: dict):
         span_id = self._next_id()
@@ -255,27 +276,34 @@ class SpanTracer:
 
 
 # --- module-level current tracer (deep call sites, zero plumbing) ---------
-_current: Optional[SpanTracer] = None
+#
+# Thread-LOCAL, not process-global: the serving daemon (service/daemon.py)
+# runs multiple jobs in one process, each with its own RunContext — a
+# global would let job B's activate() cross-stamp job A's spans with B's
+# run_id.  Each thread sees only the tracer it activated; single-threaded
+# callers (the CLI engines) behave exactly as before.
+_active = threading.local()
 
 
 def set_tracer(tracer: Optional[SpanTracer]) -> None:
-    global _current
-    _current = tracer
+    _active.tracer = tracer
 
 
 def current_tracer() -> Optional[SpanTracer]:
-    return _current
+    return getattr(_active, "tracer", None)
 
 
 def span(kind: str, **attrs):
     """Span context manager on the active tracer; no-op when none."""
-    return _current.span(kind, **attrs) if _current is not None else _NULL_CM
+    cur = current_tracer()
+    return cur.span(kind, **attrs) if cur is not None else _NULL_CM
 
 
 def event(kind: str, **attrs) -> None:
     """Point event on the active tracer; no-op when none."""
-    if _current is not None:
-        _current.event(kind, **attrs)
+    cur = current_tracer()
+    if cur is not None:
+        cur.event(kind, **attrs)
 
 
 def read_jsonl_tolerant(path: str) -> list:

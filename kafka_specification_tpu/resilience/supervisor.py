@@ -247,6 +247,44 @@ def supervise(cfg: SupervisorConfig) -> int:
         file=sys.stderr,
     )
     return rc if rc not in (0, None) else 1
+# --- serving-daemon supervision (`cli serve --supervised`) -----------------
+
+
+def daemon_supervisor_config(
+    service_dir: str,
+    cmd: list,
+    stall_timeout: float = 120.0,
+    max_restarts: int = 8,
+    env: Optional[dict] = None,
+) -> SupervisorConfig:
+    """SupervisorConfig for the checking-as-a-service daemon
+    (service/daemon.py): the daemon appends one heartbeat line per poll
+    tick to ``<service-dir>/service/heartbeat.jsonl`` even when idle, so a
+    wedged accelerator (the failure mode that motivated the whole
+    supervision stack) stalls the heartbeat and earns the same kill +
+    bounded-backoff restart as an engine run.  A restarted daemon re-claims
+    the queue's orphaned ``claimed/`` jobs on startup (service/queue.py),
+    so in-flight work survives the bounce.  The default stall timeout is
+    minutes, not the engine's half-hour: an idle daemon heartbeats every
+    poll interval, so silence means wedged, not busy.
+
+    The daemon's RESOURCE_EXHAUSTED handling is per-JOB (a breaching job
+    exits typed inside the daemon; the daemon itself exits 0/1), so the
+    supervisor's rc-75 halt policy only triggers if the daemon process
+    itself dies typed — which it never does in normal operation."""
+    svc = os.path.join(service_dir, "service")
+    os.makedirs(svc, exist_ok=True)
+    return SupervisorConfig(
+        cmd=cmd,
+        heartbeat=os.path.join(svc, "heartbeat.jsonl"),
+        events=os.path.join(svc, "events.jsonl"),
+        log_dir=os.path.join(svc, "logs"),
+        stall_timeout=stall_timeout,
+        max_restarts=max_restarts,
+        env=dict(env if env is not None else os.environ),
+    )
+
+
 # --- fleet supervision (the multi-process jax.distributed regime) --------
 #
 # A pod-scale sharded run is P cooperating processes in one

@@ -1,0 +1,647 @@
+"""The serving daemon: ``cli serve`` — a warm, multi-tenant check runner.
+
+One process imports jax ONCE, then drains the durable job queue forever:
+
+    claim pending jobs -> plan groups (scheduler) -> for each group:
+        kernel-cache lookup (shape-keyed model + prepared jitted steps)
+        one engine run (batched: one exploration serves the whole group)
+        per-job verdict files + per-job obs run dirs (PR 3 treatment)
+
+Tenancy: each run executes under the job's tenant's ResourceGovernor
+(tenants.json budgets).  A budget breach raises the engine's typed
+ResourceExhausted INSIDE the job — the daemon writes that job an rc-75
+verdict and keeps serving; sibling jobs and the daemon itself never see
+it.  Any other per-job exception becomes an error verdict (exit_code 2)
+the same way: one tenant's bad config cannot take the service down.
+
+Liveness: the daemon appends heartbeat lines to
+``service/heartbeat.jsonl`` — every few seconds when idle (size-rotated
+so a serve-forever daemon stays bounded), and from a background thread
+while the main thread is inside a long engine run, so the supervisor's
+stall detector (``cli serve --supervised``;
+resilience.supervisor.daemon_supervisor_config) kills wedged daemons,
+never merely busy ones.
+Queue depth, cache hit/miss, batch sizes and submit->verdict latency are
+exported to ``service/metrics.prom`` for scraping.
+
+Shutdown: SIGTERM/SIGINT finish the in-flight group, then exit 0; claims
+of a killed daemon are re-queued by the next daemon's startup janitor.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..engine.bfs import check
+from ..obs import RunContext
+from ..obs.metrics import MetricsRegistry
+from ..resilience.faults import FaultPlan
+from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..resilience.resources import ResourceExhausted
+from .batch import Member, derive_member, explore_shared
+from .kernel_cache import (
+    KernelCache,
+    job_cfg,
+    job_invariants,
+    resolve_kernel_source,
+)
+from .queue import JobQueue
+from .scheduler import TenantPolicy, plan_groups, union_invariants
+from .verdict import (
+    EXIT_RESOURCE,
+    error_verdict,
+    verdict_from_result,
+)
+
+# Idle heartbeat/export cadence.  The supervisor's stall detector only
+# needs the heartbeat file to change within --stall-timeout (default
+# 120s); ticking every poll interval (0.2s) would append ~432k lines/day
+# to an IDLE serve-forever daemon for no extra liveness.
+_IDLE_TICK_S = 5.0
+# While a group is EXECUTING the main thread is inside the engine for
+# arbitrarily long (a cold first job of a big shape is minutes of model
+# build + compile), so a background thread keeps the heartbeat moving —
+# otherwise --supervised would stall-kill a merely-busy daemon mid-job,
+# requeue the claim, and kill the identical cold re-run forever.
+_BUSY_HEARTBEAT_S = 5.0
+# Rotation bound for heartbeat.jsonl: a serve-forever daemon must not
+# grow it without limit.  Shrinking is safe — the stall detector treats
+# ANY size change as progress (supervisor._run_attempt).
+_HEARTBEAT_MAX_BYTES = 2 << 20
+_HEARTBEAT_KEEP_LINES = 500
+
+
+@dataclass
+class ServeConfig:
+    service_dir: str
+    poll_s: float = 0.2
+    linger_s: float = 0.05  # second claim sweep so a burst coalesces
+    max_jobs: Optional[int] = None  # exit after N verdicts (bench/tests)
+    idle_exit_s: Optional[float] = None  # exit after this long idle
+    min_bucket: int = 256
+    chunk_size: int = 32768
+    visited_backend: str = "device"
+    cache_entries: int = 32
+    batching: bool = True
+
+
+class Daemon:
+    def __init__(self, cfg: ServeConfig):
+        self.cfg = cfg
+        self.queue = JobQueue(cfg.service_dir)
+        self.policy = TenantPolicy(self.queue.tenants_path)
+        self.cache = KernelCache(max_entries=cfg.cache_entries)
+        os.makedirs(self.queue.service_dir, exist_ok=True)
+        self.heartbeat_path = os.path.join(
+            self.queue.service_dir, "heartbeat.jsonl"
+        )
+        self.events_path = os.path.join(
+            self.queue.service_dir, "events.jsonl"
+        )
+        self.metrics = MetricsRegistry(run_id="service")
+        self.jobs_done = 0
+        self.groups_run = 0
+        self._stop = False
+        self._last_work = time.monotonic()
+        self._last_tick = 0.0
+        # busy-heartbeat plumbing: the job ids of the group the main
+        # thread is currently executing (None = idle), and the event that
+        # shuts the heartbeat thread down with the daemon
+        self._busy_jobs: Optional[list] = None
+        self._hb_stop = threading.Event()
+        # both the main thread (_tick) and the busy-heartbeat thread write
+        # heartbeat.jsonl and may rotate it; unserialized, two rotations
+        # would interleave writes to the same .tmp and drop appends that
+        # land between a rotation's read and its publish
+        self._hb_lock = threading.Lock()
+
+    # --- lifecycle --------------------------------------------------------
+    def request_stop(self, *_a) -> None:
+        self._stop = True
+
+    def serve(self) -> int:
+        """Run until stop/idle-exit/max-jobs; returns a process exit code."""
+        old_term = signal.signal(signal.SIGTERM, self.request_stop)
+        old_int = signal.signal(signal.SIGINT, self.request_stop)
+        orphans = self.queue.requeue_orphans()
+        self._event("daemon-start", pid=os.getpid(), requeued=len(orphans))
+        print(
+            f"[serve] daemon up: dir={self.queue.dir} pid={os.getpid()}"
+            + (f" (requeued {len(orphans)} orphaned claims)" if orphans
+               else ""),
+            file=sys.stderr,
+        )
+        hb_thread = threading.Thread(
+            target=self._busy_heartbeat_loop, daemon=True
+        )
+        hb_thread.start()
+        try:
+            while not self._stop:
+                n = self.drain_once()
+                self._tick(worked=bool(n))
+                if n:
+                    self._last_work = time.monotonic()
+                else:
+                    if self.cfg.idle_exit_s is not None and (
+                        time.monotonic() - self._last_work
+                        > self.cfg.idle_exit_s
+                    ):
+                        self._event("daemon-idle-exit")
+                        break
+                    time.sleep(self.cfg.poll_s)
+                if (
+                    self.cfg.max_jobs is not None
+                    and self.jobs_done >= self.cfg.max_jobs
+                ):
+                    self._event("daemon-max-jobs", jobs=self.jobs_done)
+                    break
+        finally:
+            self._hb_stop.set()
+            hb_thread.join(timeout=2.0)
+            self._event("daemon-stop", jobs=self.jobs_done)
+            self._export_metrics(jsonl=True)
+            signal.signal(signal.SIGTERM, old_term)
+            signal.signal(signal.SIGINT, old_int)
+        return 0
+
+    # --- one queue sweep --------------------------------------------------
+    def drain_once(self) -> int:
+        """Claim everything pending (plus one linger sweep), run it
+        grouped.  Returns the number of verdicts written."""
+        claimed = self.queue.claim_pending()
+        if claimed and self.cfg.linger_s:
+            time.sleep(self.cfg.linger_s)  # let an in-flight burst land
+            claimed += self.queue.claim_pending()
+        if not claimed:
+            return 0
+        jobs = []
+        done = 0  # verdicts written this sweep — short-circuits and parse
+        # failures count too, or a stream of bad specs reads as "idle" to
+        # the idle-exit timer while the daemon is actively publishing
+        for spec in claimed:
+            prior = self.queue.result(spec["job_id"])
+            if prior is not None:
+                # requeued orphan that already published its verdict:
+                # retire the claim, never re-run (at-most-once
+                # visibility).  Routed through _finish_job so the
+                # published verdict counts toward --max-jobs, the
+                # jobs_done gauge and kspec_svc_jobs_total like any
+                # other — a controlled drain (serve --max-jobs N) must
+                # terminate on it, not serve forever past it
+                try:
+                    self._finish_job(spec, prior)
+                    done += 1
+                except Exception:  # noqa: BLE001 — verdict already durable
+                    pass
+                continue
+            try:
+                cfg = job_cfg(spec)
+                emitted = resolve_kernel_source(
+                    spec.get("kernel_source", "auto"), spec["module"]
+                )
+                jobs.append((spec, cfg, emitted))
+            except Exception as e:  # noqa: BLE001 — tenant input
+                done += self._fail_jobs([spec], f"cannot parse job cfg: {e}")
+        groups = plan_groups(jobs) if self.cfg.batching else [
+            [j] for j in jobs
+        ]
+        for group in groups:
+            try:
+                done += self._run_group(group)
+            finally:
+                # every exit path — normal, error-verdict returns, or an
+                # unexpected escape — must close the busy-heartbeat window
+                self._busy_jobs = None
+            if self._stop:
+                break
+        return done
+
+    # --- group execution --------------------------------------------------
+    def _run_group(self, group: list) -> int:
+        specs = [spec for spec, _c, _e in group]
+        leader_spec, leader_cfg, emitted = group[0]
+        tenant = leader_spec.get("tenant", "default")
+        # the busy-heartbeat window opens BEFORE the kernel-cache lookup:
+        # a cold miss runs build_model + prepare for minutes, and without
+        # a moving heartbeat --supervised would stall-kill the daemon
+        # mid-build, requeue the claim, and kill the identical re-build
+        # forever (drain_once clears this on every exit path)
+        self._busy_jobs = [s["job_id"] for s in specs]
+        # EVERY singleton group takes the real solo engine path — first-
+        # violation early exit, streamed levels (no collect_levels RAM),
+        # full check_deadlock semantics — still warm through the kernel
+        # cache; only groups of >= 2 pay the shared-exploration envelope.
+        # (solo_only additionally keeps deadlock/fault jobs out of groups
+        # at planning time — the post-hoc derivation cannot replay them.)
+        # This also makes --no-batching exactly what its help says: every
+        # group is a singleton, so every job runs real solo semantics.
+        solo = len(group) == 1
+        t0 = time.perf_counter()
+        try:
+            invs = (
+                job_invariants(leader_spec["module"], leader_cfg)
+                if solo else union_invariants(group)
+            )
+            members = [
+                Member(
+                    spec["job_id"],
+                    job_invariants(spec["module"], cfg),
+                    max_depth=spec.get("max_depth"),
+                    max_states=spec.get("max_states"),
+                )
+                for spec, cfg, _e in group
+            ]
+            entry = self.cache.get(
+                leader_spec["module"], leader_cfg, emitted, invs
+            )
+        except Exception as e:  # noqa: BLE001 — bad module/constants
+            return self._fail_jobs(specs, f"cannot build model: {e}")
+        self._cache_metrics(entry)
+        fault = leader_spec.get("fault")
+        leader_ctx = None
+        try:
+            # durable=False: a service run dir is pure observability — the
+            # queue's verdict file is the job's durable record, and the
+            # manifest fsyncs were the warm path's latency floor (~5/job)
+            leader_ctx = RunContext(
+                self.queue.run_dir(leader_spec["job_id"]), durable=False
+            )
+            leader_ctx.record_config(
+                module=leader_spec["module"],
+                engine="service",
+                service={
+                    "job_id": leader_spec["job_id"],
+                    "tenant": tenant,
+                    "group_size": len(group),
+                    "group_jobs": [s["job_id"] for s in specs],
+                    "cache_hit": entry["hit"],
+                },
+            )
+            # a tenant-budgeted governor replaces the engine's env-derived
+            # one wholesale, so the job's fault plan must ride in it —
+            # otherwise governor-level faults (stall@level) silently no-op
+            # for every budgeted tenant while working for unbudgeted ones
+            governor = self.policy.governor(
+                tenant,
+                watch_dirs=[leader_ctx.dir],
+                fault_plan=FaultPlan(fault) if fault else None,
+            )
+        except Exception as e:  # noqa: BLE001 — a malformed fault plan /
+            # unwritable run dir is THAT job's problem, not the daemon's:
+            # crashing here would strand the group in claimed/ and hot-loop
+            # the janitor-requeue -> identical-crash cycle
+            if leader_ctx is not None:
+                self._close_run(leader_ctx, "error", str(e))
+            return self._fail_jobs(specs, f"cannot start job: {e}")
+        old_fault = os.environ.get("KSPEC_FAULT")
+        if fault:
+            os.environ["KSPEC_FAULT"] = fault
+        try:
+            if solo:
+                shared = None
+                solo_res = check(
+                    entry["model"],
+                    max_depth=leader_spec.get("max_depth"),
+                    max_states=leader_spec.get("max_states"),
+                    store_trace=True,
+                    min_bucket=self.cfg.min_bucket,
+                    check_deadlock=leader_cfg.check_deadlock,
+                    chunk_size=self.cfg.chunk_size,
+                    visited_backend=self.cfg.visited_backend,
+                    prepared=entry["prepared"],
+                    run=leader_ctx,
+                    governor=governor,
+                    visited_capacity_exact=entry["prepared"].capacity_hint,
+                )
+                entry["prepared"].note_result(solo_res)
+            else:
+                shared = explore_shared(
+                    entry["model"],
+                    members,
+                    prepared=entry["prepared"],
+                    min_bucket=self.cfg.min_bucket,
+                    chunk_size=self.cfg.chunk_size,
+                    visited_backend=self.cfg.visited_backend,
+                    run=leader_ctx,
+                    governor=governor,
+                )
+        except ResourceExhausted as e:
+            # the engine's typed path already stamped the manifest
+            # 'resource-exhausted' and closed its observer; the deactivate
+            # here is a no-op belt for partial paths
+            self._close_run(leader_ctx, None)
+            self._event(
+                "job-resource-exhausted", tenant=tenant, reason=e.reason,
+                jobs=[s["job_id"] for s in specs],
+            )
+            n = 0
+            for spec in specs:
+                try:
+                    self._finish_job(
+                        spec,
+                        self._stamp(
+                            spec,
+                            error_verdict(
+                                f"RESOURCE_EXHAUSTED[{e.reason}]: "
+                                f"{e.detail}",
+                                run_id=leader_ctx.run_id,
+                                exit_code=EXIT_RESOURCE,
+                            ),
+                            status="resource-exhausted",
+                        ),
+                    )
+                    n += 1
+                except Exception:  # noqa: BLE001 — a second ENOSPC must
+                    pass  # not crash the daemon; the claim stays for the
+                    # next janitor
+            return n
+        except Exception as e:  # noqa: BLE001 — keep the daemon alive
+            # the engine does NOT close its observer on a generic raise:
+            # stamp + release here or every such failure leaks a tracer fd
+            self._close_run(leader_ctx, "error", str(e))
+            self._event(
+                "job-error", tenant=tenant, error=str(e)[:300],
+                jobs=[s["job_id"] for s in specs],
+            )
+            return self._fail_jobs(specs, f"engine failure: {e}")
+        finally:
+            if fault:
+                if old_fault is None:
+                    os.environ.pop("KSPEC_FAULT", None)
+                else:
+                    os.environ["KSPEC_FAULT"] = old_fault
+        n = self._publish_group(
+            group, members, specs, leader_spec, leader_ctx,
+            solo, solo_res if solo else None, shared, t0,
+        )
+        # a run that GREW the device visited set evicted the small-bucket
+        # steps the next run of this shape will need at the new capacity
+        # fixed point: re-compile them now — verdicts are already
+        # published, the busy-heartbeat window is still open, and no job
+        # is waiting on this — so the SECOND job of the shape shows zero
+        # compile spans even when the first had to grow
+        try:
+            warmed = entry["prepared"].rewarm()
+            if warmed:
+                self.metrics.inc("kspec_svc_rewarmed_steps_total", warmed)
+        except Exception as e:  # noqa: BLE001 — purely an optimization
+            self._event("rewarm-error", error=str(e)[:300])
+        return n
+
+    def _publish_group(self, group, members, specs, leader_spec,
+                       leader_ctx, solo, solo_res, shared, t0) -> int:
+        """Derive + publish every member's verdict.  Runs with
+        ``_busy_jobs`` still set (cleared by drain_once): derive_member
+        jit-compiles per-(invariant, level-bucket) predicates and walks
+        traces on the host, which on a cold big shape can outlast
+        ``--supervised``'s stall timeout — ending the busy-heartbeat
+        window at the engine's return would let the supervisor stall-kill
+        a merely-busy daemon mid-derive and requeue the group into an
+        identical kill loop."""
+        wall_s = time.perf_counter() - t0
+        self.groups_run += 1
+        self.metrics.inc("kspec_svc_groups_total")
+        if len(group) > 1:
+            self.metrics.inc("kspec_svc_batched_jobs_total", len(group))
+        for (spec, _cfg, _e), member in zip(group, members):
+            # per-member guard: a derivation/publication failure (a
+            # predicate erroring on a decoded state, an OSError on a
+            # member run dir) must cost THAT member an error verdict, not
+            # crash the daemon with the whole group stuck in claimed/ —
+            # the janitor would requeue it into an identical re-crash
+            try:
+                res = solo_res if solo else derive_member(shared, member)
+                rec = self._stamp(
+                    spec,
+                    verdict_from_result(res, run_id=leader_ctx.run_id),
+                    status="violation" if res.violation else "complete",
+                    wall_s=wall_s,
+                )
+                if len(group) > 1:
+                    rec["batch"] = {
+                        "group_size": len(group),
+                        "leader_run_id": leader_ctx.run_id,
+                    }
+                if spec is leader_spec:
+                    # the engine's RunObserver already finished the
+                    # manifest with the SHARED result; overwrite the
+                    # summary with the member's own derived verdict +
+                    # service metadata
+                    leader_ctx.finish(rec["status"], **_summary(rec))
+                else:
+                    ctx = RunContext(
+                        self.queue.run_dir(spec["job_id"]), durable=False
+                    )
+                    ctx.record_config(
+                        module=spec["module"],
+                        engine="service",
+                        service={
+                            "job_id": spec["job_id"],
+                            "tenant": spec.get("tenant", "default"),
+                            "group_size": len(group),
+                            "leader_run_id": leader_ctx.run_id,
+                            "cache_hit": True,  # rode the leader's kernels
+                        },
+                    )
+                    rec["run_id"] = ctx.run_id
+                    ctx.finish(rec["status"], **_summary(rec))
+                self._finish_job(spec, rec)
+            except Exception as e:  # noqa: BLE001 — keep the daemon alive
+                self._event(
+                    "job-error", tenant=spec.get("tenant", "default"),
+                    error=str(e)[:300], jobs=[spec["job_id"]],
+                )
+                try:
+                    self._fail_job(spec, f"verdict derivation failed: {e}")
+                except Exception:  # noqa: BLE001 — even the error verdict
+                    # failed (service dir unwritable): leave the job
+                    # claimed for the next daemon's janitor
+                    pass
+        return len(specs)
+
+    # --- helpers ----------------------------------------------------------
+    def _stamp(self, spec: dict, rec: dict, status: str,
+               wall_s: Optional[float] = None) -> dict:
+        now = time.time()
+        rec["job_id"] = spec["job_id"]
+        rec["tenant"] = spec.get("tenant", "default")
+        rec["status"] = status
+        sub = spec.get("submitted_unix")
+        claim = spec.get("claimed_unix")
+        rec["timing"] = {
+            "submitted_unix": sub,
+            "claimed_unix": claim,
+            "done_unix": round(now, 3),
+            "wait_s": round(claim - sub, 3) if sub and claim else None,
+            "wall_s": round(wall_s, 3) if wall_s is not None else None,
+            "latency_s": round(now - sub, 3) if sub else None,
+        }
+        if rec["timing"]["latency_s"] is not None:
+            self.metrics.observe(
+                "kspec_svc_latency_ms", rec["timing"]["latency_s"] * 1e3
+            )
+        return rec
+
+    def _finish_job(self, spec: dict, rec: dict) -> None:
+        self.queue.finish(spec["job_id"], rec)
+        self.jobs_done += 1
+        self.metrics.inc("kspec_svc_jobs_total", status=rec.get("status", "?"))
+
+    def _fail_job(self, spec: dict, message: str) -> None:
+        self._finish_job(
+            spec, self._stamp(spec, error_verdict(message), status="error")
+        )
+
+    def _fail_jobs(self, specs: list, message: str) -> int:
+        """Best-effort error verdicts; returns how many were written.  A
+        failure writing even the ERROR verdict (ENOSPC on the service
+        dir) must not crash the daemon into the janitor-requeue crash
+        loop — the job stays claimed for the next daemon's janitor."""
+        n = 0
+        for spec in specs:
+            try:
+                self._fail_job(spec, message)
+                n += 1
+            except Exception:  # noqa: BLE001
+                pass
+        return n
+
+    @staticmethod
+    def _close_run(ctx, status: Optional[str], error: Optional[str] = None):
+        """Best-effort terminal cleanup for a run dir whose engine died
+        outside the engine's own terminal paths (the engine finishes the
+        manifest and closes the tracer fd only on clean/typed exits): a
+        tenant repeatedly crashing the engine must not leak one tracer fd
+        per failure (EMFILE eventually takes every tenant down), and the
+        run index must not report the dir as 'running' forever under the
+        daemon's live pid.  status=None skips the manifest stamp (the
+        engine already wrote its own terminal status, e.g.
+        'resource-exhausted')."""
+        try:
+            if status is not None:
+                ctx.finish(status, **({"error": error[:300]} if error
+                                      else {}))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ctx.deactivate()  # idempotent: closed fd / cleared tracer ok
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _cache_metrics(self, entry: dict) -> None:
+        if entry["hit"]:
+            self.metrics.inc("kspec_svc_cache_hits_total")
+        else:
+            self.metrics.inc("kspec_svc_cache_misses_total")
+            self.metrics.observe(
+                "kspec_svc_model_build_ms", entry["build_s"] * 1e3
+            )
+
+    def _event(self, kind: str, **fields) -> None:
+        try:
+            append_jsonl(
+                self.events_path,
+                heartbeat_record("service", event=kind, **fields),
+            )
+        except OSError:
+            pass  # telemetry on a full disk must never take the daemon down
+
+    def _tick(self, worked: bool = False) -> None:
+        now = time.monotonic()
+        if not worked and now - self._last_tick < _IDLE_TICK_S:
+            return
+        self._last_tick = now
+        pending = self.queue.pending_count()
+        self.metrics.set_gauge("kspec_svc_queue_pending", pending)
+        self.metrics.set_gauge(
+            "kspec_svc_queue_claimed", self.queue.claimed_count()
+        )
+        self.metrics.set_gauge("kspec_svc_jobs_done", self.jobs_done)
+        self.metrics.set_gauge(
+            "kspec_svc_cache_entries", len(self.cache)
+        )
+        cs = self.cache.stats()
+        self.metrics.set_gauge("kspec_svc_cache_hit_rate", cs["hit_rate"])
+        self._heartbeat(pending=pending, cache=cs)
+        # metrics.jsonl is an append-only snapshot stream: writing it on
+        # every idle tick would grow without bound on a serve-forever
+        # daemon, so snapshots land only when work happened (plus the
+        # terminal export); metrics.prom is an atomic replace of constant
+        # size and stays fresh every tick
+        self._export_metrics(jsonl=worked)
+
+    def _heartbeat(self, **fields) -> None:
+        with self._hb_lock:
+            try:
+                append_jsonl(
+                    self.heartbeat_path,
+                    heartbeat_record(
+                        "service-heartbeat",
+                        pid=os.getpid(),
+                        jobs_done=self.jobs_done,
+                        **fields,
+                    ),
+                )
+            except OSError:
+                pass  # liveness writes must never take the daemon down
+            self._rotate_heartbeat()
+
+    def _rotate_heartbeat(self) -> None:
+        """Bound heartbeat.jsonl: keep the newest lines once it outgrows
+        the cap (atomic replace; any size CHANGE reads as liveness to the
+        supervisor's stall detector, shrink included)."""
+        try:
+            if os.path.getsize(self.heartbeat_path) <= _HEARTBEAT_MAX_BYTES:
+                return
+            with open(self.heartbeat_path) as fh:
+                tail = fh.readlines()[-_HEARTBEAT_KEEP_LINES:]
+            tmp = self.heartbeat_path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.writelines(tail)
+            os.replace(tmp, self.heartbeat_path)
+        except OSError:
+            pass  # rotation must never take the daemon down
+
+    def _busy_heartbeat_loop(self) -> None:
+        """Background thread: keep the heartbeat moving while the main
+        thread is inside a long engine run (model build + compile can be
+        minutes), so --supervised never stall-kills a busy daemon."""
+        while not self._hb_stop.wait(_BUSY_HEARTBEAT_S):
+            jobs = self._busy_jobs
+            if jobs is not None:
+                self._heartbeat(busy=True, jobs=jobs)
+
+    def _export_metrics(self, jsonl: bool = False) -> None:
+        svc = self.queue.service_dir
+        try:
+            if jsonl:
+                self.metrics.write_jsonl(os.path.join(svc, "metrics.jsonl"))
+            self.metrics.write_prom(os.path.join(svc, "metrics.prom"))
+        except OSError:
+            pass  # metrics export must never take the daemon down
+
+
+def _summary(rec: dict) -> dict:
+    """Manifest result summary from a verdict record."""
+    out = {
+        k: rec.get(k)
+        for k in ("model", "distinct_states", "diameter", "seconds",
+                  "states_per_sec", "exit_code")
+    }
+    if rec.get("violation"):
+        out["violation"] = rec["violation"]
+    if rec.get("error"):
+        out["error"] = rec["error"]
+    if rec.get("batch"):
+        out["batch"] = rec["batch"]
+    return out
+
+
+def serve(cfg: ServeConfig) -> int:
+    return Daemon(cfg).serve()

@@ -1,0 +1,162 @@
+"""Shape-keyed compile cache: the warm heart of the serving daemon.
+
+A cold ``cli check`` pays ~2 minutes of jax import + reference parse +
+model build + trace/XLA-compile for seconds of actual checking (TODO.md).
+The daemon pays each of those exactly once per *schema shape* and then
+serves every later job of that shape warm, following the compiler-first
+portable-cache design of arXiv:2603.09555 (PAPERS.md): make compilation a
+keyed artifact, look it up in O(1).
+
+The key: in this corpus a model's tensor schema (ops/packing.StateSpec —
+field names, shapes, bounds, lane packing) and its compiled step programs
+are a pure function of ``(module, kernel source, constants)``; the
+invariant selection adds/removes predicate kernels AND fixes the
+first-violation order, so it keys too — ORDERED.  Two .cfg files with
+the same semantic content — regardless of path, comments, or formatting
+— therefore hit the same cache line.  One consequence: a schema shape
+served both solo (cfg-order invariants) and batched (sorted-union
+invariants) holds up to two cache lines when those orders differ —
+first-violation semantics genuinely depend on the model's invariant
+order, so the lines cannot be merged without a model/invariant-overlay
+split (ROADMAP notes this as open); the LRU bounds the cost.  Engine knobs (bucket
+floor, chunk size, visited backend) select among the per-model compiled
+step variants and ride in the GROUP key (scheduler), not here: the
+expensive artifact, the built Model with its jitted-step cache, is shared
+across knob settings.
+
+What a cache line holds: the built :class:`~..models.base.Model` plus its
+:class:`~..engine.bfs.PreparedKernels`.  The Model object carries the
+jitted-step cache (``_step_cache``), so a hit skips model build AND every
+step trace/compile — the engine then emits zero ``compile`` spans into
+the job's trace, which is the warm path's observable proof
+(docs/service.md).
+
+Not jax-free (building models touches jax): imported only by the daemon,
+never by the client commands.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..utils.cfg import (
+    TlcConfig,
+    build_model,
+    parse_cfg,
+    resolved_invariants,
+)
+
+
+def canonical_constants(constants: dict) -> tuple:
+    """Hashable canonical form of a .cfg's CONSTANTS block."""
+    return tuple(
+        (k, tuple(v) if isinstance(v, list) else v)
+        for k, v in sorted(constants.items())
+    )
+
+
+def resolve_kernel_source(kernel_source: str, module: str) -> bool:
+    """'auto'|'emitted'|'hand' -> emitted? — same resolution as the CLI
+    (`auto` = emitted iff the reference checkout has the module)."""
+    if kernel_source == "emitted":
+        return True
+    if kernel_source == "hand":
+        return False
+    from ..models.emitted import ref_path
+
+    return (ref_path() / f"{module}.tla").exists()
+
+
+def shape_key(module: str, cfg: TlcConfig, emitted: bool,
+              invariants: tuple) -> tuple:
+    """The compile-cache key (see module docstring for why these and only
+    these fields determine the compiled artifact)."""
+    return (
+        module,
+        bool(emitted),
+        canonical_constants(cfg.constants),
+        tuple(invariants),
+        tuple(cfg.constraints),
+        bool(cfg.check_deadlock),
+    )
+
+
+class KernelCache:
+    """In-process cache of built models + prepared engine kernels, keyed
+    by schema shape.  Bounded LRU (``max_entries``): compiled programs are
+    tens of MB of host memory each on big models, and a long-lived daemon
+    must not grow without bound across every shape it has ever seen."""
+
+    def __init__(self, max_entries: int = 32):
+        self.max_entries = max_entries
+        self._entries: dict = {}  # key -> entry dict
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, module: str, cfg: TlcConfig, emitted: bool,
+            invariants: tuple) -> dict:
+        """-> {model, prepared, key, hit, build_s}; builds on miss."""
+        from ..engine.bfs import prepare
+
+        key = shape_key(module, cfg, emitted, invariants)
+        entry = self._entries.get(key)
+        if entry is not None:
+            self.hits += 1
+            entry["last_used"] = time.time()
+            entry["uses"] += 1
+            return {**entry, "hit": True}
+        self.misses += 1
+        t0 = time.perf_counter()
+        build_cfg = TlcConfig(
+            constants=dict(cfg.constants),
+            invariants=list(invariants),
+            constraints=list(cfg.constraints),
+            specification=cfg.specification,
+            check_deadlock=cfg.check_deadlock,
+        )
+        model = build_model(module, build_cfg, emitted=emitted)
+        entry = {
+            "key": key,
+            "model": model,
+            "prepared": prepare(model),
+            "build_s": round(time.perf_counter() - t0, 3),
+            "last_used": time.time(),
+            "uses": 1,
+        }
+        self._entries[key] = entry
+        if len(self._entries) > self.max_entries:
+            lru = min(self._entries.values(), key=lambda e: e["last_used"])
+            del self._entries[lru["key"]]
+            self.evictions += 1
+        return {**entry, "hit": False}
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "hit_rate": round(
+                self.hits / max(1, self.hits + self.misses), 4
+            ),
+        }
+
+
+def job_cfg(spec: dict) -> TlcConfig:
+    """Parse a job spec's inline .cfg text."""
+    cfg = parse_cfg(spec["cfg_text"])
+    return cfg
+
+
+def job_invariants(module: str, cfg: TlcConfig) -> tuple:
+    """The invariant names, in model order, that a solo ``cli check`` of
+    this job would build and check.  Delegates to build_model's own
+    resolution (utils.cfg.resolved_invariants) so the batched replay
+    (service/batch.py) can never drift from the solo path; an unknown
+    module raises KeyError loudly, same as build_model."""
+    return resolved_invariants(module, cfg)

@@ -1,0 +1,332 @@
+"""Durable on-disk job queue: the tenants' side of checking-as-a-service.
+
+Layout under one service directory (``--service-dir`` /
+``$KSPEC_SERVICE_DIR``)::
+
+    <svc>/
+      queue/pending/<job_id>.json   submitted, waiting for the daemon
+      queue/claimed/<job_id>.json   claimed by a live daemon (in flight)
+      queue/done/<job_id>.json      terminal (spec retained for audit)
+      queue/by-tenant/<digest>/<job_id>   empty admission-index markers
+      results/<job_id>.json         the kspec-verdict/1 record
+      runs/<job_id>/                per-job obs run directory (PR 3)
+      service/                      daemon heartbeat/metrics/events/logs
+      tenants.json                  per-tenant budgets (resilience.resources)
+
+Every transition is a single atomic filesystem operation — submit is
+tmp-write + ``os.rename`` into ``pending/``, claim and finish are
+``os.rename`` between state directories, the verdict is tmp-write +
+rename — so a crash at any instant leaves each job in exactly one state
+and never publishes a torn spec or verdict.  A daemon that died mid-job
+leaves its claims in ``claimed/``; the next daemon's startup janitor
+(:meth:`JobQueue.requeue_orphans`) moves them back to ``pending/`` (job
+execution is idempotent: nothing is committed until the verdict rename).
+
+Job spec (``kspec-job/1``)::
+
+    {"schema": "kspec-job/1", "job_id": ..., "tenant": ...,
+     "module": ..., "cfg_text": "<inline TLC .cfg>", "cfg_path": ...,
+     "kernel_source": "auto"|"emitted"|"hand",
+     "max_depth": null|int, "max_states": null|int,
+     "submitted_unix": <float>, "fault": null|"<KSPEC_FAULT plan>"}
+
+The .cfg travels INLINE (the client reads the file at submit time): the
+daemon never depends on the tenant's filesystem, and the job file is the
+complete, self-contained unit of work.
+
+Must stay jax-free: ``cli submit/status/result`` run on client boxes that
+never pay the jax import (the whole point of the service).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+# one shared tmp-write+fsync+replace idiom (jax-free like this module);
+# job/result paths are unique per writer so the fixed .tmp suffix is safe
+from ..obs.runctx import _atomic_write_json
+
+JOB_SCHEMA = "kspec-job/1"
+
+PENDING = "pending"
+CLAIMED = "claimed"
+DONE = "done"
+
+
+def new_job_id() -> str:
+    """Sortable, collision-resistant without coordination (same recipe as
+    obs run ids, distinct prefix so job and run ids never read alike)."""
+    return "job-{}-{}-{}".format(
+        time.strftime("%Y%m%dT%H%M%S", time.gmtime()),
+        os.getpid(),
+        os.urandom(3).hex(),
+    )
+
+
+class JobQueue:
+    """One service directory's queue; safe for many concurrent submitters
+    and one daemon (claims are renames: first mover wins, losers skip)."""
+
+    def __init__(self, service_dir: str, create: bool = True):
+        """create=False opens read-only (``cli status``/``result``): a
+        mistyped --service-dir must raise, not silently fabricate an
+        empty service tree that masks the typo as 'no such job'."""
+        self.dir = os.path.normpath(service_dir)
+        self.queue_dir = os.path.join(self.dir, "queue")
+        self.results_dir = os.path.join(self.dir, "results")
+        self.runs_dir = os.path.join(self.dir, "runs")
+        self.service_dir = os.path.join(self.dir, "service")
+        self.tenants_path = os.path.join(self.dir, "tenants.json")
+        self.tenant_index_dir = os.path.join(self.queue_dir, "by-tenant")
+        if create:
+            for state in (PENDING, CLAIMED, DONE):
+                os.makedirs(
+                    os.path.join(self.queue_dir, state), exist_ok=True
+                )
+            os.makedirs(self.tenant_index_dir, exist_ok=True)
+            os.makedirs(self.results_dir, exist_ok=True)
+            os.makedirs(self.runs_dir, exist_ok=True)
+        elif not os.path.isdir(self.queue_dir):
+            raise FileNotFoundError(
+                f"no service directory at {self.dir!r} (queue/ missing — "
+                "check --service-dir / $KSPEC_SERVICE_DIR)"
+            )
+
+    # --- paths ------------------------------------------------------------
+    def _job_path(self, state: str, job_id: str) -> str:
+        return os.path.join(self.queue_dir, state, f"{job_id}.json")
+
+    def result_path(self, job_id: str) -> str:
+        return os.path.join(self.results_dir, f"{job_id}.json")
+
+    def run_dir(self, job_id: str) -> str:
+        return os.path.join(self.runs_dir, job_id)
+
+    def _tenant_dir(self, tenant: str) -> str:
+        """Per-tenant marker directory (admission-control index).  Keyed
+        by a digest: tenant names are tenant input and must not be able
+        to escape the index dir or collide with each other's paths."""
+        digest = hashlib.sha1(tenant.encode("utf-8", "replace")).hexdigest()
+        return os.path.join(self.tenant_index_dir, digest[:16])
+
+    # --- client side ------------------------------------------------------
+    def submit(
+        self,
+        cfg_text: str,
+        module: str,
+        tenant: str = "default",
+        cfg_path: Optional[str] = None,
+        kernel_source: str = "auto",
+        max_depth: Optional[int] = None,
+        max_states: Optional[int] = None,
+        fault: Optional[str] = None,
+        job_id: Optional[str] = None,
+    ) -> dict:
+        """Atomically publish one job spec into pending/; returns it."""
+        if kernel_source not in ("auto", "emitted", "hand"):
+            raise ValueError(f"bad kernel_source {kernel_source!r}")
+        spec = {
+            "schema": JOB_SCHEMA,
+            "job_id": job_id or new_job_id(),
+            "tenant": tenant,
+            "module": module,
+            "cfg_text": cfg_text,
+            "cfg_path": cfg_path,
+            "kernel_source": kernel_source,
+            "max_depth": max_depth,
+            "max_states": max_states,
+            "submitted_unix": round(time.time(), 3),
+            "fault": fault,
+        }
+        # marker BEFORE the spec publish: the admission index may briefly
+        # overcount a submit that dies here (lazily cleaned on the next
+        # count), but can never undercount a published job
+        tdir = self._tenant_dir(tenant)
+        os.makedirs(tdir, exist_ok=True)
+        marker = os.path.join(tdir, spec["job_id"])
+        with open(marker, "w"):
+            pass
+        _atomic_write_json(self._job_path(PENDING, spec["job_id"]), spec)
+        return spec
+
+    def status(self, job_id: str) -> dict:
+        """-> {job_id, state: pending|claimed|done|unknown, result?}.
+
+        The verdict file is checked FIRST: a published verdict is
+        terminal truth wherever the spec sits (a daemon that died between
+        verdict write and claim retire leaves the job requeued in
+        pending/ — status must still say done, like `cli result` does).
+        Two scan passes for the rest: the daemon's claim is an os.rename
+        racing these isfile probes, so a single sweep can miss a live job
+        in the instant it moves pending -> claimed; a second sweep closes
+        that window before reporting 'unknown'."""
+        rec = self.result(job_id)
+        if rec is not None:
+            return {"job_id": job_id, "state": DONE, "result": rec}
+        for _attempt in (0, 1):
+            for state in (PENDING, CLAIMED, DONE):
+                if os.path.isfile(self._job_path(state, job_id)):
+                    out = {"job_id": job_id, "state": state}
+                    if state == DONE:
+                        rec = self.result(job_id)
+                        if rec is not None:
+                            out["result"] = rec
+                    return out
+            # the verdict may have landed while we scanned
+            rec = self.result(job_id)
+            if rec is not None:
+                return {"job_id": job_id, "state": DONE, "result": rec}
+        return {"job_id": job_id, "state": "unknown"}
+
+    def result(self, job_id: str) -> Optional[dict]:
+        try:
+            with open(self.result_path(job_id)) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def wait_result(self, job_id: str, timeout: float = 120.0,
+                    poll: float = 0.05) -> Optional[dict]:
+        deadline = time.monotonic() + timeout
+        while True:
+            rec = self.result(job_id)
+            if rec is not None:
+                return rec
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(poll)
+
+    def overview(self) -> dict:
+        """Queue depths + recent terminal jobs (``cli status`` no-arg)."""
+        counts = {
+            state: len(self._list(state)) for state in (PENDING, CLAIMED, DONE)
+        }
+        recent = sorted(self._list(DONE))[-10:]
+        return {"dir": self.dir, "counts": counts, "recent_done": recent}
+
+    # --- daemon side ------------------------------------------------------
+    def _list(self, state: str) -> list:
+        try:
+            return [
+                n[: -len(".json")]
+                for n in os.listdir(os.path.join(self.queue_dir, state))
+                if n.endswith(".json")
+            ]
+        except OSError:
+            return []
+
+    def pending_count(self) -> int:
+        return len(self._list(PENDING))
+
+    def pending_for_tenant(self, tenant: str,
+                           stop_at: Optional[int] = None) -> int:
+        """Pending jobs queued by `tenant` (admission control), counted
+        from the per-tenant marker index submit maintains: O(this
+        tenant's markers) isfile probes, never an open/parse of every
+        pending spec in the whole queue — one deep tenant must not make
+        every OTHER tenant's submit pay an O(queue) scan.  Markers whose
+        pending spec is gone (claimed/finished) are lazily removed;
+        ``stop_at`` bounds the scan for threshold-only callers."""
+        tdir = self._tenant_dir(tenant)
+        try:
+            markers = os.listdir(tdir)
+        except OSError:
+            return 0
+        n = 0
+        for job_id in markers:
+            if os.path.isfile(self._job_path(PENDING, job_id)):
+                n += 1
+                if stop_at is not None and n >= stop_at:
+                    return n
+            else:
+                try:  # claimed or finished since: retire the marker
+                    os.unlink(os.path.join(tdir, job_id))
+                except OSError:
+                    pass
+        return n
+
+    def claimed_count(self) -> int:
+        return len(self._list(CLAIMED))
+
+    def claim_pending(self, limit: Optional[int] = None) -> list:
+        """Move pending jobs to claimed/ (submit-order) and return their
+        parsed specs.  Unparsable/torn specs are quarantined as done with
+        no verdict rather than wedging the queue forever."""
+        out = []
+        for job_id in sorted(self._list(PENDING)):
+            if limit is not None and len(out) >= limit:
+                break
+            src = self._job_path(PENDING, job_id)
+            dst = self._job_path(CLAIMED, job_id)
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue  # another daemon won the claim, or it vanished
+            try:
+                with open(dst) as fh:
+                    spec = json.load(fh)
+                if spec.get("schema") != JOB_SCHEMA:
+                    raise ValueError(
+                        f"unsupported job schema {spec.get('schema')!r}"
+                    )
+                spec["claimed_unix"] = round(time.time(), 3)
+                out.append(spec)
+            except FileNotFoundError:
+                # the claim vanished after we won the rename — a sibling
+                # daemon's janitor requeued it (it cannot tell a live
+                # claim from an orphan).  The job is VALID: leave it for
+                # whoever holds it now, never quarantine it as corrupt
+                continue
+            except OSError:
+                # transient read failure (EMFILE under fd pressure, a
+                # momentary EIO) on a spec we just claimed: the job is
+                # almost certainly valid — submit publishes atomically —
+                # so put the claim back for a later sweep instead of
+                # permanently quarantining it with an exit-2 verdict.
+                # If even the requeue fails, the claim stays for the
+                # next janitor.
+                try:
+                    os.rename(dst, src)
+                except OSError:
+                    pass
+            except ValueError as e:
+                self.finish(job_id, verdict=None, error=f"bad job spec: {e}")
+        return out
+
+    def requeue_orphans(self) -> list:
+        """Startup janitor: claims left by a dead daemon go back to
+        pending/ (idempotent jobs; nothing commits before the verdict)."""
+        moved = []
+        for job_id in self._list(CLAIMED):
+            try:
+                os.rename(
+                    self._job_path(CLAIMED, job_id),
+                    self._job_path(PENDING, job_id),
+                )
+                moved.append(job_id)
+            except OSError:
+                pass
+        return moved
+
+    def finish(self, job_id: str, verdict: Optional[dict],
+               error: Optional[str] = None) -> None:
+        """Publish the verdict (atomic) THEN retire the claim: a crash
+        between the two leaves a claimed job with a verdict, which the
+        janitor requeues and the daemon then short-circuits on the
+        existing result (execute-at-most-once for the visible verdict)."""
+        if verdict is None:
+            from .verdict import error_verdict
+
+            verdict = error_verdict(error or "unknown failure")
+            verdict["job_id"] = job_id
+        _atomic_write_json(self.result_path(job_id), verdict)
+        claimed = self._job_path(CLAIMED, job_id)
+        if os.path.isfile(claimed):
+            try:
+                os.rename(claimed, self._job_path(DONE, job_id))
+            except OSError:
+                pass

@@ -114,6 +114,24 @@ def _setlen(v) -> int:
     return len(v) if isinstance(v, list) else int(v)
 
 
+def resolved_invariants(module: str, cfg) -> tuple:
+    """The invariant names, in order, the model built by :func:`build_model`
+    for this module+cfg will check — the .cfg order, per-module defaults
+    when the .cfg names none, and the fixed built-in TypeOk for the small
+    models whose builders take no invariant selection.  The serving path's
+    batched verdict replay (service/batch.py) keys on exactly this set, so
+    it lives here next to build_model's own resolution rather than as a
+    second table that could drift.  Unknown modules raise KeyError, the
+    same loud failure build_model gives them."""
+    if module in ("IdSequence", "FiniteReplicatedLog"):
+        return ("TypeOk",)  # fixed by the builders; cfg selection ignored
+    if module in KAFKA_VARIANTS or module in ("Kip320", "Kip320FirstTry"):
+        return tuple(cfg.invariants) or ("TypeOk",)
+    if module == "AsyncIsr":
+        return tuple(cfg.invariants) or ("TypeOk", "ValidHighWatermark")
+    raise KeyError(f"unknown module {module!r}")
+
+
 def _with_names(built, constants):
     """Record the .cfg's replica model-value names (`Replicas = {b1, b2,
     b3}`) in the model's meta so counterexample traces render with the
@@ -187,7 +205,7 @@ def build_model(
             max_records=int(c["MaxRecords"]),
             max_leader_epoch=int(c["MaxLeaderEpoch"]),
         )
-        invs = tuple(cfg.invariants) or ("TypeOk",)
+        invs = resolved_invariants(module, cfg)
         if emitted:
             from ..models.emitted import make_emitted_model
 
@@ -225,7 +243,7 @@ def build_model(
             max_offset=int(c["MaxOffset"]),
             max_version=int(c.get("MaxVersion", c["MaxOffset"])),
         )
-        invs = tuple(cfg.invariants) or ("TypeOk", "ValidHighWatermark")
+        invs = resolved_invariants(module, cfg)
         if emitted:
             from ..models.emitted import make_emitted_async_isr
 

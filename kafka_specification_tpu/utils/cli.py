@@ -181,28 +181,15 @@ def _guarded_reexec(argv) -> int:
     return rc
 
 
-def _print_result(res, as_json: bool, model_meta=None):
+def _print_result(res, as_json: bool, model_meta=None, run_id=None):
     if as_json:
-        print(
-            json.dumps(
-                {
-                    "model": res.model,
-                    "distinct_states": res.total,
-                    "diameter": res.diameter,
-                    "levels": res.levels,
-                    "states_per_sec": round(res.states_per_sec, 1),
-                    "seconds": round(res.seconds, 3),
-                    "violation": (
-                        {
-                            "invariant": res.violation.invariant,
-                            "depth": res.violation.depth,
-                        }
-                        if res.violation
-                        else None
-                    ),
-                }
-            )
-        )
+        # the STABLE machine-readable verdict (kspec-verdict/1): the same
+        # record the service's `cli result` returns, so clients switch
+        # between local runs and submitted jobs without re-parsing
+        # (service/verdict.py; docs/service.md)
+        from ..service.verdict import verdict_from_result
+
+        print(json.dumps(verdict_from_result(res, run_id=run_id)))
         return
     print(f"Model: {res.model}")
     print(
@@ -404,11 +391,150 @@ def main(argv=None):
         "events) into a human summary: per-level throughput, action "
         "enablement, spill accounting, restart timeline, ETA, stall "
         "verdict.  Works on live and crashed-mid-run directories; never "
-        "touches an accelerator",
+        "touches an accelerator.  With no run dir: index the recent runs "
+        "under --root (the service multiplies run dirs; this is the "
+        "operator's ls)",
     )
-    pr.add_argument("run_dir")
+    pr.add_argument(
+        "run_dir", nargs="?",
+        help="run directory to render (omit to list recent runs)",
+    )
+    pr.add_argument(
+        "--latest", action="store_true",
+        help="render the newest run under --root instead of listing",
+    )
+    pr.add_argument(
+        "--root",
+        help="runs root for the no-argument index / --latest "
+        "(default: $KSPEC_RUNS_ROOT or ./runs)",
+    )
     pr.add_argument("--json", action="store_true",
                     help="machine-readable report")
+
+    # --- checking-as-a-service (docs/service.md) -------------------------
+    svc_help = (
+        "service directory (queue + results + run dirs; default: "
+        "$KSPEC_SERVICE_DIR or ./service)"
+    )
+
+    pserve = sub.add_parser(
+        "serve",
+        help="run the checking-as-a-service daemon: import jax once, hold "
+        "jitted engine kernels in a shape-keyed compile cache, drain the "
+        "durable job queue under per-tenant resource budgets, coalesce "
+        "jobs sharing a schema shape into one batched engine run "
+        "(docs/service.md)",
+    )
+    pserve.add_argument("service_dir", nargs="?", help=svc_help)
+    pserve.add_argument("--poll", type=float, default=0.2,
+                        help="queue poll interval seconds (default 0.2)")
+    pserve.add_argument(
+        "--max-jobs", type=int,
+        help="exit after this many verdicts (benchmarks / tests)",
+    )
+    pserve.add_argument(
+        "--idle-exit", type=float,
+        help="exit after this many seconds with an empty queue "
+        "(default: serve forever)",
+    )
+    pserve.add_argument("--min-bucket", type=int, default=256)
+    pserve.add_argument(
+        "--chunk-size", type=int, default=32768,
+        help="engine streaming chunk (one value for the whole daemon: "
+        "batched verdict derivation depends on chunk boundaries)",
+    )
+    pserve.add_argument(
+        "--visited-backend",
+        choices=["device", "device-hash", "host"],
+        default="device",
+    )
+    pserve.add_argument(
+        "--no-batching", action="store_true",
+        help="disable multi-config coalescing (every job runs solo; the "
+        "compile cache still amortizes)",
+    )
+    pserve.add_argument(
+        "--cache-entries", type=int, default=32,
+        help="kernel-cache LRU capacity (distinct schema shapes held "
+        "warm; default 32)",
+    )
+    pserve.add_argument(
+        "--supervised", action="store_true",
+        help="run the daemon under the auto-restart supervisor (heartbeat "
+        "stall-kill + bounded restarts; resilience.supervisor)",
+    )
+    pserve.add_argument(
+        "--stall-timeout", type=float, default=120.0,
+        help="[--supervised] kill the daemon after this many seconds "
+        "without a heartbeat tick (default 120; an idle daemon still "
+        "ticks every --poll)",
+    )
+    pserve.add_argument(
+        "--max-restarts", type=int, default=8,
+        help="[--supervised] restart budget (default 8)",
+    )
+    pserve.add_argument("--cpu", action="store_true",
+                        help="force the CPU platform")
+
+    psub = sub.add_parser(
+        "submit",
+        help="submit a check to the service queue and return the job id — "
+        "NEVER imports jax (the tenant side pays no cold start); the .cfg "
+        "travels inline in the job spec",
+    )
+    psub.add_argument("cfg")
+    psub.add_argument("--module", help="TLA+ module (default: cfg stem)")
+    psub.add_argument("--service-dir", help=svc_help)
+    psub.add_argument("--tenant", default="default")
+    psub.add_argument("--max-depth", type=int)
+    psub.add_argument("--max-states", type=int)
+    psub.add_argument(
+        "--emitted", action="store_true", default=None,
+        help="force the mechanically emitted kernels (default: auto — "
+        "emitted when the daemon's reference checkout has the module)",
+    )
+    psub.add_argument(
+        "--hand", action="store_true",
+        help="force the hand-translated kernels",
+    )
+    psub.add_argument(
+        "--fault", metavar="PLAN",
+        help="deterministic fault plan for THIS job (testing/ops; the "
+        "daemon scopes it to the job's run)",
+    )
+    psub.add_argument(
+        "--wait", action="store_true",
+        help="block until the verdict and exit with its exit code",
+    )
+    psub.add_argument(
+        "--timeout", type=float, default=300.0,
+        help="[--wait] give up after this many seconds (default 300)",
+    )
+    psub.add_argument("--json", action="store_true")
+
+    pst = sub.add_parser(
+        "status",
+        help="job state (pending/claimed/done) or, with no job id, the "
+        "queue overview — never imports jax",
+    )
+    pst.add_argument("job_id", nargs="?")
+    pst.add_argument("--service-dir", help=svc_help)
+    pst.add_argument("--json", action="store_true")
+
+    pres = sub.add_parser(
+        "result",
+        help="fetch a job's verdict (kspec-verdict/1, the same record "
+        "`cli check --json` prints) and exit with its exit code — never "
+        "imports jax",
+    )
+    pres.add_argument("job_id")
+    pres.add_argument("--service-dir", help=svc_help)
+    pres.add_argument(
+        "--wait", action="store_true",
+        help="block until the verdict exists",
+    )
+    pres.add_argument("--timeout", type=float, default=300.0)
+    pres.add_argument("--json", action="store_true")
 
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
     po.add_argument("cfg")
@@ -477,13 +603,97 @@ def main(argv=None):
     if args.cmd == "report":
         # a report must render on a box whose accelerator is wedged (that
         # is when you want it most): obs never imports jax
-        from ..obs.report import render_report, report_data
+        from ..obs.report import (
+            list_runs,
+            render_report,
+            render_run_index,
+            report_data,
+        )
 
+        run_dir = args.run_dir
+        if run_dir is None:
+            root = args.root or os.environ.get("KSPEC_RUNS_ROOT", "runs")
+            if args.latest:
+                runs = list_runs(root, limit=1)
+                if not runs:
+                    print(f"no runs under {root}", file=sys.stderr)
+                    return 1
+                run_dir = runs[0]["dir"]
+            else:
+                runs = list_runs(root)
+                if args.json:
+                    print(json.dumps(runs, default=str))
+                else:
+                    print(render_run_index(root, runs))
+                return 0
         if args.json:
-            print(json.dumps(report_data(args.run_dir), default=str))
+            print(json.dumps(report_data(run_dir), default=str))
         else:
-            print(render_report(args.run_dir))
+            print(render_report(run_dir))
         return 0
+
+    if args.cmd in ("submit", "status", "result"):
+        # the tenant side of the service: MUST stay jax-free — clients
+        # never pay the cold start (tests pin this with a poisoned jax)
+        return _run_service_client(args)
+
+    if args.cmd == "serve" and args.supervised:
+        # daemon supervision: same watchdog as engine runs, pointed at the
+        # daemon's own heartbeat (it ticks every poll even when idle)
+        from ..resilience.supervisor import daemon_supervisor_config, supervise
+
+        child_argv = [
+            a
+            for a in (argv if argv is not None else sys.argv[1:])
+            if not (a.startswith("--su") and "--supervised".startswith(a))
+        ]
+        svc_dir = _service_dir(args.service_dir)
+        cfg = daemon_supervisor_config(
+            svc_dir,
+            [sys.executable, "-m", "kafka_specification_tpu.utils.cli"]
+            + child_argv,
+            stall_timeout=args.stall_timeout,
+            max_restarts=args.max_restarts,
+        )
+        return supervise(cfg)
+
+    if args.cmd == "serve":
+        # the daemon IS the jax process: same platform hygiene as `check`
+        # (guarded re-exec against a wedged accelerator tunnel, persistent
+        # XLA compile cache so even a restarted daemon re-warms from disk)
+        if (
+            not args.cpu
+            and not _platform_is_pinned()
+            and not os.environ.get(_CLI_CHILD_ENV)
+        ):
+            return _guarded_reexec(
+                list(argv if argv is not None else sys.argv[1:])
+            )
+        from .platform_guard import pin_cpu_in_process, reassert_env_pin
+
+        if args.cpu:
+            pin_cpu_in_process()
+        elif _platform_is_pinned():
+            reassert_env_pin()
+        if os.environ.get(_CLI_CHILD_ENV):
+            _mark_platform_ready()
+        _enable_compile_cache()
+        from ..service.daemon import ServeConfig
+        from ..service.daemon import serve as _serve
+
+        return _serve(
+            ServeConfig(
+                service_dir=_service_dir(args.service_dir),
+                poll_s=args.poll,
+                max_jobs=args.max_jobs,
+                idle_exit_s=args.idle_exit,
+                min_bucket=args.min_bucket,
+                chunk_size=args.chunk_size,
+                visited_backend=args.visited_backend,
+                cache_entries=args.cache_entries,
+                batching=not args.no_batching,
+            )
+        )
 
     from pathlib import Path
 
@@ -723,6 +933,22 @@ def main(argv=None):
         # to resume, and exit with the distinct resource code (75) so
         # supervisors never classify this as a crash
         print(f"RESOURCE EXHAUSTED: {e}", file=sys.stderr)
+        if args.json:
+            # the stable verdict record covers ALL exits (0/1/75/2): a
+            # client switching between local runs and submitted jobs must
+            # get a kspec-verdict/1 object on the rc-75 path too, exactly
+            # like `cli result` does for a resource-exhausted service job
+            from ..service.verdict import error_verdict
+
+            json.dump(
+                error_verdict(
+                    f"RESOURCE_EXHAUSTED[{e.reason}]: {e.detail}",
+                    run_id=run_ctx.run_id if run_ctx is not None else None,
+                    exit_code=EXIT_RESOURCE_EXHAUSTED,
+                ),
+                sys.stdout,
+            )
+            print()
         if args.checkpoint:
             print(
                 f"  checkpoint intact at {args.checkpoint} — verify with "
@@ -745,9 +971,160 @@ def main(argv=None):
         import shutil
 
         shutil.rmtree(run_ctx.spill_dir, ignore_errors=True)
-    _print_result(res, args.json, model_meta=model.meta)
+    _print_result(
+        res, args.json, model_meta=model.meta,
+        run_id=run_ctx.run_id if run_ctx is not None else None,
+    )
     return 0 if res.violation is None else 1
 
+
+
+def _service_dir(given) -> str:
+    return given or os.environ.get("KSPEC_SERVICE_DIR", "service")
+
+
+def _run_service_client(args) -> int:
+    """submit / status / result: the tenants' side of the service.  Only
+    jax-free imports allowed here — the zero-cold-start contract."""
+    from ..service.queue import JobQueue
+    from ..service.verdict import render_verdict, verdict_exit_code
+
+    try:
+        # submit creates the tree (tenants may enqueue before the first
+        # daemon start); status/result are read-only so a mistyped
+        # --service-dir errors instead of minting an empty service tree
+        q = JobQueue(
+            _service_dir(args.service_dir), create=args.cmd == "submit"
+        )
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.cmd == "submit":
+        from pathlib import Path
+
+        try:
+            cfg_text = Path(args.cfg).read_text()
+        except OSError as e:
+            print(f"error: cannot read {args.cfg}: {e}", file=sys.stderr)
+            return 2
+        module = args.module or Path(args.cfg).stem
+        try:
+            tlc_cfg = parse_cfg(cfg_text)  # validate before queueing
+        except ValueError as e:
+            print(f"error: cannot parse {args.cfg}: {e}", file=sys.stderr)
+            return 2
+        if args.hand and args.emitted:
+            print("error: --hand and --emitted are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        if args.fault:
+            from ..resilience.faults import FaultPlan
+
+            try:
+                FaultPlan(args.fault)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        # admission control: the tenant's max_pending cap (advisory —
+        # the check is client-side so a racing burst can overshoot; the
+        # budget that matters, the resource governor, is daemon-side)
+        from ..resilience.resources import (
+            budget_for_tenant,
+            load_tenant_budgets,
+        )
+
+        try:
+            budgets = load_tenant_budgets(q.tenants_path)
+        except (OSError, ValueError) as e:
+            print(f"error: bad tenants.json: {e}", file=sys.stderr)
+            return 2
+        b = budget_for_tenant(budgets, args.tenant)
+        if b is not None and b.max_pending is not None:
+            mine = q.pending_for_tenant(args.tenant, stop_at=b.max_pending)
+            if mine >= b.max_pending:
+                print(
+                    f"error: tenant {args.tenant!r} at max_pending="
+                    f"{b.max_pending} ({mine} queued) — drain or raise "
+                    f"the cap in tenants.json",
+                    file=sys.stderr,
+                )
+                return 2
+        kernel_source = (
+            "emitted" if args.emitted else "hand" if args.hand else "auto"
+        )
+        spec = q.submit(
+            cfg_text,
+            module,
+            tenant=args.tenant,
+            cfg_path=args.cfg,
+            kernel_source=kernel_source,
+            max_depth=args.max_depth,
+            max_states=args.max_states,
+            fault=args.fault,
+        )
+        if args.json and not args.wait:
+            print(json.dumps({"job_id": spec["job_id"],
+                              "service_dir": q.dir}))
+        else:
+            print(f"submitted {spec['job_id']} (tenant {args.tenant}) -> "
+                  f"{q.dir}", file=sys.stderr)
+        if not args.wait:
+            if not args.json:
+                print(spec["job_id"])
+            return 0
+        rec = q.wait_result(spec["job_id"], timeout=args.timeout)
+        if rec is None:
+            print(
+                f"error: no verdict for {spec['job_id']} within "
+                f"{args.timeout}s (is the daemon up?  `cli serve {q.dir}`)",
+                file=sys.stderr,
+            )
+            return 2
+        print(json.dumps(rec) if args.json else render_verdict(rec))
+        return verdict_exit_code(rec)
+
+    if args.cmd == "status":
+        if args.job_id is None:
+            ov = q.overview()
+            if args.json:
+                print(json.dumps(ov))
+            else:
+                c = ov["counts"]
+                print(
+                    f"service {ov['dir']}: {c['pending']} pending, "
+                    f"{c['claimed']} in flight, {c['done']} done"
+                )
+                for jid in ov["recent_done"]:
+                    rec = q.result(jid) or {}
+                    print(f"  {jid}  {rec.get('status', '?')}")
+            return 0
+        st = q.status(args.job_id)
+        if args.json:
+            print(json.dumps(st))
+        else:
+            line = f"{st['job_id']}: {st['state']}"
+            rec = st.get("result")
+            if rec:
+                line += f" ({rec.get('status', '?')})"
+            print(line)
+        return 0 if st["state"] != "unknown" else 1
+
+    # result
+    rec = (
+        q.wait_result(args.job_id, timeout=args.timeout)
+        if args.wait
+        else q.result(args.job_id)
+    )
+    if rec is None:
+        print(
+            f"error: no verdict for {args.job_id}"
+            + ("" if args.wait else " (yet — use --wait)"),
+            file=sys.stderr,
+        )
+        return 2
+    print(json.dumps(rec) if args.json else render_verdict(rec))
+    return verdict_exit_code(rec)
 
 
 def _print_verify_checkpoint(rep: dict) -> None:

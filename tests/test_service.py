@@ -1,0 +1,556 @@
+"""Checking-as-a-service: queue, daemon, compile cache, batching, tenancy.
+
+Fast tier (`service` marker).  The daemon runs IN-PROCESS here (its
+public Daemon.drain_once) so the suite pays jax/XLA compiles once per
+model through the normal test cache; the jax-free client contract and the
+CLI e2e use subprocesses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.service.daemon import Daemon, ServeConfig
+from kafka_specification_tpu.service.queue import JobQueue
+from kafka_specification_tpu.utils.cli import main as cli_main
+
+pytestmark = pytest.mark.service
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ID_CFG = """
+SPECIFICATION Spec
+CONSTANTS
+    MaxId = 6
+INVARIANTS TypeOk
+CHECK_DEADLOCK FALSE
+"""
+
+# KafkaTruncateToHighWatermark at the TINY config: 353 states clean under
+# TypeOk, WeakIsr VIOLATED at depth 8 (tests/test_variants.py) — the
+# smallest real violation workload, ideal for trace-exactness checks
+TTW_TINY = Config(n_replicas=2, log_size=2, max_records=1, max_leader_epoch=1)
+TTW_CFG_TYPEOK = """
+SPECIFICATION Spec
+CONSTANTS
+    Replicas = {b1, b2}
+    LogSize = 2
+    MaxRecords = 1
+    MaxLeaderEpoch = 1
+INVARIANTS TypeOk
+CHECK_DEADLOCK FALSE
+"""
+TTW_CFG_WEAK = TTW_CFG_TYPEOK.replace(
+    "INVARIANTS TypeOk", "INVARIANTS TypeOk WeakIsr"
+)
+
+
+def _daemon(svc_dir, **kw) -> Daemon:
+    kw.setdefault("linger_s", 0.0)
+    kw.setdefault("min_bucket", 32)
+    return Daemon(ServeConfig(service_dir=str(svc_dir), **kw))
+
+
+def _submit_id(q: JobQueue, tenant="default", **kw) -> dict:
+    return q.submit(ID_CFG, "IdSequence", tenant=tenant,
+                    kernel_source="hand", **kw)
+
+
+# --- queue ----------------------------------------------------------------
+
+
+def test_queue_submit_claim_finish_roundtrip(tmp_path):
+    q = JobQueue(str(tmp_path / "svc"))
+    spec = _submit_id(q)
+    jid = spec["job_id"]
+    assert q.status(jid)["state"] == "pending"
+    claimed = q.claim_pending()
+    assert [s["job_id"] for s in claimed] == [jid]
+    assert q.status(jid)["state"] == "claimed"
+    assert q.claim_pending() == []  # claims are exclusive
+    q.finish(jid, {"schema": "kspec-verdict/1", "job_id": jid,
+                   "status": "complete", "exit_code": 0})
+    st = q.status(jid)
+    assert st["state"] == "done"
+    assert st["result"]["exit_code"] == 0
+
+
+def test_queue_orphan_requeue_and_verdict_shortcircuit(tmp_path):
+    """Claims of a dead daemon requeue; a job whose verdict already
+    published is retired WITHOUT re-running (at-most-once visibility)."""
+    q = JobQueue(str(tmp_path / "svc"))
+    j1 = _submit_id(q)["job_id"]
+    j2 = _submit_id(q)["job_id"]
+    q.claim_pending()
+    # j1's verdict landed before the "crash"; j2's did not
+    q_result = {"schema": "kspec-verdict/1", "job_id": j1,
+                "status": "complete", "exit_code": 0,
+                "distinct_states": 8}
+    from kafka_specification_tpu.service.queue import _atomic_write_json
+
+    _atomic_write_json(q.result_path(j1), q_result)
+    # next daemon: janitor requeues both claims
+    q2 = JobQueue(str(tmp_path / "svc"))
+    moved = q2.requeue_orphans()
+    assert sorted(moved) == sorted([j1, j2])
+    d = _daemon(tmp_path / "svc")
+    d.drain_once()
+    # j1 kept its ORIGINAL verdict (not re-run: distinct_states marker
+    # survives), j2 ran for real
+    assert q2.result(j1)["distinct_states"] == 8
+    assert q2.result(j2)["status"] == "complete"
+    assert q2.status(j1)["state"] == "done"
+    # the short-circuited verdict counts like any other published one:
+    # `serve --max-jobs N` must terminate on it, not serve past it
+    assert d.jobs_done == 2
+
+
+def test_claim_transient_oserror_requeues_not_quarantines(
+    tmp_path, monkeypatch
+):
+    """A transient read failure (EMFILE/EIO) on a just-claimed spec must
+    put the claim back for a later sweep — never permanently fail a
+    valid job with an exit-2 'bad job spec' verdict."""
+    q = JobQueue(str(tmp_path / "svc"))
+    jid = _submit_id(q)["job_id"]
+    real_open = open
+    fired = []
+
+    def flaky_open(path, *a, **kw):
+        p = str(path)
+        if not fired and os.sep + "claimed" + os.sep in p and jid in p:
+            fired.append(p)
+            raise OSError(24, "too many open files")
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr("builtins.open", flaky_open)
+    assert q.claim_pending() == []  # transient failure: nothing claimed...
+    assert fired
+    assert q.result(jid) is None  # ...and NO quarantine verdict published
+    assert q.status(jid)["state"] == "pending"
+    assert [s["job_id"] for s in q.claim_pending()] == [jid]  # next sweep
+
+
+def test_tenant_index_markers_retire_lazily(tmp_path):
+    """Admission counting is O(the tenant's own markers): markers whose
+    pending spec moved on (claimed/finished) are lazily removed."""
+    q = JobQueue(str(tmp_path / "svc"))
+    _submit_id(q, tenant="acme")
+    _submit_id(q, tenant="acme")
+    _submit_id(q, tenant="other")
+    assert q.pending_for_tenant("acme") == 2
+    assert q.pending_for_tenant("other") == 1
+    assert q.pending_for_tenant("acme", stop_at=1) == 1
+    q.claim_pending()  # everything leaves pending/
+    assert q.pending_for_tenant("acme") == 0
+    assert os.listdir(q._tenant_dir("acme")) == []  # stale markers gone
+    assert q.pending_for_tenant("nonexistent") == 0
+
+
+def test_tenant_max_pending_admission(tmp_path):
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    (svc / "tenants.json").write_text(
+        json.dumps({"capped": {"max_pending": 1}})
+    )
+    cfg_path = tmp_path / "IdSequence.cfg"
+    cfg_path.write_text(ID_CFG)
+    rc1 = cli_main(["submit", str(cfg_path), "--service-dir", str(svc),
+                    "--tenant", "capped", "--hand"])
+    rc2 = cli_main(["submit", str(cfg_path), "--service-dir", str(svc),
+                    "--tenant", "capped", "--hand"])
+    assert rc1 == 0 and rc2 == 2  # second submit rejected at the cap
+    assert q.pending_for_tenant("capped") == 1
+
+
+# --- daemon: warm path, batching, verdict fidelity ------------------------
+
+
+def test_daemon_end_to_end_and_warm_second_job(tmp_path):
+    """Job 1 of a shape compiles (compile spans in its trace); job 2 of
+    the same shape rides the shape-keyed cache: zero compile spans, and
+    its manifest records the cache hit — the serving warm-path proof."""
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    d = _daemon(svc)
+    j1 = _submit_id(q)["job_id"]
+    assert d.drain_once() == 1
+    j2 = _submit_id(q)["job_id"]
+    assert d.drain_once() == 1
+
+    for jid in (j1, j2):
+        rec = q.result(jid)
+        assert rec["schema"] == "kspec-verdict/1"
+        assert rec["status"] == "complete"
+        assert rec["distinct_states"] == 8  # MaxId=6 -> 0..7
+        assert rec["exit_code"] == 0
+        assert rec["timing"]["latency_s"] is not None
+
+    assert len(_compile_spans(q, j1)) > 0  # cold shape: compiles visible
+    assert _compile_spans(q, j2) == []  # warm shape: ZERO compile spans
+    man2 = json.load(open(os.path.join(q.run_dir(j2), "manifest.json")))
+    assert man2["config"]["service"]["cache_hit"] is True
+    assert d.cache.stats()["hits"] == 1
+
+
+def _compile_spans(q: JobQueue, jid: str) -> list:
+    path = os.path.join(q.run_dir(jid), "spans.jsonl")
+    with open(path) as fh:
+        spans = [json.loads(line) for line in fh]
+    return [s for s in spans if s.get("span") == "compile"]
+
+
+def test_warm_zero_compiles_even_after_capacity_growth(tmp_path):
+    """A cold run that GROWS the device visited set evicts the steps
+    compiled at outgrown capacities; the daemon's post-run rewarm
+    re-compiles them at the new fixed point, so the SECOND job of the
+    shape still shows zero compile spans (the warm-path contract is not
+    limited to shapes that fit their initial preallocation)."""
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    d = _daemon(svc)
+    j1 = q.submit(TTW_CFG_WEAK, "KafkaTruncateToHighWatermark",
+                  kernel_source="hand")["job_id"]
+    assert d.drain_once() == 1
+    cold = _compile_spans(q, j1)
+    # the premise: this shape outgrows its initial vcap mid-run (compile
+    # spans at >= 2 capacities).  If engine sizing ever changes so it no
+    # longer grows, swap in a config that does — the test exists to pin
+    # the post-growth rewarm.
+    assert len({s["vcap"] for s in cold}) >= 2
+    j2 = q.submit(TTW_CFG_WEAK, "KafkaTruncateToHighWatermark",
+                  kernel_source="hand")["job_id"]
+    assert d.drain_once() == 1
+    assert _compile_spans(q, j2) == []
+    assert q.result(j2)["violation"]["depth"] == 8
+
+
+def test_batched_group_bit_identical_to_solo(tmp_path):
+    """Jobs sharing a schema shape but differing in invariant selection
+    and depth bounds coalesce into ONE engine run; every member's verdict
+    — counts AND violation trace values — equals its solo `cli check`."""
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    jobs = {
+        "typeok": q.submit(TTW_CFG_TYPEOK, "KafkaTruncateToHighWatermark",
+                           kernel_source="hand"),
+        "weak": q.submit(TTW_CFG_WEAK, "KafkaTruncateToHighWatermark",
+                         kernel_source="hand"),
+        "depth5": q.submit(TTW_CFG_WEAK, "KafkaTruncateToHighWatermark",
+                           kernel_source="hand", max_depth=5),
+    }
+    d = _daemon(svc)
+    assert d.drain_once() == 3
+    # one group, one engine run: 3 batched jobs, 1 cache build
+    assert d.groups_run == 1
+    assert d.cache.stats()["misses"] == 1
+
+    solo = {
+        "typeok": check(
+            variants.make_model("KafkaTruncateToHighWatermark", TTW_TINY,
+                                invariants=("TypeOk",)),
+            min_bucket=32,
+        ),
+        "weak": check(
+            variants.make_model("KafkaTruncateToHighWatermark", TTW_TINY,
+                                invariants=("TypeOk", "WeakIsr")),
+            min_bucket=32,
+        ),
+        "depth5": check(
+            variants.make_model("KafkaTruncateToHighWatermark", TTW_TINY,
+                                invariants=("TypeOk", "WeakIsr")),
+            min_bucket=32,
+            max_depth=5,
+        ),
+    }
+    assert solo["weak"].violation is not None  # the known depth-8 WeakIsr
+
+    for name, job in jobs.items():
+        rec = q.result(job["job_id"])
+        s = solo[name]
+        assert rec["levels"] == s.levels, name
+        assert rec["distinct_states"] == s.total, name
+        assert rec["diameter"] == s.diameter, name
+        assert rec["batch"]["group_size"] == 3, name
+        if s.violation is None:
+            assert rec["violation"] is None, name
+        else:
+            assert rec["violation"]["invariant"] == s.violation.invariant
+            assert rec["violation"]["depth"] == s.violation.depth
+            assert rec["violation"]["trace_len"] == len(s.violation.trace)
+    # trace VALUES: replay the batched runner directly against solo
+    from kafka_specification_tpu.engine.bfs import prepare
+    from kafka_specification_tpu.service.batch import Member, run_group
+
+    union = variants.make_model(
+        "KafkaTruncateToHighWatermark", TTW_TINY,
+        invariants=("TypeOk", "WeakIsr"),
+    )
+    derived, _shared = run_group(
+        union,
+        [Member("weak", ("TypeOk", "WeakIsr"))],
+        prepared=prepare(union),
+        min_bucket=32,
+    )
+    dv = derived["weak"].violation
+    sv = solo["weak"].violation
+    assert [a for a, _s in dv.trace] == [a for a, _s in sv.trace]
+    assert [s_ for _a, s_ in dv.trace] == [s_ for _a, s_ in sv.trace]
+
+
+def test_tenant_budget_breach_is_typed_and_isolated(tmp_path):
+    """A job breaching its per-tenant budget exits THAT job rc-75 typed;
+    sibling tenants' jobs and the daemon itself are untouched."""
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    # tenant "starved" gets an impossible deadline: every level is
+    # instantly late (the deterministic breach the resource suite uses)
+    (svc / "tenants.json").write_text(
+        json.dumps({"starved": {"level_deadline": 0}})
+    )
+    j_ok = _submit_id(q, tenant="healthy")["job_id"]
+    j_bad = _submit_id(q, tenant="starved")["job_id"]
+    d = _daemon(svc)
+    assert d.drain_once() == 2
+    bad = q.result(j_bad)
+    assert bad["status"] == "resource-exhausted"
+    assert bad["exit_code"] == 75
+    assert "RESOURCE_EXHAUSTED[deadline]" in bad["error"]
+    ok = q.result(j_ok)
+    assert ok["status"] == "complete" and ok["exit_code"] == 0
+    # the daemon survives and keeps serving
+    j_next = _submit_id(q, tenant="healthy")["job_id"]
+    assert d.drain_once() == 1
+    assert q.result(j_next)["status"] == "complete"
+
+
+def test_bad_job_is_error_verdict_not_daemon_death(tmp_path):
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    j_bad = q.submit("CONSTANTS\n  MaxId = 3\n", "NoSuchModule",
+                     kernel_source="hand")["job_id"]
+    j_ok = _submit_id(q)["job_id"]
+    d = _daemon(svc)
+    assert d.drain_once() == 2
+    bad = q.result(j_bad)
+    assert bad["status"] == "error" and bad["exit_code"] == 2
+    assert q.result(j_ok)["status"] == "complete"
+
+
+def test_malformed_fault_plan_is_error_verdict_not_daemon_death(tmp_path):
+    """`cli submit` pre-validates --fault, but the queue API does not: a
+    spec carrying an unparsable plan must cost THAT job an error verdict
+    (FaultPlan raising inside the daemon), never crash the daemon into
+    the janitor-requeue -> identical-crash loop."""
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    j_bad = _submit_id(q, fault="bogus@x")["job_id"]
+    j_ok = _submit_id(q)["job_id"]
+    d = _daemon(svc)
+    assert d.drain_once() == 2
+    bad = q.result(j_bad)
+    assert bad["status"] == "error" and bad["exit_code"] == 2
+    assert "cannot start job" in bad["error"]
+    assert q.result(j_ok)["status"] == "complete"
+
+
+# --- jax-free client contract ---------------------------------------------
+
+
+def test_client_commands_are_jax_free(tmp_path):
+    """submit/status/result (and the no-arg report index) run with jax
+    imports POISONED — the tenant side never pays the jax cold start."""
+    svc = str(tmp_path / "svc")
+    cfg_path = tmp_path / "IdSequence.cfg"
+    cfg_path.write_text(ID_CFG)
+
+    def client(*argv):
+        return subprocess.run(
+            [
+                sys.executable, "-c",
+                "import sys; sys.modules['jax'] = None; "
+                "sys.modules['jaxlib'] = None\n"
+                "from kafka_specification_tpu.utils.cli import main\n"
+                "sys.exit(main(sys.argv[1:]))",
+                *argv,
+            ],
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+
+    out = client("submit", str(cfg_path), "--service-dir", svc, "--hand",
+                 "--json")
+    assert out.returncode == 0, out.stderr[-2000:]
+    jid = json.loads(out.stdout)["job_id"]
+
+    out = client("status", jid, "--service-dir", svc, "--json")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert json.loads(out.stdout)["state"] == "pending"
+
+    # verdict published (by a daemon elsewhere); result reads it jax-free
+    q = JobQueue(svc)
+    q.claim_pending()
+    q.finish(jid, {"schema": "kspec-verdict/1", "job_id": jid,
+                   "status": "complete", "exit_code": 0, "model": "X",
+                   "distinct_states": 1, "diameter": 0, "levels": [1],
+                   "states_per_sec": 1.0, "seconds": 0.1,
+                   "violation": None})
+    out = client("result", jid, "--service-dir", svc, "--json")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert json.loads(out.stdout)["exit_code"] == 0
+
+    out = client("report", "--root", str(tmp_path / "no-runs"))
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    # read-only clients must ERROR on a mistyped service dir, never mint
+    # an empty service tree that masks the typo as "no such job"
+    out = client("status", "--service-dir", str(tmp_path / "typo"))
+    assert out.returncode == 2
+    assert "no service directory" in out.stderr
+    assert not (tmp_path / "typo").exists()
+
+
+def test_result_exit_codes_follow_verdict(tmp_path):
+    q = JobQueue(str(tmp_path / "svc"))
+    q.finish("job-x", {"schema": "kspec-verdict/1", "job_id": "job-x",
+                       "status": "violation", "exit_code": 1})
+    rc = cli_main(["result", "job-x", "--service-dir",
+                   str(tmp_path / "svc"), "--json"])
+    assert rc == 1
+    rc = cli_main(["result", "job-missing", "--service-dir",
+                   str(tmp_path / "svc")])
+    assert rc == 2
+
+
+# --- verdict schema shared with `cli check --json` ------------------------
+
+
+def test_check_json_is_stable_verdict_schema(tmp_path, capsys):
+    rc = cli_main(["check", "configs/IdSequence.cfg", "--json",
+                   "--run-dir", str(tmp_path / "run")])
+    rec = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert rec["schema"] == "kspec-verdict/1"
+    assert rec["distinct_states"] == 12
+    assert rec["exit_code"] == 0
+    assert rec["run_id"]  # correlates the verdict to its run dir
+    assert rec["violation"] is None
+
+
+# --- report index ---------------------------------------------------------
+
+
+def test_report_index_and_latest(tmp_path, capsys):
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    jid = _submit_id(q)["job_id"]
+    _daemon(svc).drain_once()
+    root = str(svc / "runs")
+    rc = cli_main(["report", "--root", root, "--json"])
+    rows = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert len(rows) == 1
+    assert rows[0]["status"] == "complete"
+    assert rows[0]["service"] == jid
+    rc = cli_main(["report", "--latest", "--root", root])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "service: job " + jid in out
+    assert "[COMPLETE]" in out
+    # empty root: friendly listing, not a crash
+    rc = cli_main(["report", "--root", str(tmp_path / "none")])
+    assert rc == 0
+
+
+# --- CLI serve e2e (one real daemon subprocess) ---------------------------
+
+
+def test_cli_serve_subprocess_e2e(tmp_path):
+    """Full CLI path: daemon subprocess drains a submitted job; the
+    client submits with --wait and inherits the verdict's exit code."""
+    svc = str(tmp_path / "svc")
+    cfg_path = tmp_path / "IdSequence.cfg"
+    cfg_path.write_text(ID_CFG)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "kafka_specification_tpu.utils.cli",
+         "serve", svc, "--max-jobs", "1", "--idle-exit", "60",
+         "--min-bucket", "32"],
+        cwd=_REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "kafka_specification_tpu.utils.cli",
+             "submit", str(cfg_path), "--service-dir", svc, "--hand",
+             "--wait", "--timeout", "240", "--json"],
+            cwd=_REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+        rec = json.loads(out.stdout.splitlines()[-1])
+        assert rec["status"] == "complete"
+        assert rec["distinct_states"] == 8
+        daemon.wait(timeout=120)  # --max-jobs 1: exits after the verdict
+        assert daemon.returncode == 0
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait()
+
+
+# --- concurrency: many submitters against one live daemon ----------------
+
+
+def test_concurrent_submitters_coalesce(tmp_path):
+    """A burst of concurrent submitters sharing one schema shape is
+    served by far fewer engine runs than jobs (the batched economics the
+    serve bench banks at full scale)."""
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    d = _daemon(svc, linger_s=0.05)
+    # warm the shape first so the burst measures batching, not compiles
+    _submit_id(q)
+    d.drain_once()
+    n = 12
+    ids = []
+    lock = threading.Lock()
+
+    def submit():
+        spec = _submit_id(q)
+        with lock:
+            ids.append(spec["job_id"])
+
+    threads = [threading.Thread(target=submit) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    groups_before = d.groups_run
+    t0 = time.perf_counter()
+    done = 0
+    while done < n and time.perf_counter() - t0 < 120:
+        done += d.drain_once()
+    assert done == n
+    for jid in ids:
+        assert q.result(jid)["status"] == "complete"
+    # 12 jobs cost at most a couple of engine runs, not 12
+    assert d.groups_run - groups_before <= 3
+    # one cold build total (the warmup); every burst group hit the cache
+    assert d.cache.stats()["misses"] == 1
+    assert d.cache.stats()["hits"] >= 1
