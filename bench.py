@@ -76,15 +76,29 @@ def _child_main():
 
     # THE measured model is the path users actually get: `cli check`
     # defaults to the mechanically emitted kernels (utils/tla_emit) when
-    # the reference corpus is on disk, so the headline number is the
-    # emitted flagship (round-5 verdict item 4).  The hand-translated
-    # kernels — the independent cross-check path (`--hand`) — are timed
-    # too and reported as a stderr side-note.
-    from kafka_specification_tpu.models.emitted import make_emitted_model
-
+    # the reference corpus is on disk, AND to the fused level-pipeline
+    # (engine/pipeline.py) — so the headline is the emitted flagship on
+    # the fused successor mega-kernels.  The hand kernels and the legacy
+    # per-action pipeline are both timed as cross-checks: the bench JSON
+    # records the emitted-vs-hand gap and the fused-vs-legacy gap as
+    # measured artifacts, plus the per-level successor-launch counts.
+    # Without a reference checkout (this container ships none) the
+    # emitted builders cannot run at all; the bench then measures the
+    # hand kernels and says so ("reference_absent": true) instead of
+    # failing the whole benchmark.
     invs = ("TypeOk", "LeaderInIsr", "WeakIsr", "StrongIsr")
-    model = make_emitted_model("Kip320", cfg, invariants=invs)
     hand_model = kip320.make_model(cfg)
+    model = None
+    reference_absent = True
+    try:
+        from kafka_specification_tpu.models.emitted import make_emitted_model
+
+        model = make_emitted_model("Kip320", cfg, invariants=invs)
+        reference_absent = False
+    except FileNotFoundError as e:
+        print(f"# no reference checkout ({e}); measuring the hand "
+              "kernels as the headline", file=sys.stderr)
+        model = hand_model
     # Backend: on the accelerator the open-addressing HBM hash table
     # (ops/hashset — O(batch) dedup per level, device-resident); on the CPU
     # fallback the native C++ host FpSet (fastest when the "device" IS the
@@ -95,39 +109,70 @@ def _child_main():
         chunk_size=32768,
         visited_capacity_hint=800_000,
         visited_backend="device-hash" if on_accelerator else "host",
+        stats_path=os.devnull,  # per-level stats carry the launch counts
     )
-    # One warmup pass populates the jit caches (tracing + XLA compiles are
-    # a one-time cost per shape — ~11s CPU, more through the TPU tunnel —
-    # amortized away in any real checking session); the measured run
-    # reports steady-state throughput.  The oracle baseline needs no
-    # warmup: CPython has no jit and its rate is flat.
-    check(model, **kwargs)
-    res = check(model, **kwargs)
-    assert res.ok, res.violation
-    assert res.total == 737_794, res.total  # oracle-pinned golden count
 
-    check(hand_model, **kwargs)
-    hres = check(hand_model, **kwargs)
-    assert hres.ok and hres.total == 737_794, (hres.total, hres.violation)
+    def run(m, pipeline):
+        # One warmup pass populates the jit caches (tracing + XLA
+        # compiles are a one-time cost per shape, amortized away in any
+        # real checking session); the measured run is steady-state.
+        check(m, pipeline=pipeline, **kwargs)
+        r = check(m, pipeline=pipeline, **kwargs)
+        assert r.ok, r.violation
+        assert r.total == 737_794, r.total  # oracle-pinned golden count
+        return r
 
+    res = run(model, "fused")  # the headline: the CLI-default path
+    lres = run(model, "legacy")  # pipeline cross-check, same kernels
+    hres = res if reference_absent else run(hand_model, "fused")
+
+    def launches(r):
+        lv = r.stats["levels"]
+        return {
+            "per_chunk_max": max(l["launches_per_chunk_max"] for l in lv),
+            "per_level_max": max(l["successor_launches"] for l in lv),
+        }
+
+    kernel_source = "hand" if reference_absent else "emitted"
     print(
         json.dumps(
             {
-                "metric": "Kip320 3-broker exhaustive check (737,794 states, "
-                "4 invariants), EMITTED kernels (the cli default path), "
-                "distinct states/sec",
+                "metric": "Kip320 3-broker exhaustive check (737,794 "
+                f"states, 4 invariants), {kernel_source.upper()} kernels, "
+                "FUSED successor-mega-kernel pipeline (the cli default "
+                "path), distinct states/sec",
                 "value": round(res.states_per_sec, 1),
                 "unit": "states/sec",
                 "vs_baseline": round(res.states_per_sec / oracle_sps, 2),
                 "platform": platform,
+                "kernel_source": kernel_source,
+                "reference_absent": reference_absent,
+                "pipeline": {
+                    "fused_sps": round(res.states_per_sec, 1),
+                    "legacy_sps": round(lres.states_per_sec, 1),
+                    "fused_vs_legacy": round(
+                        res.states_per_sec / lres.states_per_sec, 2
+                    ),
+                    "fallback": res.stats.get("pipeline_fallback", False),
+                },
+                "kernel_launches": {
+                    "fused": launches(res),
+                    "legacy": launches(lres),
+                },
+                "emitted_vs_hand": (
+                    None if reference_absent
+                    else round(res.states_per_sec / hres.states_per_sec, 2)
+                ),
+                "hand_sps": round(hres.states_per_sec, 1),
             }
         )
     )
     print(
-        f"# emitted (default path): {res.seconds:.1f}s wall on {platform}, "
-        f"diameter {res.diameter}; hand cross-check kernels: "
-        f"{hres.states_per_sec:,.0f} states/sec ({hres.seconds:.1f}s); "
-        f"oracle baseline {oracle_sps:.0f} states/sec",
+        f"# {kernel_source} fused (default path): {res.seconds:.1f}s wall "
+        f"on {platform}, diameter {res.diameter}; legacy pipeline same "
+        f"kernels: {lres.states_per_sec:,.0f} states/sec "
+        f"({lres.seconds:.1f}s); hand fused: {hres.states_per_sec:,.0f} "
+        f"states/sec; oracle baseline {oracle_sps:.0f} states/sec",
         file=sys.stderr,
     )
 

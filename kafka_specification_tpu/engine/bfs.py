@@ -48,6 +48,7 @@ from ..resilience.resources import (
     is_disk_full,
 )
 from ..resilience.retry import ChunkRetryHandler
+from .pipeline import key_vcap as _key_vcap, make_pipeline, resolve_pipeline
 
 # insert-or-find on the device hash table; table + claim lattice donated so
 # XLA updates them in place instead of copying O(capacity) per chunk
@@ -469,6 +470,32 @@ class _Step:
             jnp.concatenate(packed_parts, axis=0),
         )
 
+    def inv_sig(self, with_invariants: bool) -> tuple:
+        """The invariant-selection component of step-cache keys: the
+        ORDERED invariant names when the program embeds the predicates,
+        () otherwise.  Keying on the names (not a bool) lets invariant
+        overlays of one base model (service/kernel_cache) share one step
+        cache — invariant-free programs are shared across overlays, while
+        each ordering's invariant-bearing programs key separately (the
+        stack order fixes the first-violation rule)."""
+        return (
+            tuple(i.name for i in self.model.invariants)
+            if with_invariants and self.model.invariants
+            else ()
+        )
+
+    def cached(self, key, build, **attrs):
+        """Compile-cache insert-or-get: `build()` must return the jitted
+        callable; the first call of a fresh entry is wrapped in a
+        ``compile`` span (_CompileOnFirstCall) and the key is appended to
+        the compiled log PreparedKernels.rewarm replays."""
+        if key not in self._cache:
+            self._compiled_log.add(key)
+            self._cache[key] = _CompileOnFirstCall(
+                build(), self._cache, key, **attrs
+            )
+        return self._cache[key]
+
     def get(
         self,
         bucket: int,
@@ -494,30 +521,27 @@ class _Step:
             tuple(compact) if isinstance(compact, (list, tuple)) else compact
         )
         key = (
+            "step",
             bucket,
             vcap,
-            with_invariants,
+            self.inv_sig(with_invariants),
             with_merge,
             compact_key,
             squeeze_full,
             self.use_pallas,
         )
-        if key not in self._cache:
-            self._compiled_log.add(key)
-            self._cache[key] = _CompileOnFirstCall(
-                jax.jit(
-                    self.build_raw(
-                        bucket, vcap, with_invariants, with_merge, compact,
-                        squeeze_full,
-                    )
-                ),
-                self._cache,
-                key,
-                bucket=bucket,
-                vcap=vcap,
-                compact=repr(compact_key),
-            )
-        return self._cache[key]
+        return self.cached(
+            key,
+            lambda: jax.jit(
+                self.build_raw(
+                    bucket, vcap, with_invariants, with_merge, compact,
+                    squeeze_full,
+                )
+            ),
+            bucket=bucket,
+            vcap=vcap,
+            compact=repr(compact_key),
+        )
 
     def build_raw(
         self,
@@ -820,21 +844,21 @@ class PreparedKernels:
         cap = self.capacity_hint
         if not cap or not getattr(self, "_hint_is_capacity", False):
             return 0  # non-device backends never evict on growth
+        from .pipeline import key_vcap, warm_key
+
         done = 0
         for key in list(self.step._compiled_log):
-            (bucket, vcap, with_inv, with_merge, compact_key, squeeze,
-             _pallas) = key
-            if vcap == cap:
-                continue
-            target = (bucket, cap, with_inv, with_merge, compact_key,
-                      squeeze, self.step.use_pallas)
+            vcap = key_vcap(key)
+            if vcap is None or vcap == cap:
+                continue  # no capacity component, or already at the
+                # fixed point (guard kernels never evict on growth)
+            target = tuple(
+                cap if i == 2 else f for i, f in enumerate(key)
+            )
             if target in self.step._cache:
                 continue
-            self.warmup(
-                bucket, cap, with_inv, with_merge=with_merge,
-                compact=compact_key, squeeze_full=squeeze,
-            )
-            done += 1
+            if warm_key(self.step, self.model, key, cap) is not None:
+                done += 1
         return done
 
     @property
@@ -947,6 +971,8 @@ def check(
     visited_capacity_hint: Optional[int] = None,
     visited_capacity_exact: Optional[int] = None,
     compact_shift: int = 2,
+    compact_gate: int = 4096,
+    pipeline: Optional[str] = None,
     mem_budget=None,
     spill_dir: Optional[str] = None,
     store: str = "auto",
@@ -1006,6 +1032,21 @@ def check(
     a chunk whose enabled count overflows a compact buffer is re-run at
     double the width (the step reports overflow; results stay exact).  0
     disables compaction.
+
+    pipeline: level-pipeline implementation (engine/pipeline.py):
+    "fused" (default; $KSPEC_PIPELINE overrides) = successor mega-kernels
+    — per chunk, ONE batched guard-predicate-matrix launch over the
+    (frontier x choice) lattice, C-speed host compaction into one shared
+    data-driven-width buffer, and ONE update-skeleton launch
+    (gather -> action update -> CONSTRAINT -> pack -> fingerprint), i.e.
+    2 successor launches per chunk instead of one per action;
+    "legacy" = the historical per-action monolithic step.  Both are
+    bit-identical — same level counts, duplicate accounting,
+    first-violation rule and trace values (tests/test_pipeline.py); a
+    fused program that fails to compile degrades the run to legacy
+    (recorded in stats["degradations"] and stats["pipeline_fallback"]).
+    compact_gate: frontier-bucket floor below which both pipelines run
+    the uncompacted full-lattice path (small levels; default 4096).
 
     checkpoint_dir: when set, the (visited set, frontier, level counters) are
     persisted every `checkpoint_every` BFS levels (default 1 = per level; a
@@ -1478,11 +1519,35 @@ def check(
     # shift until a uniform attempt overflows, then measured high-water
     # widths with learned floors — lives in AdaptiveCompact, shared with
     # the sharded engine (docs/PROFILE_5R.md has the measurements).
-    adapt = AdaptiveCompact(model.actions, compact_shift, bucket_gate=4096)
-    adaptive_fallback = False
-    squeeze_full = False
+    adapt = AdaptiveCompact(model.actions, compact_shift,
+                            bucket_gate=compact_gate)
+
+    def _degrade_chunk():
+        # device RESOURCE_EXHAUSTED: halve the streaming chunk size for
+        # the rest of the run (ChunkRetryHandler's degradation contract)
+        nonlocal chunk
+        chunk = max(chunk_floor, chunk >> 1)
+
+    # The level-pipeline: per-chunk expand/squeeze/fingerprint (+ the
+    # device backend's in-jit dedup) behind one interface — the fused
+    # 2-launch mega-kernel path or the legacy per-action path
+    # (engine/pipeline.py; both bit-identical)
+    pipe = make_pipeline(
+        resolve_pipeline(pipeline),
+        step_builder=step_builder,
+        model=model,
+        adapt=adapt,
+        chunk_retry=chunk_retry,
+        fault=fault,
+        check_invariants=check_invariants,
+        visited_backend=visited_backend,
+        on_degrade_chunk=_degrade_chunk,
+        compact_shift=compact_shift,
+        compact_gate=compact_gate,
+    )
 
     exhausted: Optional[ResourceExhausted] = None
+    run_launches_max = 0  # per-chunk max actually DISPATCHED this run
     try:
         while _f_rows(frontier_np) > 0:
             # level-boundary fault injection point (resilience.faults)
@@ -1505,6 +1570,8 @@ def check(
             lvl_rows, lvl_parent, lvl_act = [], [], []
             lvl_new = 0
             lvl_act_en = np.zeros(len(model.actions), np.int64)
+            lvl_launches = 0  # successor-kernel launches this level
+            lvl_launches_max = 0  # ... and the per-chunk maximum
             verdict = None  # (kind, global_frontier_idx, inv_name)
             # Host-native backend: assemble the next level in a preallocated
             # arena via the fused C pass (native.FpSet.insert_compact) — one
@@ -1537,7 +1604,8 @@ def check(
                         # capacity are dead weight in the Model-lifetime cache
                         # (each is a full compiled program) — evict them
                         for k in [
-                            k for k in step_builder._cache if k[1] == vcap
+                            k for k in step_builder._cache
+                            if _key_vcap(k) == vcap
                         ]:
                             del step_builder._cache[k]
                         vcap = new_cap
@@ -1547,114 +1615,34 @@ def check(
                         ht_hi, ht_lo, 2 * ht_hi.shape[0]
                     )
                     ht_claim = None
-                # Candidate compaction: expand/pack/sort/probe/merge at the
-                # enabled width (a few % of M) instead of the padded-lattice
-                # width.  On overflow (an action enabled more pairs than its
-                # compact buffer holds) the visited set returned by the step is
-                # discarded and THIS chunk re-runs with the offending buffers
-                # doubled; the learned floors (act_w_floor) and the
-                # squeeze_full flag persist for the rest of the run so a
-                # recurring density doesn't re-pay the retry every chunk —
-                # exact results either way, sizing is purely a performance
-                # knob.
-                compact_arg = adapt.widths_for(bucket)
-                attempt_sq_full = squeeze_full
+                # One chunk through the level-pipeline: expand -> squeeze ->
+                # fingerprint (+ the device backend's in-jit dedup), with
+                # overflow retries / escalation / failure degradation owned
+                # by the pipeline implementation (engine/pipeline.py).  The
+                # outputs are COMMITTED — exact regardless of which
+                # implementation or retry path produced them.
                 t_attempt = time.perf_counter()
-                chunk_retry.reset_chunk()
-                while True:
-                    try:
-                        injected = fault.chunk_error(
-                            escalated=isinstance(compact_arg, (list, tuple))
-                        )
-                        if injected is not None:
-                            raise injected
-                        step = step_builder.get(
-                            bucket,
-                            vcap,
-                            check_invariants,
-                            with_merge=visited_backend == "device",
-                            compact=compact_arg,
-                            squeeze_full=attempt_sq_full,
-                        )
-                        (
-                            out,
-                            out_parent,
-                            out_act,
-                            new_n,
-                            vhi_n,
-                            vlo_n,
-                            vn_n,
-                            viol_any,
-                            viol_idx,
-                            dl_any,
-                            dl_idx,
-                            act_en,
-                            out_hi,
-                            out_lo,
-                            overflow,
-                            act_guard,
-                        ) = step(
-                            jnp.asarray(_pad_rows(piece, bucket)),
-                            jnp.arange(bucket) < fp_n,
-                            vhi,
-                            vlo,
-                            vn,
-                        )
-                    except Exception as e:  # noqa: BLE001 — XLA compile/run
-                        # known failure ladder — one policy for both engines
-                        # (resilience.retry.ChunkRetryHandler): transient
-                        # errors re-run the same attempt after bounded backoff
-                        # (the chunk commits nothing until its results are
-                        # read back, so a re-run is exact); a device
-                        # RESOURCE_EXHAUSTED re-runs on the uniform compact
-                        # path AND halves the streaming chunk size for the
-                        # rest of the run (same-shape retries would die
-                        # identically); a failed ESCALATED compile degrades to
-                        # the uniform path (AdaptiveCompact.compile_fallback);
-                        # anything else — including an exhausted transient
-                        # budget — re-raises for the supervisor's restart layer
-                        action = chunk_retry.handle(
-                            e,
-                            escalated=isinstance(compact_arg, (list, tuple)),
-                            depth=depth,
-                        )
-                        if action == "retry":
-                            continue
-                        if action == "degrade_chunk":
-                            chunk = max(chunk_floor, chunk >> 1)
-                        compact_arg = adapt.compile_fallback(bucket)
-                        adaptive_fallback = True
-                        continue
-                    ovf = np.asarray(overflow)
-                    if compact_arg is None or not ovf.any():
-                        vhi, vlo, vn = vhi_n, vlo_n, vn_n
-                        break
-                    # retry this chunk with the offending buffers widened: a
-                    # per-action compact overflow doubles that action's width
-                    # (floored for the rest of the run); a squeeze overflow
-                    # disables the pre-sort width reduction (sticky); a
-                    # uniform-shift overflow steps toward the full path
-                    if ovf[-1]:
-                        attempt_sq_full = squeeze_full = True
-                    if ovf[:-1].any():
-                        # shared escalation policy (AdaptiveCompact): a uniform
-                        # overflow escalates to per-action widths sized from
-                        # THIS attempt's guard counts (phase A sweeps the full
-                        # lattice, so act_guard is complete even on overflow);
-                        # a per-action overflow doubles the offenders, floored
-                        # for the rest of the run
-                        compact_arg = adapt.escalate(
-                            compact_arg,
-                            ovf[:-1],
-                            bucket,
-                            np.asarray(act_guard, np.int64) / max(fp_n, 1),
-                        )
-                # adapt buffer sizing from the committed attempt's
-                # PRE-constraint guard counts (what the buffers actually hold;
-                # act_en is post-constraint and undercounts on pruning models)
+                (
+                    out,
+                    out_parent,
+                    out_act,
+                    new_n,
+                    vhi,
+                    vlo,
+                    vn,
+                    viol_any,
+                    viol_idx,
+                    dl_any,
+                    dl_idx,
+                    act_en,
+                    out_hi,
+                    out_lo,
+                    act_guard,
+                    launches,
+                ) = pipe.run_chunk(
+                    piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
+                )
                 act_en_np = np.asarray(act_en, np.int64)
-                act_guard_np = np.asarray(act_guard, np.int64)
-                adapt.observe(act_guard_np / max(fp_n, 1))
                 # frontier-level verdicts (states being expanded = level `depth`)
                 if check_invariants:
                     viol_any_np = np.asarray(viol_any)
@@ -1669,9 +1657,12 @@ def check(
                 nn = int(new_n)
                 step_s = time.perf_counter() - t_attempt
                 prof_step += step_s
+                lvl_launches += launches
+                lvl_launches_max = max(lvl_launches_max, launches)
+                run_launches_max = max(run_launches_max, launches)
                 obs_.chunk_span(
                     "step", step_s, depth=depth, start=start, rows=fp_n,
-                    bucket=bucket,
+                    bucket=bucket, launches=launches,
                 )
                 t_host = time.perf_counter()
                 if host_set is not None and nn:
@@ -1905,7 +1896,17 @@ def check(
                         a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
                     },
                 )
-                result_stats.setdefault("levels", []).append(rec)
+                # launch accounting rides only the in-memory result (and
+                # the per-chunk step spans): the emitted stats stream is
+                # a pinned record-for-record historical contract
+                # (tests/test_obs.py shim equivalence)
+                result_stats.setdefault("levels", []).append(
+                    {
+                        **rec,
+                        "successor_launches": lvl_launches,
+                        "launches_per_chunk_max": lvl_launches_max,
+                    }
+                )
             if collect_levels is not None and new_n:
                 collect_levels.append(_f_all(next_frontier))
             if store_trace:
@@ -1979,8 +1980,17 @@ def check(
             "fanout": C,
             "lanes": K,
             "visited_backend": visited_backend,
+            "pipeline": pipe.name,
+            "pipeline_fallback": bool(getattr(pipe, "fallback", False)),
+            # measured, not the pipeline's nominal figure: sub-gate
+            # chunks delegate to the per-action path and a fused
+            # compile-fallback runs legacy for the rest of the run, so
+            # only the observed per-chunk maximum is honest here
+            "launches_per_chunk_max": run_launches_max,
             "adaptive_active": adapt.active,
-            "adaptive_compile_fallback": adaptive_fallback,
+            "adaptive_compile_fallback": bool(
+                getattr(pipe, "legacy", pipe).compile_fallback
+            ),
             "transient_retries": chunk_retry.retries_total,
             "degradations": chunk_retry.degradations,
         }

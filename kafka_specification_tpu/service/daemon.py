@@ -114,6 +114,12 @@ class Daemon:
         # thread is currently executing (None = idle), and the event that
         # shuts the heartbeat thread down with the daemon
         self._busy_jobs: Optional[list] = None
+        # every claim of the current drain sweep that has not finished
+        # yet: lease renewal must cover claims QUEUED BEHIND the active
+        # group too (a sweep of several cold groups runs for many
+        # minutes, and a sibling janitor must not read the later groups'
+        # original-claim-time leases as expired and steal live work)
+        self._sweep_jobs: list = []
         self._hb_stop = threading.Event()
         # both the main thread (_tick) and the busy-heartbeat thread write
         # heartbeat.jsonl and may rotate it; unserialized, two rotations
@@ -211,15 +217,22 @@ class Daemon:
         groups = plan_groups(jobs) if self.cfg.batching else [
             [j] for j in jobs
         ]
-        for group in groups:
-            try:
-                done += self._run_group(group)
-            finally:
-                # every exit path — normal, error-verdict returns, or an
-                # unexpected escape — must close the busy-heartbeat window
-                self._busy_jobs = None
-            if self._stop:
-                break
+        self._sweep_jobs = [
+            spec["job_id"] for group in groups for spec, _c, _e in group
+        ]
+        try:
+            for group in groups:
+                try:
+                    done += self._run_group(group)
+                finally:
+                    # every exit path — normal, error-verdict returns, or
+                    # an unexpected escape — must close the busy-heartbeat
+                    # window
+                    self._busy_jobs = None
+                if self._stop:
+                    break
+        finally:
+            self._sweep_jobs = []
         return done
 
     # --- group execution --------------------------------------------------
@@ -490,6 +503,10 @@ class Daemon:
 
     def _finish_job(self, spec: dict, rec: dict) -> None:
         self.queue.finish(spec["job_id"], rec)
+        try:  # finished jobs leave the lease-renewal set immediately
+            self._sweep_jobs.remove(spec["job_id"])
+        except ValueError:
+            pass
         self.jobs_done += 1
         self.metrics.inc("kspec_svc_jobs_total", status=rec.get("status", "?"))
 
@@ -611,11 +628,25 @@ class Daemon:
     def _busy_heartbeat_loop(self) -> None:
         """Background thread: keep the heartbeat moving while the main
         thread is inside a long engine run (model build + compile can be
-        minutes), so --supervised never stall-kills a busy daemon."""
+        minutes), so --supervised never stall-kills a busy daemon — and
+        renew the claim LEASES of the in-flight group for the same
+        reason: a sibling daemon sharing this queue directory must read
+        a long-running job as live, not orphaned (queue.requeue_orphans)."""
         while not self._hb_stop.wait(_BUSY_HEARTBEAT_S):
             jobs = self._busy_jobs
             if jobs is not None:
                 self._heartbeat(busy=True, jobs=jobs)
+            # renew every unfinished claim of the sweep, not just the
+            # active group: claims queued behind a minutes-long cold
+            # build must stay visibly live to sibling janitors (a lease
+            # recreated in the instant after finish retires it is a
+            # dangling sidecar the next janitor sweeps — harmless)
+            sweep = list(self._sweep_jobs)
+            if sweep:
+                try:
+                    self.queue.renew_leases(sweep)
+                except Exception:  # noqa: BLE001 — advisory metadata only
+                    pass
 
     def _export_metrics(self, jsonl: bool = False) -> None:
         svc = self.queue.service_dir

@@ -7,29 +7,38 @@ serves every later job of that shape warm, following the compiler-first
 portable-cache design of arXiv:2603.09555 (PAPERS.md): make compilation a
 keyed artifact, look it up in O(1).
 
-The key: in this corpus a model's tensor schema (ops/packing.StateSpec —
-field names, shapes, bounds, lane packing) and its compiled step programs
-are a pure function of ``(module, kernel source, constants)``; the
-invariant selection adds/removes predicate kernels AND fixes the
-first-violation order, so it keys too — ORDERED.  Two .cfg files with
-the same semantic content — regardless of path, comments, or formatting
-— therefore hit the same cache line.  One consequence: a schema shape
-served both solo (cfg-order invariants) and batched (sorted-union
-invariants) holds up to two cache lines when those orders differ —
-first-violation semantics genuinely depend on the model's invariant
-order, so the lines cannot be merged without a model/invariant-overlay
-split (ROADMAP notes this as open); the LRU bounds the cost.  Engine knobs (bucket
-floor, chunk size, visited backend) select among the per-model compiled
-step variants and ride in the GROUP key (scheduler), not here: the
-expensive artifact, the built Model with its jitted-step cache, is shared
-across knob settings.
+Two layers (the model-layer/invariant-overlay split, ROADMAP item 3):
 
-What a cache line holds: the built :class:`~..models.base.Model` plus its
-:class:`~..engine.bfs.PreparedKernels`.  The Model object carries the
-jitted-step cache (``_step_cache``), so a hit skips model build AND every
-step trace/compile — the engine then emits zero ``compile`` spans into
-the job's trace, which is the warm path's observable proof
-(docs/service.md).
+**Model layer** — keyed by ``(module, kernel source, canonical CONSTANTS,
+constraints)``: the expensive artifact.  One entry holds the built
+:class:`~..models.base.Model` (reference parse, symbolic emit, schema,
+action kernels) constructed with the sorted UNION of every invariant any
+overlay of this shape has asked for, plus the model-lifetime jitted-step
+cache (``_step_cache``).
+
+**Invariant overlay** — keyed by the full shape key (ordered invariants +
+deadlock flag): a cheap view over its base model.  The invariant
+selection adds/removes predicate kernels AND fixes the first-violation
+order, so it must key — ORDERED — but it does not need a second model
+build: the overlay reorders the base's Invariant objects (and
+column-permutes the base's fused invariant evaluator) and SHARES the
+base's step cache.  Step-cache keys carry the ordered invariant names
+(engine.bfs._Step.inv_sig), so invariant-free step programs — the whole
+batched-exploration path — are shared across every overlay of a shape,
+while each ordering's invariant-bearing programs compile once per order.
+This is what retires the old "mixed solo/batched traffic of one schema
+shape holds two cache lines" note: solo (cfg-order invariants) and
+batched (sorted-union invariants) traffic now share one model build and
+one step cache, and the solo order only adds its own thin overlay.
+
+Two .cfg files with the same semantic content — regardless of path,
+comments, or formatting — therefore hit the same overlay.  Engine knobs
+(bucket floor, chunk size, visited backend) select among the per-model
+compiled step variants and ride in the GROUP key (scheduler), not here.
+
+A hit skips model build AND every step trace/compile — the engine then
+emits zero ``compile`` spans into the job's trace, which is the warm
+path's observable proof (docs/service.md).
 
 Not jax-free (building models touches jax): imported only by the daemon,
 never by the client commands.
@@ -70,8 +79,8 @@ def resolve_kernel_source(kernel_source: str, module: str) -> bool:
 
 def shape_key(module: str, cfg: TlcConfig, emitted: bool,
               invariants: tuple) -> tuple:
-    """The compile-cache key (see module docstring for why these and only
-    these fields determine the compiled artifact)."""
+    """The overlay key (ordered invariants fix the first-violation rule,
+    so they key verbatim; see module docstring)."""
     return (
         module,
         bool(emitted),
@@ -82,25 +91,124 @@ def shape_key(module: str, cfg: TlcConfig, emitted: bool,
     )
 
 
+def model_key(module: str, cfg: TlcConfig, emitted: bool) -> tuple:
+    """The model-layer key: everything that shapes the built Model except
+    the invariant selection (overlaid) and the deadlock flag (a pure
+    engine knob — the step programs compute deadlock info either way)."""
+    return (
+        module,
+        bool(emitted),
+        canonical_constants(cfg.constants),
+        tuple(cfg.constraints),
+    )
+
+
+def _overlay_model(base, invariants: tuple):
+    """A cheap Model view selecting `invariants` (ordered) from `base`.
+
+    Shares the base's spec/actions/decode AND its step cache (the
+    expensive compiled artifacts); the fused invariant evaluator is a
+    column permutation of the base's, so the shared predicate core
+    compiles once per base, not once per ordering."""
+    base_names = [i.name for i in base.invariants]
+    if tuple(base_names) == tuple(invariants):
+        return base
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    idx = tuple(base_names.index(n) for n in invariants)
+    fused = None
+    if base.invariants_fused is not None:
+        def fused(s, _f=base.invariants_fused, _ix=idx):
+            return _f(s)[jnp.asarray(_ix)]
+
+    view = dataclasses.replace(
+        base,
+        invariants=[base.invariant(n) for n in invariants],
+        invariants_fused=fused,
+    )
+    # one step cache per BASE: overlays share compiled programs; the
+    # ordered-invariant component of each step key (engine.bfs._Step)
+    # keeps invariant-bearing programs per-order while everything
+    # invariant-free is shared
+    for attr in ("_step_cache", "_step_compiled_log"):
+        store = getattr(base, attr, None)
+        if store is None:
+            store = {} if attr == "_step_cache" else set()
+            setattr(base, attr, store)
+        setattr(view, attr, store)
+    return view
+
+
 class KernelCache:
-    """In-process cache of built models + prepared engine kernels, keyed
-    by schema shape.  Bounded LRU (``max_entries``): compiled programs are
-    tens of MB of host memory each on big models, and a long-lived daemon
-    must not grow without bound across every shape it has ever seen."""
+    """In-process two-layer cache of built models + prepared engine
+    kernels.  Bounded LRU over the overlays (``max_entries``): compiled
+    programs are tens of MB of host memory each on big models, and a
+    long-lived daemon must not grow without bound across every shape it
+    has ever seen.  Base models are dropped when their last overlay is
+    evicted."""
 
     def __init__(self, max_entries: int = 32):
         self.max_entries = max_entries
-        self._entries: dict = {}  # key -> entry dict
+        self._entries: dict = {}  # overlay key -> entry dict
+        self._models: dict = {}  # model key -> {model, names, build_s}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.model_builds = 0
+        self.overlay_derives = 0
 
     def __len__(self) -> int:
         return len(self._entries)
 
+    def _base(self, module: str, cfg: TlcConfig, emitted: bool,
+              invariants: tuple) -> dict:
+        """The model-layer entry covering `invariants`, building (or
+        rebuilding with a grown union) when needed."""
+        bkey = model_key(module, cfg, emitted)
+        base = self._models.get(bkey)
+        if base is not None and set(invariants) <= set(base["names"]):
+            return base
+        union = sorted(set(invariants) | set(base["names"] if base else ()))
+        t0 = time.perf_counter()
+        build_cfg = TlcConfig(
+            constants=dict(cfg.constants),
+            invariants=list(union),
+            constraints=list(cfg.constraints),
+            specification=cfg.specification,
+            check_deadlock=cfg.check_deadlock,
+        )
+        model = build_model(module, build_cfg, emitted=emitted)
+        self.model_builds += 1
+        if base is not None:
+            # a grown union replaced the base: overlays derived from the
+            # OLD base would otherwise pin a second full model + step
+            # cache for this shape (the exact cost this split retires) —
+            # drop them so their next request re-derives from the new
+            # base (in-flight callers keep their own references)
+            for k in [
+                k for k, e in self._entries.items()
+                if e.get("base_key") == bkey
+            ]:
+                del self._entries[k]
+        base = {
+            "key": bkey,
+            "model": model,
+            # the names actually RESOLVED into the model (builders may
+            # apply defaults), so coverage checks match reality
+            "names": tuple(i.name for i in model.invariants),
+            "build_s": round(time.perf_counter() - t0, 3),
+        }
+        self._models[bkey] = base
+        return base
+
     def get(self, module: str, cfg: TlcConfig, emitted: bool,
             invariants: tuple) -> dict:
-        """-> {model, prepared, key, hit, build_s}; builds on miss."""
+        """-> {model, prepared, key, hit, build_s}; builds on miss.
+        A miss that lands on a warm model layer derives an invariant
+        overlay (no model build, no step compiles for the shared
+        invariant-free programs) — ``overlay`` is True on such entries."""
         from ..engine.bfs import prepare
 
         key = shape_key(module, cfg, emitted, invariants)
@@ -112,19 +220,19 @@ class KernelCache:
             return {**entry, "hit": True}
         self.misses += 1
         t0 = time.perf_counter()
-        build_cfg = TlcConfig(
-            constants=dict(cfg.constants),
-            invariants=list(invariants),
-            constraints=list(cfg.constraints),
-            specification=cfg.specification,
-            check_deadlock=cfg.check_deadlock,
-        )
-        model = build_model(module, build_cfg, emitted=emitted)
+        prior = self._models.get(model_key(module, cfg, emitted))
+        base = self._base(module, cfg, emitted, invariants)
+        overlay = prior is not None and prior is base  # warm base, no build
+        model = _overlay_model(base["model"], tuple(invariants))
+        if model is not base["model"]:
+            self.overlay_derives += 1
         entry = {
             "key": key,
+            "base_key": base["key"],
             "model": model,
             "prepared": prepare(model),
             "build_s": round(time.perf_counter() - t0, 3),
+            "overlay": bool(overlay),
             "last_used": time.time(),
             "uses": 1,
         }
@@ -133,6 +241,12 @@ class KernelCache:
             lru = min(self._entries.values(), key=lambda e: e["last_used"])
             del self._entries[lru["key"]]
             self.evictions += 1
+            # drop the base model once no overlay references it
+            bk = lru.get("base_key")
+            if bk is not None and not any(
+                e.get("base_key") == bk for e in self._entries.values()
+            ):
+                self._models.pop(bk, None)
         return {**entry, "hit": False}
 
     def stats(self) -> dict:
@@ -144,6 +258,11 @@ class KernelCache:
             "hit_rate": round(
                 self.hits / max(1, self.hits + self.misses), 4
             ),
+            "model_layer": {
+                "entries": len(self._models),
+                "builds": self.model_builds,
+                "overlay_derives": self.overlay_derives,
+            },
         }
 
 

@@ -22,6 +22,18 @@ leaves its claims in ``claimed/``; the next daemon's startup janitor
 (:meth:`JobQueue.requeue_orphans`) moves them back to ``pending/`` (job
 execution is idempotent: nothing is committed until the verdict rename).
 
+Claim leases: every claim is stamped with a sidecar
+``claimed/<job_id>.lease`` recording the claimer's pid + per-process
+token + a lease timestamp, renewed while the daemon works (the
+busy-heartbeat loop calls :meth:`renew_leases` for every unfinished
+claim of its drain sweep).  The janitor requeues a claim only when its lease
+is ORPHANED — no lease file, the pid is gone, or the lease expired
+(``lease_ttl``, default 900s, covering a wedged-but-alive daemon and
+shared-filesystem queues where pid liveness can't be probed).  A live
+sibling's claim is left alone, which is what lets two daemons share one
+queue directory: both janitors run at startup, neither steals in-flight
+work, and a genuinely dead daemon's claims still come back.
+
 Job spec (``kspec-job/1``)::
 
     {"schema": "kspec-job/1", "job_id": ..., "tenant": ...,
@@ -55,6 +67,33 @@ JOB_SCHEMA = "kspec-job/1"
 PENDING = "pending"
 CLAIMED = "claimed"
 DONE = "done"
+
+#: default seconds before an unrenewed claim lease counts as orphaned
+#: (KSPEC_CLAIM_LEASE_TTL overrides; generous — the busy-heartbeat loop
+#: renews every few seconds, so expiry means the claimer is truly gone
+#: or wedged beyond its own supervisor's stall timeout)
+DEFAULT_LEASE_TTL = 900.0
+
+#: per-process claim token: pid alone cannot identify a claimer (a
+#: restarted daemon can be handed its dead predecessor's recycled pid,
+#: especially in small-pid-space containers) — leases carry pid+token,
+#: and only a matching PAIR reads as "our own claim"
+_PROC_TOKEN = os.urandom(8).hex()
+
+
+def _pid_alive(pid: int) -> bool:
+    """Best-effort pid liveness (same-host daemons).  Treats EPERM as
+    alive (the pid exists under another uid) and any other failure as
+    unknowable-alive=False."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except PermissionError:
+        return True
+    except OSError:
+        return False
 
 
 def new_job_id() -> str:
@@ -99,6 +138,9 @@ class JobQueue:
     # --- paths ------------------------------------------------------------
     def _job_path(self, state: str, job_id: str) -> str:
         return os.path.join(self.queue_dir, state, f"{job_id}.json")
+
+    def _lease_path(self, job_id: str) -> str:
+        return os.path.join(self.queue_dir, CLAIMED, f"{job_id}.lease")
 
     def result_path(self, job_id: str) -> str:
         return os.path.join(self.results_dir, f"{job_id}.json")
@@ -267,6 +309,15 @@ class JobQueue:
             except OSError:
                 continue  # another daemon won the claim, or it vanished
             try:
+                # rename PRESERVES the submit-time mtime: refresh it so
+                # the janitor's leaseless-claim grace window (which keys
+                # on the claim file's age) actually covers a claim of a
+                # job that sat queued longer than the window
+                os.utime(dst)
+            except OSError:
+                pass
+            self._write_lease(job_id)
+            try:
                 with open(dst) as fh:
                     spec = json.load(fh)
                 if spec.get("schema") != JOB_SCHEMA:
@@ -291,25 +342,126 @@ class JobQueue:
                 # next janitor.
                 try:
                     os.rename(dst, src)
+                    self._drop_lease(job_id)
                 except OSError:
                     pass
             except ValueError as e:
                 self.finish(job_id, verdict=None, error=f"bad job spec: {e}")
         return out
 
-    def requeue_orphans(self) -> list:
-        """Startup janitor: claims left by a dead daemon go back to
-        pending/ (idempotent jobs; nothing commits before the verdict)."""
+    # --- claim leases -----------------------------------------------------
+    def _write_lease(self, job_id: str) -> None:
+        """Stamp (or renew) this process's lease on a claimed job.  Plain
+        tmp-less write: the lease is advisory liveness metadata, a torn
+        read is treated as no-lease (orphan) which only costs a requeue
+        of an idempotent job."""
+        try:
+            with open(self._lease_path(job_id), "w") as fh:
+                json.dump(
+                    {
+                        "pid": os.getpid(),
+                        "token": _PROC_TOKEN,
+                        "lease_unix": round(time.time(), 3),
+                    },
+                    fh,
+                )
+        except OSError:
+            pass  # lease-less claims degrade to the pre-lease behavior
+
+    def _drop_lease(self, job_id: str) -> None:
+        try:
+            os.unlink(self._lease_path(job_id))
+        except OSError:
+            pass
+
+    def read_lease(self, job_id: str) -> Optional[dict]:
+        try:
+            with open(self._lease_path(job_id)) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def renew_leases(self, job_ids) -> None:
+        """Re-stamp the lease timestamp on in-flight claims (the daemon's
+        busy-heartbeat loop calls this every few seconds while a group
+        runs, so a healthy daemon's leases never approach the TTL)."""
+        for job_id in job_ids:
+            self._write_lease(job_id)
+
+    def lease_orphaned(self, job_id: str,
+                       lease_ttl: Optional[float] = None) -> bool:
+        """True iff a claimed job's lease marks it as abandoned: no lease
+        sidecar (pre-lease claim or write failure), a dead claimer pid on
+        this host, or an expired timestamp (shared-filesystem queues,
+        where pids from another box LOOK dead — expiry is what finally
+        frees their claims; a live same-host sibling renews well inside
+        any sane TTL).  Expiry dominates everything, including our own
+        pid: an expired lease means the claimer is wedged beyond its
+        renewal loop (or a foreign/recycled pid merely aliases a live
+        one), and requeueing an idempotent job is the safe response."""
+        lease = self.read_lease(job_id)
+        if lease is None:
+            # grace window: a sibling writes its lease right AFTER winning
+            # the claim rename, so a leaseless-but-fresh claim may be a
+            # live claim mid-stamp — only a leaseless claim that has SAT
+            # there is an orphan (pre-lease daemons also land here)
+            try:
+                age = time.time() - os.path.getmtime(
+                    self._job_path(CLAIMED, job_id)
+                )
+            except OSError:
+                return True  # claim vanished under us: nothing to hold
+            return age > 10.0
+        if lease_ttl is None:
+            lease_ttl = float(
+                os.environ.get("KSPEC_CLAIM_LEASE_TTL", DEFAULT_LEASE_TTL)
+            )
+        age = time.time() - float(lease.get("lease_unix", 0.0))
+        if age >= lease_ttl:
+            # expiry dominates even a live pid: the busy-heartbeat loop
+            # renews every few seconds, so an expired lease means the
+            # claimer is wedged beyond rescue (or a foreign-host daemon
+            # died and its pid merely ALIASES a live local one)
+            return True
+        pid = int(lease.get("pid", -1))
+        if pid == os.getpid():
+            # ours ONLY if the token matches too: a recycled pid from a
+            # dead predecessor must read as the orphan it is, or its
+            # claims sit stuck until the TTL instead of requeueing at
+            # our own startup janitor
+            return lease.get("token") != _PROC_TOKEN
+        return not _pid_alive(pid)
+
+    def requeue_orphans(self, lease_ttl: Optional[float] = None) -> list:
+        """Startup janitor: claims whose LEASE is orphaned (dead pid /
+        expired / missing — see :meth:`lease_orphaned`) go back to
+        pending/ (idempotent jobs; nothing commits before the verdict).
+        A live sibling daemon's leased claims are left untouched — the
+        prerequisite for two daemons sharing one queue directory."""
         moved = []
         for job_id in self._list(CLAIMED):
+            if not self.lease_orphaned(job_id, lease_ttl=lease_ttl):
+                continue
             try:
                 os.rename(
                     self._job_path(CLAIMED, job_id),
                     self._job_path(PENDING, job_id),
                 )
+                self._drop_lease(job_id)
                 moved.append(job_id)
             except OSError:
                 pass
+        # dangling leases (spec vanished mid-claim, or retired without
+        # cleanup by an older daemon) are dead weight: sweep them
+        try:
+            for name in os.listdir(os.path.join(self.queue_dir, CLAIMED)):
+                if not name.endswith(".lease"):
+                    continue
+                jid = name[: -len(".lease")]
+                if not os.path.isfile(self._job_path(CLAIMED, jid)):
+                    self._drop_lease(jid)
+        except OSError:
+            pass
         return moved
 
     def finish(self, job_id: str, verdict: Optional[dict],
@@ -330,3 +482,4 @@ class JobQueue:
                 os.rename(claimed, self._job_path(DONE, job_id))
             except OSError:
                 pass
+        self._drop_lease(job_id)

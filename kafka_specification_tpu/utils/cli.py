@@ -348,6 +348,20 @@ def main(argv=None):
         metavar="DIR",
         help="wrap the run in a jax.profiler trace (TensorBoard format)",
     )
+    pc.add_argument(
+        "--pipeline",
+        choices=["fused", "legacy"],
+        default=None,
+        help="single-device level-pipeline implementation "
+        "(engine/pipeline.py): 'fused' (default; $KSPEC_PIPELINE "
+        "overrides) = successor mega-kernels — one guard-predicate-matrix "
+        "launch + one update-skeleton launch per chunk (2 successor "
+        "launches instead of one per action); 'legacy' = the historical "
+        "per-action step.  Bit-identical results either way (counts, "
+        "duplicate accounting, first-violation rule, trace values); "
+        "ignored by --sharded (the sharded engine keeps the per-action "
+        "path)",
+    )
     pc.add_argument("--cpu", action="store_true", help="force the CPU platform")
     pc.add_argument(
         "--emitted",
@@ -1323,6 +1337,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw, run=None):
             check_deadlock=tlc_cfg.check_deadlock,
             stats_path=args.stats,
             visited_backend=args.visited_backend,
+            pipeline=getattr(args, "pipeline", None),
             **store_kw,
             **chunk_kw,
         )

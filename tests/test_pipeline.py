@@ -1,0 +1,321 @@
+"""Level-pipeline parity: fused successor mega-kernels vs the legacy
+per-action path (engine/pipeline.py).
+
+The fused pipeline's contract is BIT-IDENTITY with the legacy path —
+same level counts, duplicate accounting, first-violation rule, and trace
+values — plus the perf contract the span tracer can observe: at most 2
+successor launches per chunk (one guard-matrix program + one
+update-skeleton program) where the legacy path dispatches one
+successor-kernel pass per action.
+
+Tiny configs + compact_gate=32 push the fused path into play at
+test-sized buckets (the production gate of 4096 would leave these
+frontiers on the shared full-lattice path and test nothing).
+
+Tier budget: the violating TruncateToHW case (richest assertions: trace
+values) plus the perf smokes and units run in tier-1; the rest of the
+model matrix, the extra backends and the cross-pipeline resume ride the
+`slow` tier (they re-run the same parity predicate on more models).
+Models are memoized per module — the two pipelines SHARE one Model (and
+hence one step cache), exactly like a CLI pipeline switch on a warm
+model; key tags keep their programs separate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kafka_specification_tpu.engine import check, prepare
+from kafka_specification_tpu.engine.pipeline import (
+    PooledWidths,
+    resolve_pipeline,
+)
+from kafka_specification_tpu.models import async_isr, kip320, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.obs.runctx import RunContext
+
+REF = Path(os.environ.get("KSPEC_REFERENCE", "/root/reference"))
+TINY = Config(2, 2, 1, 1)
+
+# fused engages at bucket >= compact_gate; 32 puts every level of these
+# tiny models on it (min_bucket 32 -> buckets 32..256)
+KW = dict(min_bucket=32, chunk_size=256, compact_gate=32,
+          store_trace=True, stats_path=os.devnull)
+
+_MODELS: dict = {}
+
+
+def _model(module):
+    """One shared Model per module (jit tracing is the dominant test
+    cost; the pipelines' step-cache keys are tagged, so sharing is the
+    same contract a CLI `--pipeline` switch on a warm model gets)."""
+    if module not in _MODELS:
+        if module == "Kip320":
+            _MODELS[module] = kip320.make_model(TINY)
+        elif module == "AsyncIsr":
+            _MODELS[module] = async_isr.make_model(
+                async_isr.AsyncIsrConfig(2, 2, 2)
+            )
+        else:
+            _MODELS[module] = variants.make_model(
+                module, TINY, invariants=("TypeOk", "WeakIsr")
+            )
+    return _MODELS[module]
+
+
+def _assert_parity(module, **extra_kw):
+    kw = {**KW, **extra_kw}
+    m = _model(module)
+    r_leg = check(m, pipeline="legacy", **kw)
+    r_fus = check(m, pipeline="fused", **kw)
+    assert r_fus.stats["pipeline"] == "fused"
+    assert r_fus.stats["pipeline_fallback"] is False
+    assert r_leg.levels == r_fus.levels
+    assert r_leg.total == r_fus.total
+    for a, b in zip(r_leg.stats["levels"], r_fus.stats["levels"]):
+        assert a["new"] == b["new"]
+        assert a["duplicates"] == b["duplicates"]
+        assert a["enabled_candidates"] == b["enabled_candidates"]
+        assert a["action_enablement"] == b["action_enablement"]
+    assert (r_leg.violation is None) == (r_fus.violation is None)
+    if r_leg.violation is not None and kw.get("store_trace"):
+        assert r_leg.violation.invariant == r_fus.violation.invariant
+        assert r_leg.violation.depth == r_fus.violation.depth
+        t_leg = [(a, repr(s)) for a, s in r_leg.violation.trace]
+        t_fus = [(a, repr(s)) for a, s in r_fus.violation.trace]
+        assert t_leg == t_fus  # trace VALUES, transition for transition
+    return r_leg, r_fus
+
+
+def test_fused_vs_legacy_bit_identity_violating_model():
+    """Tier-1 anchor case: TruncateToHW violates WeakIsr at depth 8
+    (tests/test_variants.py's pinned answer) — counts, per-level
+    duplicate accounting, the per-action enablement histogram, the
+    first-violation verdict, and the trace VALUES all bit-identical
+    between the two pipelines."""
+    r_leg, _ = _assert_parity("KafkaTruncateToHighWatermark")
+    assert r_leg.violation is not None  # the case actually violates
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("module", ["Kip101", "Kip320", "AsyncIsr"])
+def test_fused_vs_legacy_bit_identity_matrix(module):
+    """The rest of the model matrix (passing runs, constraint pruning
+    on AsyncIsr) — same parity predicate."""
+    _assert_parity(module)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["host", "device-hash"])
+def test_fused_vs_legacy_backends(backend):
+    """Same parity on the non-default visited backends (the sorted
+    device set is the default exercised above)."""
+    _assert_parity("Kip101", visited_backend=backend)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not (REF / "Kip101.tla").exists(),
+    reason="no reference checkout: emitted kernels unavailable",
+)
+def test_fused_vs_legacy_emitted_kernels():
+    """The same parity holds on the mechanically emitted kernels (the
+    CLI default path when the reference corpus is present)."""
+    from kafka_specification_tpu.models.emitted import make_emitted_model
+
+    r = {}
+    for pipe in ("legacy", "fused"):
+        m = make_emitted_model("Kip101", TINY,
+                               invariants=("TypeOk", "WeakIsr"))
+        r[pipe] = check(m, pipeline=pipe, **KW)
+    assert r["legacy"].levels == r["fused"].levels
+    assert r["legacy"].total == r["fused"].total
+    for a, b in zip(r["legacy"].stats["levels"],
+                    r["fused"].stats["levels"]):
+        assert a["duplicates"] == b["duplicates"]
+
+
+@pytest.mark.slow
+def test_resume_cross_pipeline(tmp_path):
+    """A checkpoint taken under one pipeline resumes bit-identical under
+    the other — checkpoints carry no pipeline-specific state, which is
+    what makes the CLI default switch safe for in-flight runs."""
+    kw = {**KW, "store_trace": False}
+    ref = check(_model("Kip101"), pipeline="fused", **kw)
+    for first, second in (("legacy", "fused"), ("fused", "legacy")):
+        ckpt = tmp_path / f"{first}-{second}"
+        cut = check(
+            _model("Kip101"), pipeline=first, checkpoint_dir=str(ckpt),
+            max_depth=5, **kw,
+        )
+        assert cut.diameter == 5
+        resumed = check(
+            _model("Kip101"), pipeline=second, checkpoint_dir=str(ckpt),
+            **kw,
+        )
+        assert resumed.levels == ref.levels
+        assert resumed.total == ref.total
+
+
+@pytest.mark.perf
+def test_fused_two_launches_per_chunk(tmp_path):
+    """The launch-count contract, asserted via the span tracer: every
+    fused chunk dispatches exactly 2 successor programs (guard matrix +
+    update skeleton) where the legacy path runs one successor-kernel
+    pass per action.  Single-chunk levels here, so the per-level count
+    equals the per-chunk count."""
+    m = _model("KafkaTruncateToHighWatermark")
+    n_actions = len(m.actions)
+
+    def check_counts(pipe, pred):
+        run = RunContext(str(tmp_path / pipe))
+        res = check(m, pipeline=pipe, run=run,
+                    **{k: v for k, v in KW.items() if k != "stats_path"})
+        run.deactivate()
+        assert res.stats["pipeline_fallback"] is False
+        for lvl in res.stats["levels"]:
+            assert pred(lvl["launches_per_chunk_max"]), (pipe, lvl)
+        with open(os.path.join(run.dir, "spans.jsonl")) as fh:
+            spans = [json.loads(line) for line in fh]
+        steps = [s for s in spans
+                 if s.get("span") == "step" and s.get("ph") != "B"]
+        assert steps, "no step spans recorded"
+        assert all(pred(s["launches"]) for s in steps), pipe
+
+    # fused: EXACTLY 2 — exact pre-dispatch counts mean no retry can
+    # ever re-dispatch.  legacy: one pass per action per dispatch, and
+    # overflow retries re-dispatch the whole per-action step (a multiple
+    # of n_actions; at these tiny buckets the uniform buffers overflow
+    # and escalate, which is exactly the retry cost fused eliminates)
+    check_counts("fused", lambda n: n == 2)
+    check_counts("legacy",
+                 lambda n: n >= n_actions and n % n_actions == 0)
+    # the bit-identity case above already pins fused == legacy results;
+    # this test is ONLY the launch-count contract
+
+
+@pytest.mark.perf
+def test_warm_prepared_fused_zero_compiles(tmp_path):
+    """The serving warm-path contract survives the fused default: the
+    second check() over one PreparedKernels replays every fused program
+    from the step cache — zero compile spans in its trace.  Needs a
+    FRESH model (the shared memo would arrive pre-warmed)."""
+    model = variants.make_model("Kip101", TINY,
+                                invariants=("TypeOk", "WeakIsr"))
+    pk = prepare(model)
+    kw = {k: v for k, v in KW.items() if k != "stats_path"}
+    run1 = RunContext(str(tmp_path / "cold"))
+    r1 = check(model, pipeline="fused", prepared=pk, run=run1, **kw)
+    run1.deactivate()
+    assert r1.stats["pipeline_fallback"] is False
+    pk.note_result(r1)
+    run2 = RunContext(str(tmp_path / "warm"))
+    check(model, pipeline="fused", prepared=pk, run=run2,
+          visited_capacity_exact=pk.capacity_hint, **kw)
+    run2.deactivate()
+
+    def compiles(run):
+        with open(os.path.join(run.dir, "spans.jsonl")) as fh:
+            spans = [json.loads(line) for line in fh]
+        return [s for s in spans if s.get("span") == "compile"]
+
+    assert len(compiles(run1)) > 0  # cold: the fused programs compile
+    assert compiles(run2) == []  # warm: every one replayed from cache
+
+
+@pytest.mark.slow
+def test_rewarm_replays_fused_keys(tmp_path):
+    """PreparedKernels.rewarm re-compiles FUSED step-cache keys at a new
+    visited-capacity fixed point (the serving daemon's post-growth warm
+    contract now covers the fused default, not just legacy 'step' keys)."""
+    model = variants.make_model("Kip101", TINY,
+                                invariants=("TypeOk", "WeakIsr"))
+    pk = prepare(model)
+    kw = {**KW, "store_trace": False}
+    r = check(model, pipeline="fused", prepared=pk,
+              visited_backend="device", **kw)
+    pk.note_result(r)
+    # simulate a growth run: pretend the fixed point is one doubling up
+    pk.capacity_hint = int(r.stats["visited_capacity"]) * 2
+    pk._hint_is_capacity = True
+    warmed = pk.rewarm()
+    assert warmed > 0
+    # the replayed fused keys exist at the new capacity
+    from kafka_specification_tpu.engine.pipeline import key_vcap
+
+    caps = {key_vcap(k) for k in model._step_compiled_log
+            if k[0] == "fsc"}
+    assert pk.capacity_hint in caps
+    # and a run at the new capacity is compile-free (all replayed)
+    run = RunContext(str(tmp_path / "warm"))
+    check(model, pipeline="fused", prepared=pk, visited_backend="device",
+          visited_capacity_exact=pk.capacity_hint,
+          **{k: v for k, v in kw.items() if k != "stats_path"}, run=run)
+    run.deactivate()
+    with open(os.path.join(run.dir, "spans.jsonl")) as fh:
+        spans = [json.loads(line) for line in fh]
+    assert [s for s in spans if s.get("span") == "compile"] == []
+
+
+def test_injected_compile_oom_degrades_fused_to_legacy(monkeypatch):
+    """KSPEC_FAULT=compile_oom rehearses the fused failure ladder: the
+    fused programs are the escalated-shape family, so the injected OOM
+    fires on them and the run degrades to the legacy pipeline — same
+    results, stats['pipeline_fallback'] records it."""
+    monkeypatch.setenv("KSPEC_FAULT", "compile_oom")
+    r_fall = check(_model("KafkaTruncateToHighWatermark"),
+                   pipeline="fused", **KW)
+    monkeypatch.delenv("KSPEC_FAULT")
+    r_ref = check(_model("KafkaTruncateToHighWatermark"),
+                  pipeline="fused", **KW)
+    assert r_fall.stats["pipeline_fallback"] is True
+    assert any(d["kind"] == "compile_fallback"
+               for d in r_fall.stats["degradations"])
+    assert r_fall.levels == r_ref.levels  # degraded run, exact results
+    assert r_fall.violation.depth == r_ref.violation.depth
+    # and the degraded run's chunks ran the per-action path (a multiple
+    # of n_actions: overflow retries re-dispatch the whole step)
+    n_actions = len(_model("KafkaTruncateToHighWatermark").actions)
+    assert r_fall.stats["launches_per_chunk_max"] % n_actions == 0
+    assert r_fall.stats["launches_per_chunk_max"] >= n_actions
+    assert r_ref.stats["launches_per_chunk_max"] == 2
+
+
+def test_pooled_widths_ladder():
+    """Unit: pooled segment widths cover the exact counts, stay
+    256-aligned (the fingerprint-block invariant), never exceed the
+    action's full lattice width, and only grow (the monotone ladder is
+    what bounds compiled width vectors and keeps warm runs replayable)."""
+    m = _model("Kip101")
+    pool = PooledWidths(m.actions)
+    bucket = 4096
+    w1 = pool.widths_for(
+        bucket, np.asarray([5.0] * len(m.actions)), fp_n=1000
+    )
+    assert all(w >= 256 for w in w1)
+    assert all(w % 256 == 0 for w in w1)
+    counts = np.asarray(
+        [300.0 * (i + 1) for i in range(len(m.actions))]
+    )
+    w2 = pool.widths_for(bucket, counts, fp_n=1000)
+    assert all(w >= c for w, c in zip(w2, counts))
+    assert all(b >= a for a, b in zip(w1, w2))  # monotone
+    # cap: never wider than the full lattice for the action
+    huge = np.asarray([1e9] * len(m.actions))
+    w3 = pool.widths_for(bucket, huge, fp_n=1)
+    for w, a in zip(w3, m.actions):
+        assert w <= -(-bucket * a.n_choices // 256) * 256
+
+
+def test_resolve_pipeline_env(monkeypatch):
+    assert resolve_pipeline(None) == "fused"
+    assert resolve_pipeline("legacy") == "legacy"
+    monkeypatch.setenv("KSPEC_PIPELINE", "legacy")
+    assert resolve_pipeline(None) == "legacy"
+    with pytest.raises(ValueError):
+        resolve_pipeline("bogus")

@@ -64,6 +64,15 @@ def _submit_id(q: JobQueue, tenant="default", **kw) -> dict:
                     kernel_source="hand", **kw)
 
 
+def _kill_leases(q: JobQueue, job_ids, pid=999_999_999,
+                 age: float = 0.0) -> None:
+    """Rewrite claim leases to simulate a dead/expired claimer (our own
+    claims carry this process's live pid, which a janitor must spare)."""
+    for jid in job_ids:
+        with open(q._lease_path(jid), "w") as fh:
+            json.dump({"pid": pid, "lease_unix": time.time() - age}, fh)
+
+
 # --- queue ----------------------------------------------------------------
 
 
@@ -97,6 +106,10 @@ def test_queue_orphan_requeue_and_verdict_shortcircuit(tmp_path):
     from kafka_specification_tpu.service.queue import _atomic_write_json
 
     _atomic_write_json(q.result_path(j1), q_result)
+    # the claimer "died": stamp its leases with a dead pid (our own live
+    # pid would read as a live sibling daemon and be left alone — see
+    # test_janitor_spares_live_sibling_claims)
+    _kill_leases(q, [j1, j2])
     # next daemon: janitor requeues both claims
     q2 = JobQueue(str(tmp_path / "svc"))
     moved = q2.requeue_orphans()
@@ -126,7 +139,8 @@ def test_claim_transient_oserror_requeues_not_quarantines(
 
     def flaky_open(path, *a, **kw):
         p = str(path)
-        if not fired and os.sep + "claimed" + os.sep in p and jid in p:
+        if (not fired and os.sep + "claimed" + os.sep in p and jid in p
+                and p.endswith(".json")):  # the spec read, not the lease
             fired.append(p)
             raise OSError(24, "too many open files")
         return real_open(path, *a, **kw)
@@ -137,6 +151,150 @@ def test_claim_transient_oserror_requeues_not_quarantines(
     assert q.result(jid) is None  # ...and NO quarantine verdict published
     assert q.status(jid)["state"] == "pending"
     assert [s["job_id"] for s in q.claim_pending()] == [jid]  # next sweep
+
+
+def test_janitor_spares_live_sibling_claims(tmp_path):
+    """Claim leases (pid + timestamp) let a janitor tell a LIVE sibling
+    daemon's in-flight claim from an orphan — the prerequisite for two
+    daemons sharing one queue directory.  A live-pid fresh lease is
+    spared; a dead pid or an expired lease is requeued."""
+    q = JobQueue(str(tmp_path / "svc"))
+    j1 = _submit_id(q)["job_id"]
+    claimed = q.claim_pending()  # leaves OUR live-pid lease on j1
+    assert [s["job_id"] for s in claimed] == [j1]
+    lease = q.read_lease(j1)
+    assert lease["pid"] == os.getpid()
+
+    sibling = JobQueue(str(tmp_path / "svc"))  # "second daemon" starts up
+    assert sibling.requeue_orphans() == []  # live sibling claim: spared
+    assert q.status(j1)["state"] == "claimed"
+
+    # the claimer wedges: its lease stops renewing and expires
+    _kill_leases(q, [j1], pid=os.getpid(), age=3600.0)
+    assert sibling.requeue_orphans(lease_ttl=900.0) == [j1]
+    assert q.status(j1)["state"] == "pending"
+
+    # dead pid (fresh timestamp): the crash case, requeued immediately
+    j2 = _submit_id(q)["job_id"]
+    q.claim_pending()
+    _kill_leases(q, [j2])  # pid that cannot exist
+    assert sibling.requeue_orphans() == [j2]
+    assert q.status(j2)["state"] == "pending"
+
+    # recycled pid: OUR live pid but a dead predecessor's (missing)
+    # token — must read as the orphan it is, not "our own claim"
+    j3 = _submit_id(q)["job_id"]
+    q.claim_pending()
+    _kill_leases(q, [j3], pid=os.getpid())  # fresh, our pid, no token
+    assert sibling.requeue_orphans() == [j3]
+    assert q.status(j3)["state"] == "pending"
+
+
+def test_janitor_leaseless_claim_grace_window(tmp_path):
+    """A leaseless claim is only an orphan once it has SAT there: a
+    sibling writes its lease right after winning the claim rename, so a
+    fresh leaseless claim must survive a concurrently-starting janitor
+    (the pre-lease race this grace window closes)."""
+    q = JobQueue(str(tmp_path / "svc"))
+    jid = _submit_id(q)["job_id"]
+    q.claim_pending()
+    q._drop_lease(jid)  # simulate mid-stamp: claim renamed, lease not yet
+    sibling = JobQueue(str(tmp_path / "svc"))
+    assert sibling.requeue_orphans() == []  # fresh: inside the grace
+    # age the claim file past the grace window -> genuine pre-lease orphan
+    old = time.time() - 60.0
+    os.utime(q._job_path("claimed", jid), (old, old))
+    assert sibling.requeue_orphans() == [jid]
+
+
+def test_renew_leases_keeps_claim_live(tmp_path):
+    """The busy-heartbeat loop's lease renewal moves the timestamp, so a
+    long-running job never reads as expired to a sibling."""
+    q = JobQueue(str(tmp_path / "svc"))
+    jid = _submit_id(q)["job_id"]
+    q.claim_pending()
+    _kill_leases(q, [jid], pid=os.getpid(), age=3600.0)  # nearly expired
+    q.renew_leases([jid])  # what the daemon does every few seconds
+    assert not JobQueue(str(tmp_path / "svc")).lease_orphaned(
+        jid, lease_ttl=900.0
+    )
+    assert q.result(jid) is None
+    q.finish(jid, {"schema": "kspec-verdict/1", "job_id": jid,
+                   "status": "complete", "exit_code": 0})
+    assert q.read_lease(jid) is None  # finish retires the lease sidecar
+
+
+# --- kernel cache: model layer + invariant overlay ------------------------
+
+
+def test_cache_split_one_model_build_for_mixed_orders(tmp_path):
+    """Mixed solo/batched traffic of ONE schema shape builds ONE model:
+    the solo job's .cfg-order invariants and the batched union's sorted
+    invariants land as overlays over a shared model layer (shared step
+    cache), not two full cache lines (ROADMAP item-3 open note)."""
+    q = JobQueue(str(tmp_path / "svc"))
+    d = _daemon(tmp_path / "svc")
+    # solo first: cfg order (WeakIsr, TypeOk) != sorted union order
+    cfg_rev = TTW_CFG_TYPEOK.replace(
+        "INVARIANTS TypeOk", "INVARIANTS WeakIsr TypeOk"
+    )
+    j1 = q.submit(cfg_rev, "KafkaTruncateToHighWatermark",
+                  kernel_source="hand")["job_id"]
+    d.drain_once()
+    # then a coalescing pair of the same schema shape (union = sorted)
+    j2 = q.submit(TTW_CFG_WEAK, "KafkaTruncateToHighWatermark",
+                  kernel_source="hand")["job_id"]
+    j3 = q.submit(TTW_CFG_WEAK, "KafkaTruncateToHighWatermark",
+                  kernel_source="hand")["job_id"]
+    d.drain_once()
+    s = d.cache.stats()
+    assert s["model_layer"]["builds"] == 1  # ONE build for both orders
+    assert s["model_layer"]["entries"] == 1
+    assert s["model_layer"]["overlay_derives"] >= 1
+    assert len(d.cache) == 2  # two thin overlays over the one base
+    # the overlays share one step cache (the expensive artifact)
+    entries = list(d.cache._entries.values())
+    caches = {id(e["model"]._step_cache) for e in entries}
+    assert len(caches) == 1
+    # and every member still gets the solo-exact verdict: WeakIsr
+    # violated at depth 8 (tests/test_variants.py's pinned answer)
+    for j in (j1, j2, j3):
+        rec = q.result(j)
+        assert rec["status"] == "violation"
+        assert rec["exit_code"] == 1
+        assert rec["violation"]["invariant"] == "WeakIsr"
+        assert rec["violation"]["depth"] == 8
+
+
+def test_cache_overlay_first_violation_order(tmp_path):
+    """An overlay's invariant ORDER is its own: the first-violation rule
+    follows the .cfg order even when the base model was built in sorted
+    order (the reordered view + column-permuted fused evaluator)."""
+    from kafka_specification_tpu.service.kernel_cache import KernelCache
+    from kafka_specification_tpu.utils.cfg import parse_cfg
+
+    cache = KernelCache()
+    cfg_sorted = parse_cfg(TTW_CFG_WEAK)  # TypeOk, WeakIsr (sorted)
+    cfg_rev = parse_cfg(TTW_CFG_WEAK.replace(
+        "INVARIANTS TypeOk WeakIsr", "INVARIANTS WeakIsr TypeOk"
+    ))
+    e1 = cache.get("KafkaTruncateToHighWatermark", cfg_sorted, False,
+                   ("TypeOk", "WeakIsr"))
+    e2 = cache.get("KafkaTruncateToHighWatermark", cfg_rev, False,
+                   ("WeakIsr", "TypeOk"))
+    assert cache.stats()["model_layer"]["builds"] == 1
+    assert [i.name for i in e1["model"].invariants] == ["TypeOk", "WeakIsr"]
+    assert [i.name for i in e2["model"].invariants] == ["WeakIsr", "TypeOk"]
+    r1 = check(e1["model"], min_bucket=32, store_trace=True)
+    r2 = check(e2["model"], min_bucket=32, store_trace=True)
+    for r in (r1, r2):
+        assert r.violation is not None
+        assert r.violation.invariant == "WeakIsr"
+        assert r.violation.depth == 8
+    # identical counterexample trace values through the overlay view
+    assert [(a, repr(s)) for a, s in r1.violation.trace] == [
+        (a, repr(s)) for a, s in r2.violation.trace
+    ]
 
 
 def test_tenant_index_markers_retire_lazily(tmp_path):
