@@ -126,6 +126,46 @@ def _child_main():
     lres = run(model, "legacy")  # pipeline cross-check, same kernels
     hres = res if reference_absent else run(hand_model, "fused")
 
+    # Integrity overhead (resilience.integrity): the headline above runs
+    # with the ALWAYS-ON digest path (level digest chain + per-chunk
+    # folds — the production default); measure the kill-switch baseline
+    # to bank the overhead honestly.  The venue is CPU-share-throttled
+    # (PR 7's caveat), so single on/off runs are noise-dominated —
+    # alternate on/off three times and compare best-of wall (standard
+    # throttled-venue practice; everything is warm by this point).
+    on_s, off_s = [], []
+    for _ in range(3):
+        os.environ["KSPEC_INTEGRITY"] = "0"
+        r = check(model, pipeline="fused", **kwargs)
+        assert r.ok and r.total == 737_794
+        off_s.append(r.seconds)
+        del os.environ["KSPEC_INTEGRITY"]
+        r = check(model, pipeline="fused", **kwargs)
+        assert r.ok and r.total == 737_794
+        on_s.append(r.seconds)
+    digest_overhead = 100.0 * (min(on_s) / min(off_s) - 1.0)
+    # shadow re-execution per sample rate: each sampled chunk re-executes
+    # through the legacy pipeline + the host fingerprint oracle, so cost
+    # scales with the rate (vs the best always-on wall)
+    shadow = {}
+    for rate in (0.1, 0.5):
+        r = check(model, pipeline="fused", integrity_shadow=rate, **kwargs)
+        assert r.ok and r.total == 737_794, (r.total, r.violation)
+        shadow[str(rate)] = {
+            "sps": round(r.states_per_sec, 1),
+            "cost_vs_always_on_pct": round(
+                100.0 * (r.seconds / min(on_s) - 1.0), 1
+            ),
+        }
+    integrity_rec = {
+        "digest_on_best_s": round(min(on_s), 2),
+        "digest_off_best_s": round(min(off_s), 2),
+        "digest_on_walls_s": [round(s, 2) for s in on_s],
+        "digest_off_walls_s": [round(s, 2) for s in off_s],
+        "digest_overhead_pct": round(digest_overhead, 1),
+        "shadow": shadow,
+    }
+
     def launches(r):
         lv = r.stats["levels"]
         return {
@@ -164,6 +204,7 @@ def _child_main():
                     else round(res.states_per_sec / hres.states_per_sec, 2)
                 ),
                 "hand_sps": round(hres.states_per_sec, 1),
+                "integrity": integrity_rec,
             }
         )
     )
@@ -173,6 +214,17 @@ def _child_main():
         f"kernels: {lres.states_per_sec:,.0f} states/sec "
         f"({lres.seconds:.1f}s); hand fused: {hres.states_per_sec:,.0f} "
         f"states/sec; oracle baseline {oracle_sps:.0f} states/sec",
+        file=sys.stderr,
+    )
+    print(
+        f"# integrity: always-on digest path "
+        f"{integrity_rec['digest_overhead_pct']:+.1f}% wall vs "
+        f"kill-switch baseline (best-of-3 alternating, "
+        f"{min(on_s):.2f}s vs {min(off_s):.2f}s); shadow "
+        + ", ".join(
+            f"rate {k}: {v['cost_vs_always_on_pct']:+.1f}%"
+            for k, v in shadow.items()
+        ),
         file=sys.stderr,
     )
 

@@ -40,8 +40,10 @@ from ..models.base import Model
 from ..obs.observer import RunObserver
 from ..ops import dedup, hashset
 from ..ops.fingerprint import fingerprint_lanes
+from ..resilience import integrity as _integ
 from ..resilience.checkpoints import CheckpointStore
 from ..resilience.faults import FaultPlan
+from ..resilience.integrity import IntegrityError
 from ..resilience.resources import (
     ResourceExhausted,
     ResourceGovernor,
@@ -981,6 +983,7 @@ def check(
     prepared: Optional[PreparedKernels] = None,
     collect_trace: Optional[list] = None,
     governor: Optional[ResourceGovernor] = None,
+    integrity_shadow: Optional[float] = None,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -1110,6 +1113,24 @@ def check(
     (service/scheduler.py); a breach inside this check raises the same
     typed ResourceExhausted without touching any other job's budgets.
 
+    integrity_shadow: sampled shadow re-execution rate in [0, 1]
+    ($KSPEC_INTEGRITY_SHADOW is the env twin; default 0 = off).  A
+    deterministically sampled chunk is re-executed through an independent
+    path BEFORE its outputs are committed — the legacy pipeline for
+    fused-gated chunks (counts, new-fingerprint multiset and verdict
+    flags must match the fused result bit-for-bit), and the host
+    fingerprint oracle (numpy recomputation of every emitted row's
+    fingerprint) for every sampled chunk — so silent device/compaction
+    corruption is caught in-flight, typed, and never enters a
+    checkpoint.  Always-on independent of the rate: the per-level digest
+    chain over the new-state fingerprint multiset (stamped into
+    checkpoints + verified at every level boundary, on resume, and by
+    the offline `cli verify-checkpoint`), the save-time visited-set
+    self-check, and read-side storage checksums.  Any failure raises the
+    typed :class:`IntegrityError` (CLI exit 76) with the run manifest
+    stamped ``integrity-violation`` (resilience.integrity,
+    docs/resilience.md).  KSPEC_INTEGRITY=0 disables the whole layer.
+
     disk_budget: byte budget for the spill + checkpoint directories
     (resilience.resources.ResourceGovernor; KSPEC_DISK_BUDGET is the env
     twin, KSPEC_RSS_BUDGET / KSPEC_LEVEL_DEADLINE arm the RSS and
@@ -1145,6 +1166,13 @@ def check(
 
     fault = FaultPlan.from_env()
     chunk_retry = ChunkRetryHandler.from_env("[engine]")
+    # state-integrity defense (resilience.integrity): always-on level
+    # digest chain + sampled shadow re-execution; KSPEC_INTEGRITY=0 is
+    # the kill switch (bench baselines, emergency escape hatch)
+    chain = _integ.LevelDigestChain() if _integ.enabled() else None
+    shadow_rate = (
+        _integ.shadow_rate(integrity_shadow) if chain is not None else 0.0
+    )
     ckpt_store = None  # built once ckpt_ident is known
     # newest durably checkpointed level (None = not checkpointing):
     # level-crash faults defer until the target level is checkpointed so
@@ -1358,7 +1386,30 @@ def check(
         + ",".join(f"{f.name}:{f.shape}:{f.lo}:{f.hi}" for f in spec.fields)
         + ("|store=disk" if use_disk else "")
     )
+    def _spill_ref_errors(arrays: dict) -> list:
+        """Disk-tier load validator: CRC-verify every spill run and
+        frontier segment a generation REFERENCES before accepting it —
+        a generation whose referenced run rotted on disk (flip@spill)
+        then falls back to an older one that predates the corrupt file
+        (whose deterministic re-exploration rewrites it), instead of
+        crashing mid-restore."""
+        if disk is None or "spill_manifest" not in arrays:
+            return []
+        from ..storage.frontier import FrontierReader as _FR
+        from ..storage.frontier import SegmentCorrupt as _SC
+
+        man = json.loads(str(arrays["spill_manifest"]))
+        errs = _integ.spill_run_errors(
+            disk.fpset.dir, (man.get("fpset") or {}).get("runs", ())
+        )
+        try:
+            _FR(disk.frontier_dir, man["frontier"], verify=True)
+        except _SC as e:
+            errs.append(f"referenced frontier segment corrupt: {e}")
+        return errs
+
     resumed = False
+    resumed_chain_arr = None
     if checkpoint_dir is not None:
         ckpt_store = CheckpointStore(
             checkpoint_dir,
@@ -1366,11 +1417,21 @@ def check(
             ident=ckpt_ident,
             keep=checkpoint_keep,
             fault_plan=fault,
+            # chain-mismatch generations (CRC-consistent content
+            # corruption) fall back exactly like checksum failures: the
+            # run resumes from the newest CHAIN-VERIFIED generation
+            validators=(
+                (_integ.checkpoint_chain_errors, _spill_ref_errors)
+                if chain is not None
+                else (_spill_ref_errors,)
+            ),
         )
         loaded = ckpt_store.load()
         if loaded is not None:
             resumed = True
             snap, _, _gen = loaded
+            if "digest_chain" in snap:
+                resumed_chain_arr = snap["digest_chain"]
             if disk is not None:
                 # the checkpoint references the disk tier, it does not
                 # contain it: reopen the manifest's runs + frontier
@@ -1417,53 +1478,124 @@ def check(
         disk.start_fresh(init_packed, np.asarray(_u64(hi0, lo0)))
         frontier_np = disk.pending()
 
+    if chain is not None:
+        if resumed:
+            # the chain IS the continuation proof: a resumed run extends
+            # the stamped chain, and the frontier verify below checks the
+            # loaded frontier against its sealed entry.  Pre-integrity
+            # checkpoints rebuild an unanchored chain (counts only)
+            chain = (
+                _integ.LevelDigestChain.from_array(resumed_chain_arr)
+                if resumed_chain_arr is not None
+                else _integ.LevelDigestChain.from_levels(levels)
+            )
+        else:
+            chain.fold(_integ.pair_u64(hi0, lo0))
+            chain.seal(0, n0)
+
+    def _chain_stamp() -> dict:
+        # an UNANCHORED chain (rebuilt from a pre-integrity checkpoint's
+        # counts — its digests are unknown, stored as zeros) must never
+        # be stamped: a stamped zero-digest chain would fail the
+        # cumulative visited check on the NEXT load and permanently
+        # reject every post-upgrade generation.  Such runs keep saving
+        # chain-less checkpoints; anchoring restarts with the next fresh
+        # run
+        return (
+            {"digest_chain": chain.to_array()}
+            if chain is not None and chain.anchored
+            else {}
+        )
+
+    def _readback_chain(path: str) -> None:
+        if chain is not None and chain.anchored:
+            _integ.readback_chain(path, depth=depth)
+
     def _save_checkpoint():
         # only the live prefix of the visited set is saved (the sentinel
         # padding is rebuilt on resume from vcap/vn); uncompressed — live
         # fingerprints are high-entropy and zlib only burns time
         n = int(vn)
+        levels_arr = np.asarray(levels)
+        # flip injections are gated on an ANCHORED chain: they rehearse
+        # detection, and an unanchored chain (pre-integrity resume)
+        # cannot detect — injecting there would just silently corrupt
+        if chain is not None and chain.anchored and fault.flip(
+            "ckpt", depth, ckpt_depth=last_ckpt_depth
+        ):
+            # CRC-consistent metadata corruption: the manifest is built
+            # AFTER this flip, so every per-array checksum passes over
+            # the corrupt content — only the digest chain flags it
+            levels_arr = levels_arr.copy()
+            _integ.flip_bit(levels_arr)
         if disk is not None:
             # the disk tier IS the durable state: record the run manifest
             # + frontier-segment offsets + the (budget-bounded) hot dump,
-            # never the runs/segments themselves
-            ckpt_store.save(
+            # never the runs/segments themselves.  (The hot dump is a
+            # SUBSET of the visited set, so the cumulative-digest
+            # self-check does not apply here — the spilled runs carry
+            # their own read-side-verified CRCs instead.)
+            path = ckpt_store.save(
                 depth,
                 dict(
                     spill_manifest=json.dumps(disk.manifest()),
                     host_fps=disk.fpset.hot_dump(),
                     vcap=vcap,
-                    levels=np.asarray(levels),
+                    levels=levels_arr,
                     total=total,
+                    **_chain_stamp(),
                 ),
             )
             # a new durable generation exists: advance the deferred-
             # deletion barrier (merged-away runs / consumed frontier
             # segments older than every retained generation get unlinked)
             disk.on_checkpoint_saved()
+            _readback_chain(path)
             return
         if host_set is not None:
             extra = {"host_fps": host_set.dump()}
+            pk = "host_fps"
         elif ht_hi is not None:
             th = np.asarray(ht_hi)
             tl = np.asarray(ht_lo)
             live = ~((th == hashset.SENT) & (tl == hashset.SENT))
             extra = {"hash_hi": th[live], "hash_lo": tl[live]}
+            pk = "hash_hi"
         else:
             extra = {
                 "vhi": np.asarray(vhi[:n]),
                 "vlo": np.asarray(vlo[:n]),
                 "vn": n,
             }
-        ckpt_store.save(
+            pk = "vhi"
+        if chain is not None and chain.anchored:
+            if fault.flip("fpset", depth, ckpt_depth=last_ckpt_depth):
+                corrupted = np.array(extra[pk], copy=True)
+                _integ.flip_bit(corrupted)
+                extra[pk] = corrupted
+            if host_set is not None:
+                dump_fps = np.asarray(extra["host_fps"], np.uint64)
+            elif ht_hi is not None:
+                dump_fps = _integ.pair_u64(extra["hash_hi"], extra["hash_lo"])
+            else:
+                dump_fps = _integ.pair_u64(extra["vhi"], extra["vlo"])
+            # save-time self-check: the dump must digest to the chain's
+            # running total BEFORE the write — corruption detected here
+            # never enters a checkpoint
+            _integ.count_check()
+            chain.verify_visited(dump_fps, depth=depth)
+        path = ckpt_store.save(
             depth,
             dict(
                 frontier=frontier_np,
                 vcap=vcap,
-                levels=np.asarray(levels),
+                levels=levels_arr,
                 total=total,
                 **extra,
+                **_chain_stamp(),
             ),
         )
+        _readback_chain(path)
 
     chunk = _next_pow2(max(min_bucket, chunk_size))
     chunk_floor = _next_pow2(max(32, min_bucket))
@@ -1546,12 +1678,123 @@ def check(
         compact_gate=compact_gate,
     )
 
+    def _shadow_exec(piece, fp_n, bucket, start, pre_v, cvcap,
+                     out, out_hi, out_lo, nn, viol_any, dl_any):
+        """Sampled shadow re-execution of one committed-candidate chunk
+        (see check()'s integrity_shadow docstring).  Two independent
+        oracles, both BEFORE the outputs feed the visited set:
+
+        - host fingerprint oracle (every sampled chunk): the numpy twin
+          recomputes each emitted row's fingerprint — rows and fps
+          diverging means corruption between the kernel and the host;
+        - legacy cross-execution (fused-gated chunks): the whole chunk
+          re-runs through the legacy per-action pipeline from the same
+          pre-chunk visited state — counts, the new-fingerprint multiset
+          and the verdict flags must match the fused result exactly (the
+          PR 7 bit-identity contract, used as a runtime oracle)."""
+        from ..obs import metrics as _met
+
+        t0 = time.perf_counter()
+        main_fps = _integ.pair_u64(
+            np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn])
+        )
+        rows = np.asarray(out[:nn])
+        oracle = _integ.fingerprint_rows(rows, spec.exact64)
+        mode = "host-oracle"
+        if not np.array_equal(oracle, main_fps):
+            bad = int(np.argmax(oracle != main_fps))
+            raise IntegrityError(
+                "shadow",
+                f"host fingerprint oracle mismatch at depth {depth} chunk "
+                f"start {start} row {bad}: recomputed {int(oracle[bad]):#x}"
+                f" != emitted {int(main_fps[bad]):#x}",
+                depth=depth,
+            )
+        if (
+            getattr(pipe, "name", "") == "fused"
+            and not getattr(pipe, "fallback", False)
+            and pipe._gate(bucket)
+        ):
+            mode = "legacy-cross"
+            (l_out, _lp, _la, l_new, _h1, _h2, _h3, l_viol, _vi,
+             l_dl, _di, _ae, l_hi, l_lo, _ag, _launch) = (
+                pipe.legacy.run_chunk(
+                    piece, fp_n, bucket, depth, *pre_v, cvcap
+                )
+            )
+            ln = int(l_new)
+            l_fps = _integ.pair_u64(
+                np.asarray(l_hi[:ln]), np.asarray(l_lo[:ln])
+            )
+            if ln != nn or _integ.digest_fps(l_fps) != _integ.digest_fps(
+                main_fps
+            ):
+                raise IntegrityError(
+                    "shadow",
+                    f"legacy cross-execution diverged at depth {depth} "
+                    f"chunk start {start}: fused emitted {nn} "
+                    f"fingerprints, legacy {ln} (or multiset digests "
+                    f"differ) — one of the two pipelines produced "
+                    f"corrupt successors",
+                    depth=depth,
+                )
+            if not np.array_equal(
+                np.asarray(viol_any), np.asarray(l_viol)
+            ) or bool(dl_any) != bool(l_dl):
+                raise IntegrityError(
+                    "shadow",
+                    f"verdict flags diverged between fused and legacy at "
+                    f"depth {depth} chunk start {start}",
+                    depth=depth,
+                )
+        _met.inc("kspec_integrity_shadow_total")
+        _integ.count_check()
+        obs_.chunk_span(
+            "shadow", time.perf_counter() - t0,
+            depth=depth, start=start, rows=int(fp_n), mode=mode,
+        )
+
+    # storage read-side corruption (read-verified CRCs on spill runs /
+    # frontier segments / parent-log levels) surfaces as these typed
+    # exceptions mid-run — all integrity violations, exit 76
+    from ..storage.frontier import SegmentCorrupt
+    from ..storage.parent_log import ParentLogCorrupt
+    from ..storage.runs import RunCorrupt
+
     exhausted: Optional[ResourceExhausted] = None
+    integrity_fail: Optional[IntegrityError] = None
     run_launches_max = 0  # per-chunk max actually DISPATCHED this run
     try:
         while _f_rows(frontier_np) > 0:
             # level-boundary fault injection point (resilience.faults)
             fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
+            if chain is not None:
+                sp = fault.flip(
+                    "frontier", depth, ckpt_depth=last_ckpt_depth
+                )
+                if isinstance(frontier_np, np.ndarray):
+                    if sp:
+                        _integ.flip_bit(frontier_np)
+                    # the frontier about to be expanded must digest to
+                    # the entry sealed when its level was discovered — a
+                    # bit flipped in the buffer between levels (or a
+                    # frontier loaded from a CRC-consistent corrupted
+                    # checkpoint) is caught HERE, before it poisons
+                    # successors
+                    _integ.count_check()
+                    chain.verify_level(
+                        depth,
+                        _integ.fingerprint_rows(frontier_np, spec.exact64),
+                    )
+                elif sp and frontier_np.paths():
+                    # disk-spilled frontier: the flip lands in a segment
+                    # FILE (there is no long-lived host buffer to flip);
+                    # the read-side segment CRC catches it at the first
+                    # chunk read of this level
+                    from ..resilience.faults import corrupt_file
+
+                    frontier_np._read_verified.clear()
+                    corrupt_file(frontier_np.paths()[0])
             if max_depth is not None and depth >= max_depth:
                 break
             if max_states is not None and total >= max_states:
@@ -1621,6 +1864,13 @@ def check(
                 # by the pipeline implementation (engine/pipeline.py).  The
                 # outputs are COMMITTED — exact regardless of which
                 # implementation or retry path produced them.
+                shadow = shadow_rate > 0 and _integ.sample_chunk(
+                    depth, start, shadow_rate
+                )
+                # pre-chunk visited refs: the shadow legacy cross-exec
+                # replays the chunk from the same starting state (jax
+                # arrays are immutable, so holding them is free)
+                pre_v = (vhi, vlo, vn) if shadow else None
                 t_attempt = time.perf_counter()
                 (
                     out,
@@ -1655,6 +1905,11 @@ def check(
                     verdict = ("deadlock", start + int(dl_idx), "Deadlock")
                     break
                 nn = int(new_n)
+                if shadow:
+                    _shadow_exec(
+                        piece, fp_n, bucket, start, pre_v, vcap,
+                        out, out_hi, out_lo, nn, viol_any, dl_any,
+                    )
                 step_s = time.perf_counter() - t_attempt
                 prof_step += step_s
                 lvl_launches += launches
@@ -1691,11 +1946,21 @@ def check(
                         )
                         a_w += w
                         lvl_new += w
+                        if chain is not None and w:
+                            # arena rows are the committed novel states;
+                            # the numpy twin recomputes their fps (the C
+                            # pass hands back rows, not fingerprints)
+                            chain.fold(
+                                _integ.fingerprint_rows(
+                                    a_rows[a_w - w : a_w], spec.exact64
+                                )
+                            )
                     else:  # tiered disk store, or no native toolchain
                         rows = np.asarray(out[:nn])
-                        mask = host_set.insert(
-                            _u64(np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn]))
+                        fps_u64 = _u64(
+                            np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn])
                         )
+                        mask = host_set.insert(fps_u64)
                         if disk is not None:
                             # novel rows stream straight to the spilled
                             # frontier + parent log in discovery order (int64
@@ -1713,6 +1978,8 @@ def check(
                             )
                             lvl_act.append(np.asarray(out_act[:nn])[mask])
                         lvl_new += int(mask.sum())
+                        if chain is not None:
+                            chain.fold(fps_u64[mask.astype(bool)])
                 elif ht_hi is not None and nn:
                     # device-hash backend: insert-or-find on the HBM table; a
                     # probe-budget overflow grows the table and re-runs the
@@ -1809,11 +2076,27 @@ def check(
                     lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
                     lvl_act.append(np.asarray(out_act[:nn])[mask])
                     lvl_new += int(mask.sum())
+                    if chain is not None:
+                        chain.fold(
+                            _integ.pair_u64(
+                                np.asarray(out_hi[:nn])[mask],
+                                np.asarray(out_lo[:nn])[mask],
+                            )
+                        )
                 elif nn:
                     lvl_rows.append(np.asarray(out[:nn]))
                     lvl_parent.append(np.asarray(out_parent[:nn]) + start)
                     lvl_act.append(np.asarray(out_act[:nn]))
                     lvl_new += nn
+                    if chain is not None:
+                        # device backend: the in-jit dedup already
+                        # compacted exactly the new states to the front
+                        chain.fold(
+                            _integ.pair_u64(
+                                np.asarray(out_hi[:nn]),
+                                np.asarray(out_lo[:nn]),
+                            )
+                        )
                 host_s = time.perf_counter() - t_host
                 prof_host_s += host_s
                 obs_.chunk_span(
@@ -1875,6 +2158,13 @@ def check(
             if new_n:
                 levels.append(new_n)
                 total += new_n
+            if chain is not None:
+                if new_n:
+                    # seal the level: the folded multiset digest becomes
+                    # the chain entry (count disagreement raises typed)
+                    chain.seal(depth, new_n)
+                else:
+                    chain.reset_fold()
             if collect_stats:
                 enabled_total = int(lvl_act_en.sum())
                 # heartbeat-enveloped (kind/ts/unix): the per-level stats
@@ -1923,6 +2213,13 @@ def check(
             governor.level_end(depth, reclaim=_reclaim, save_hook=_final_save)
     except ResourceExhausted as e:
         exhausted = e
+    except IntegrityError as e:
+        integrity_fail = e
+    except (RunCorrupt, SegmentCorrupt, ParentLogCorrupt) as e:
+        # read-side storage checksum failure: silent on-disk corruption
+        # caught at consumption time — typed exactly like every other
+        # integrity violation
+        integrity_fail = IntegrityError("storage", str(e), depth=depth)
     except OSError as e:
         if not is_disk_full(e):
             raise
@@ -1930,6 +2227,31 @@ def check(
         # injected paths: same typed clean exit (every writer cleans
         # up its tmp on failure, so the promoted state is intact)
         exhausted = ResourceExhausted("enospc", str(e), depth=depth)
+    if integrity_fail is not None:
+        # typed terminal (resilience.integrity): stamp the manifest so
+        # `cli report` renders the integrity beat, then propagate for the
+        # CLI's exit-76 mapping.  The supervisor restarts; the resume
+        # path's chain validator skips corrupted generations, so the
+        # restart resumes from the newest CHAIN-VERIFIED one.  Corrupt
+        # in-memory state is deliberately NOT checkpointed here (unlike
+        # the resource exit's final save): the newest durable generation
+        # predates the detected corruption by construction.
+        try:
+            _integ.record_violation(integrity_fail)
+            if disk is not None:
+                disk.abort_level()  # partial next-level writer: discard
+            obs_.abort(
+                "integrity-violation",
+                site=integrity_fail.site,
+                depth=integrity_fail.depth,
+                detail=integrity_fail.detail[:300],
+                distinct_states=total,
+            )
+            obs_.close()
+        except OSError:
+            pass
+        _drop_ephemeral_spill()
+        raise integrity_fail
     if exhausted is not None:
         # the terminal path itself writes (manifest rewrite, metrics
         # snapshot) to the same full filesystem — best-effort only, so a

@@ -41,6 +41,8 @@ _EVENT_KINDS = (
     "resource-pressure",
     "reclaim",
     "resource-exhausted",
+    "integrity-violation",
+    "pipeline-fallback",
     "xprof-start",
     "xprof-stop",
 )
@@ -110,10 +112,11 @@ def verdict(data: dict, now: Optional[float] = None) -> dict:
     growth within the stall timeout), so report and sentry agree."""
     man = data["manifest"]
     status = man.get("status")
-    if status in ("complete", "violation", "error", "resource-exhausted"):
-        # resource-exhausted is TERMINAL, not a crash: the run checkpointed
-        # and exited clean (exit code 75); it resumes once the operator
-        # frees space — the detail says what ran out and where
+    if status in ("complete", "violation", "error", "resource-exhausted",
+                  "integrity-violation"):
+        # resource-exhausted / integrity-violation are TERMINAL, not
+        # crashes: the run exited typed (75 / 76); the detail says what
+        # ran out or which integrity check tripped
         return {"status": status, "detail": man.get("result", {})}
     now = time.time() if now is None else now
     beats = [r.get("unix") for r in data["levels"] if r.get("unix")]
@@ -333,6 +336,24 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
         "shard_procs": shard_procs,
         "died_shards": died,
         "resource": resource,
+        "integrity": _integrity(data),
+    }
+
+
+def _integrity(data: dict) -> dict:
+    """Integrity beat (resilience.integrity): how many always-on checks
+    and shadow samples ran, and any violation events."""
+    snap = data.get("metrics") or {}
+    counters = snap.get("counters") or {}
+    return {
+        "checks": counters.get("kspec_integrity_checks_total", 0),
+        "shadow_samples": counters.get("kspec_integrity_shadow_total", 0),
+        "violations": counters.get("kspec_integrity_violations_total", 0),
+        "events": [
+            e
+            for e in data["obs_events"]
+            if e.get("event") == "integrity-violation"
+        ],
     }
 
 
@@ -548,6 +569,31 @@ def render_report(run_dir: str, now: Optional[float] = None,
             "`cli verify-checkpoint`, then re-run the same command to "
             "resume — or supervise with --reclaim for one automatic "
             "prune-and-retry."
+        )
+    if v["status"] == "integrity-violation":
+        # the verdict beat: a state-integrity check tripped (exit code
+        # 76) — the run's data, not its progress, was the problem
+        d = v["detail"] or {}
+        out.append(
+            f"  INTEGRITY VIOLATION: site {d.get('site', '?')} at level "
+            f"{d.get('depth', '?')} after {d.get('distinct_states', '?')} "
+            f"distinct states — silent corruption detected, typed exit."
+        )
+        out.append(
+            "  next: `cli verify-checkpoint` shows which generations are "
+            "chain-verified; re-running resumes from the newest one "
+            "(corrupted generations are skipped automatically).  "
+            "Recurring violations on one host suggest failing "
+            "hardware — re-run the single-device engine with "
+            "`--integrity-shadow 1.0` to localize."
+        )
+    integ = r.get("integrity") or {}
+    if integ.get("checks") or integ.get("shadow_samples") \
+            or integ.get("violations"):
+        out.append(
+            f"  integrity: {integ.get('checks', 0)} checks, "
+            f"{integ.get('shadow_samples', 0)} shadow samples, "
+            f"{integ.get('violations', 0)} violations"
         )
     if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
         out.append(f"  died mid-level: level {r['open_level']} began but "

@@ -66,9 +66,11 @@ from ..engine.bfs import (
 from ..models.base import Model
 from ..obs.observer import RunObserver
 from ..ops import dedup, hashset
+from ..resilience import integrity as _integ
 from ..resilience.checkpoints import CheckpointStore
 from ..resilience.faults import FaultPlan
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..resilience.integrity import IntegrityError
 from ..resilience.resources import (
     ResourceExhausted,
     ResourceGovernor,
@@ -217,6 +219,28 @@ def _make_sharded_step(
         # parent as a mesh-global frontier row id (survives the exchange)
         parent_g = me.astype(jnp.int32) * bucket + parent
 
+        def fp_digest(dhi, dlo, mask):
+            """Exchange framing record: order-invariant (count, xor_hi,
+            xor_lo, sum_hi, sum_lo) over a masked fingerprint multiset —
+            the payload's integrity stamp.  Computed per shard BEFORE and
+            AFTER the collective; the host compares the global combines,
+            so any bit the fabric (or a buffer in between) flips in a
+            routed fingerprint desyncs the two (resilience.integrity).
+            uint32 lanes: TPUs have no 64-bit ALU, and wrapping 32-bit
+            sums/xors combine across shards just as commutatively."""
+            z = jnp.uint32(0)
+            mh = jnp.where(mask, dhi, z)
+            ml = jnp.where(mask, dlo, z)
+            return jnp.stack([
+                jnp.sum(mask, dtype=jnp.uint32),
+                jax.lax.reduce(mh, z, jax.lax.bitwise_xor, [0]),
+                jax.lax.reduce(ml, z, jax.lax.bitwise_xor, [0]),
+                jnp.sum(mh, dtype=jnp.uint32),
+                jnp.sum(ml, dtype=jnp.uint32),
+            ])
+
+        sent_dig = fp_digest(hi, lo, valid)
+
         if exchange == "all_to_all":
             owner = jnp.where(valid, (lo % jnp.uint32(D)).astype(jnp.int32), D)
             s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
@@ -250,6 +274,16 @@ def _make_sharded_step(
             mine = r_valid & ((r_lo % jnp.uint32(D)).astype(jnp.int32) == me)
             r_hi = jnp.where(mine, r_hi, sent)
             r_lo = jnp.where(mine, r_lo, sent)
+
+        # post-exchange framing digest over the received (non-sentinel)
+        # candidates: across all shards the received multiset must be
+        # exactly the sent multiset (all_to_all routes each valid
+        # candidate to exactly one owner; all_gather + ownership filter
+        # partitions the same set) — compared host-side per committed
+        # chunk (overflowed attempts are discarded before the compare)
+        recv_dig = fp_digest(
+            r_hi, r_lo, ~((r_hi == sent) & (r_lo == sent))
+        )
 
         # minimal-payload sort over the received (owned) candidates: the
         # sort both dedups the batch (first-occurrence) and fixes the
@@ -338,13 +372,15 @@ def _make_sharded_step(
             ovf_probe[None],  # device-hash probe-budget overflow
             out_hi,  # [R] per shard (host-FpSet backend reads these)
             out_lo,
+            sent_dig[None],  # [1, 5] -> [D, 5] exchange framing digests
+            recv_dig[None],
         )
 
     sharded = _shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
-        out_specs=tuple([P("d")] * 18),
+        out_specs=tuple([P("d")] * 20),
         **_SHARD_MAP_KW,
     )
     return jax.jit(sharded)
@@ -856,6 +892,12 @@ def check_sharded(
     # which is the failure the fleet supervisor exists to catch
     fault.set_local_shards(my_shards)
     fault.validate_shards(D)
+    # state-integrity defense (resilience.integrity): the same always-on
+    # level digest chain as the single-device engine — the digest is over
+    # the new-state fingerprint MULTISET, which is shard-layout-invariant,
+    # so chains are comparable across engines and survive elastic resumes
+    # unchanged — plus the exchange framing check below
+    chain = _integ.LevelDigestChain() if _integ.enabled() else None
     hb_path = None
     if hb_dir:
         os.makedirs(hb_dir, exist_ok=True)
@@ -919,6 +961,21 @@ def check_sharded(
         store_trace = False
         last_ckpt_depth = 0
         checkpoint_every = max(1, int(checkpoint_every))
+        def _spill_ref_errors(arrays: dict) -> list:
+            """Disk-tier load validator: CRC-verify every per-shard spill
+            run a generation references (flip@spill recovery: fall back
+            to a generation predating the corrupt file — its
+            deterministic re-exploration rewrites it)."""
+            if not use_disk or "spill_manifest" not in arrays:
+                return []
+            errs = []
+            for d, man in enumerate(json.loads(str(arrays["spill_manifest"]))):
+                errs += _integ.spill_run_errors(
+                    os.path.join(spill_base, f"shard{d}"),
+                    (man or {}).get("runs", ()),
+                )
+            return errs
+
         ckpt_store = CheckpointStore(
             checkpoint_dir,
             "sharded_checkpoint.npz",
@@ -926,6 +983,14 @@ def check_sharded(
             keep=checkpoint_keep,
             fault_plan=fault,
             ident_aliases=(ckpt_ident_legacy,),
+            # CRC-consistent content corruption falls back exactly like a
+            # checksum failure: resume from the newest CHAIN-VERIFIED
+            # generation (resilience.integrity)
+            validators=(
+                (_integ.checkpoint_chain_errors, _spill_ref_errors)
+                if chain is not None
+                else (_spill_ref_errors,)
+            ),
         )
         if want_trace:
             # per-shard on-disk parent logs: counterexample traces that
@@ -964,6 +1029,17 @@ def check_sharded(
         if loaded is not None:
             resumed = True
             snap, part_arrays, _gen = loaded
+            if chain is not None:
+                # restore the digest chain (layout-invariant: an elastic
+                # resume re-buckets rows, never the level multisets);
+                # pre-integrity checkpoints rebuild unanchored from counts
+                chain = (
+                    _integ.LevelDigestChain.from_array(snap["digest_chain"])
+                    if "digest_chain" in snap
+                    else _integ.LevelDigestChain.from_levels(
+                        snap["levels"].tolist()
+                    )
+                )
             # stamp-less legacy snapshots passed the ident check via the
             # same-layout alias, so their layout is by construction the
             # current one (never spuriously elastic)
@@ -1128,6 +1204,10 @@ def check_sharded(
                 if len(sel):
                     host_sets[d].insert(_u64(hi0[sel], lo0[sel]))
 
+    if chain is not None and not resumed:
+        chain.fold(_integ.pair_u64(hi0, lo0))
+        chain.seal(0, n0)
+
     shard1 = NamedSharding(mesh, P("d"))
     dev_vhi = put_global(vhi, shard1)
     dev_vlo = put_global(vlo, shard1)
@@ -1141,6 +1221,34 @@ def check_sharded(
             for s in host_sets:
                 if s is not None:
                     s.on_checkpoint_saved()
+
+    def _levels_for_save():
+        """The coordinator main's levels array, with the flip@ckpt
+        CRC-consistent corruption injected BEFORE the manifest is built
+        (resilience.integrity; the post-save read-back + the load-time
+        chain validator are what must catch it)."""
+        levels_arr = np.asarray(levels)
+        # anchored-only, like every flip injection: an unanchored chain
+        # cannot detect what it corrupts (engine.bfs._save_checkpoint)
+        if chain is not None and chain.anchored and fault.flip(
+            "ckpt", depth, ckpt_depth=last_ckpt_depth
+        ):
+            levels_arr = levels_arr.copy()
+            _integ.flip_bit(levels_arr)
+        return levels_arr
+
+    def _chain_stamp() -> dict:
+        # never stamp an UNANCHORED chain (rebuilt from a pre-integrity
+        # checkpoint: digests unknown) — see engine.bfs._chain_stamp
+        return (
+            {"digest_chain": chain.to_array()}
+            if chain is not None and chain.anchored
+            else {}
+        )
+
+    def _readback_chain(path: str) -> None:
+        if chain is not None and chain.anchored:
+            _integ.readback_chain(path, depth=depth)
 
     def _save_checkpoint():
         if host_sets is not None and use_disk:
@@ -1176,16 +1284,18 @@ def check_sharded(
                 else np.empty((0, K), np.uint32),
                 pending_lens=np.asarray([p.shape[0] for p in pending]),
                 vcap=vcap,
-                levels=np.asarray(levels),
+                levels=_levels_for_save(),
                 total=total,
                 **extra,
+                **_chain_stamp(),
             )
             # single-process runs carry the payload (incl. its layout
             # stamp) inline; multi-process mains stamp their own
             main["mesh_D"] = D
             main["mesh_P"] = jax.process_count()
-            ckpt_store.save(depth, main)
+            path = ckpt_store.save(depth, main)
             _advance_spill_gc()
+            _readback_chain(path)
             return
         if host_sets is not None:
             dumps = [
@@ -1241,9 +1351,47 @@ def check_sharded(
                 "vlo": fetch_global(dev_vlo)[:, : int(vn_np.max())],
                 "vn": vn_np,
             }
+        if chain is not None and chain.anchored:
+            # flip@fpset injection + the save-time cumulative-digest
+            # self-check (pre-write: detected corruption never enters a
+            # checkpoint).  The full visited multiset is process-local
+            # only outside the per-host-parts layout, so multiprocess
+            # host runs skip (their per-host dumps are partial by design)
+            pk = None
+            if host_sets is not None and not is_multiprocess():
+                pk = "host_fps"
+            elif visited_backend == "device-hash":
+                pk = "hash_hi"
+            elif host_sets is None:
+                pk = "vhi"
+            if pk is not None and pk in extra:
+                if fault.flip("fpset", depth, ckpt_depth=last_ckpt_depth):
+                    corrupted = np.array(extra[pk], copy=True)
+                    _integ.flip_bit(corrupted)
+                    extra[pk] = corrupted
+                if pk == "host_fps":
+                    dump_fps = np.asarray(extra["host_fps"], np.uint64)
+                elif pk == "hash_hi":
+                    dump_fps = _integ.pair_u64(
+                        extra["hash_hi"], extra["hash_lo"]
+                    )
+                else:
+                    vhi_np = np.asarray(extra["vhi"])
+                    vlo_np = np.asarray(extra["vlo"])
+                    vns = np.asarray(extra["vn"]).ravel()
+                    dump_fps = np.concatenate(
+                        [
+                            _integ.pair_u64(
+                                vhi_np[d, : int(n)], vlo_np[d, : int(n)]
+                            )
+                            for d, n in enumerate(vns.tolist())
+                        ]
+                    ) if vns.size else np.empty(0, np.uint64)
+                _integ.count_check()
+                chain.verify_visited(dump_fps, depth=depth)
         if not is_coordinator():
             return  # one writer per job; all processes hold identical state
-        ckpt_store.save(
+        path = ckpt_store.save(
             depth,
             dict(
                 pending=np.concatenate(pending)
@@ -1251,13 +1399,15 @@ def check_sharded(
                 else np.empty((0, K), np.uint32),
                 pending_lens=np.asarray([p.shape[0] for p in pending]),
                 vcap=vcap,
-                levels=np.asarray(levels),
+                levels=_levels_for_save(),
                 total=total,
                 mesh_D=D,
                 mesh_P=jax.process_count(),
                 **extra,
+                **_chain_stamp(),
             ),
         )
+        _readback_chain(path)
 
     # Resource governance (resilience.resources): disk budget over the
     # spill + checkpoint dirs, RSS/deadline watchdogs, injected stall —
@@ -1356,12 +1506,46 @@ def check_sharded(
     _shard_beat(depth, event="start", resumed=bool(resumed))
     cut = False
     exhausted: Optional[ResourceExhausted] = None
+    integrity_fail: Optional[IntegrityError] = None
+    from ..storage.parent_log import ParentLogCorrupt
+    from ..storage.runs import RunCorrupt
+
     try:
         while any(p.shape[0] for p in pending):
             # level-boundary fault injection point (resilience.faults); the
             # plan derives from the replicated env, so every process raises
             # (or not) in lockstep
             fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
+            if chain is not None:
+                sp = fault.flip(
+                    "frontier", depth, ckpt_depth=last_ckpt_depth
+                )
+                if sp:
+                    # a shard scope targets THAT shard's pending buffer
+                    # (falling back to the first non-empty one when the
+                    # targeted shard happens to own no rows this level —
+                    # an empty buffer has no bit to flip)
+                    d0 = sp.shard if sp.shard is not None else 0
+                    if pending[d0].size == 0:
+                        d0 = next(
+                            (d for d in range(D) if pending[d].size), d0
+                        )
+                    _integ.flip_bit(pending[d0])
+                # frontier verify: the pending shards' combined multiset
+                # must digest to the entry sealed at discovery (the
+                # per-shard split is layout; the multiset is the search)
+                parts = [
+                    _integ.fingerprint_rows(p, spec.exact64)
+                    for p in pending
+                    if p.shape[0]
+                ]
+                _integ.count_check()
+                chain.verify_level(
+                    depth,
+                    np.concatenate(parts)
+                    if parts
+                    else np.empty(0, np.uint64),
+                )
             if max_depth is not None and depth >= max_depth:
                 cut = True
                 break
@@ -1502,6 +1686,8 @@ def check_sharded(
                             ovf_probe,
                             out_hi,
                             out_lo,
+                            sent_dig,
+                            recv_dig,
                         ) = steps[key](
                             put_global(frontier.reshape(D * bucket, K), shard1),
                             put_global(fvalid.reshape(D * bucket), shard1),
@@ -1571,6 +1757,42 @@ def check_sharded(
                         continue
                     dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
                     break
+                # exchange framing check (resilience.integrity): across
+                # the whole mesh, the received candidate multiset must
+                # combine to exactly the sent one — XOR/sum digests are
+                # commutative, so per-shard records compare globally.
+                # flip@exchange drives the detector's observation (like
+                # stall@level does the watchdog's): a real ICI bit flip
+                # desyncs the same two in-jit digests
+                if chain is not None:
+                    sd = np.asarray(fetch_global(sent_dig), np.uint32)
+                    rd = np.array(fetch_global(recv_dig), np.uint32)
+                    sp = fault.flip(
+                        "exchange", depth + 1, ckpt_depth=last_ckpt_depth
+                    )
+                    if sp:
+                        rd[sp.shard if sp.shard is not None else 0, 1] ^= 0x10
+                    _integ.count_check()
+
+                    def _combine(dig):
+                        s64 = dig.astype(np.uint64)
+                        return (
+                            int(dig[:, 0].astype(np.int64).sum()),
+                            int(np.bitwise_xor.reduce(dig[:, 1])),
+                            int(np.bitwise_xor.reduce(dig[:, 2])),
+                            int(s64[:, 3].sum() & np.uint64(0xFFFFFFFF)),
+                            int(s64[:, 4].sum() & np.uint64(0xFFFFFFFF)),
+                        )
+
+                    if _combine(sd) != _combine(rd):
+                        raise IntegrityError(
+                            "exchange",
+                            f"exchange payload framing mismatch at level "
+                            f"{depth + 1}: sent digest {_combine(sd)} != "
+                            f"received {_combine(rd)} ({exchange}; a "
+                            f"routed fingerprint was corrupted in flight)",
+                            depth=depth,
+                        )
                 # adapt buffer sizing from the committed attempt's guard counts
                 # (mirrors engine.check; no-op until escalation activates)
                 adapt.observe(_shard_density(fetch_global(act_guard), took))
@@ -1640,6 +1862,16 @@ def check_sharded(
                         if not c:
                             continue
                     next_pending[d].append(rows)
+                    if chain is not None:
+                        # fold this shard's new states into the level
+                        # digest via the numpy fingerprint twin (rows are
+                        # what the host actually keeps — digesting them,
+                        # then checking the chain against the device
+                        # fingerprints at save time, cross-checks the
+                        # two representations for free)
+                        chain.fold(
+                            _integ.fingerprint_rows(rows, spec.exact64)
+                        )
                     if collect_trace:
                         # step parents are d_src*bucket + i within this padded
                         # chunk -> level-global index in shard-major order
@@ -1672,6 +1904,11 @@ def check_sharded(
             if n_new:
                 levels.append(n_new)
                 total += n_new
+            if chain is not None:
+                if n_new:
+                    chain.seal(depth, n_new)
+                else:
+                    chain.reset_fold()
             if obs_.collect and is_coordinator():
                 enabled_total = int(lvl_act_en.sum())
                 # heartbeat-enveloped (kind/ts/unix): the per-level stats
@@ -1770,6 +2007,12 @@ def check_sharded(
             )
     except ResourceExhausted as e:
         exhausted = e
+    except IntegrityError as e:
+        integrity_fail = e
+    except (RunCorrupt, ParentLogCorrupt) as e:
+        # read-side storage checksum failure (spill runs / parent-log
+        # segments): silent on-disk corruption caught at consumption
+        integrity_fail = IntegrityError("storage", str(e), depth=depth)
     except OSError as e:
         if not is_disk_full(e):
             raise
@@ -1777,6 +2020,33 @@ def check_sharded(
         # injected paths: same typed clean exit (every writer cleans
         # up its tmp on failure, so the promoted state is intact)
         exhausted = ResourceExhausted("enospc", str(e), depth=depth)
+    if integrity_fail is not None:
+        # typed terminal (resilience.integrity): stamp the run manifest +
+        # shard heartbeat, then propagate for the CLI's exit-76 mapping;
+        # the restart resumes from the newest chain-verified generation
+        # (the load validators skip corrupted ones).  In a fleet the
+        # raising process exits 76 and its peers wedge in the next
+        # collective — the fleet supervisor tears down and restarts, the
+        # same contract as every shard-scoped fault
+        try:
+            _integ.record_violation(integrity_fail)
+            _shard_beat(
+                depth,
+                event="integrity-violation",
+                site=integrity_fail.site,
+                detail=integrity_fail.detail[:200],
+            )
+            obs_.abort(
+                "integrity-violation",
+                site=integrity_fail.site,
+                depth=integrity_fail.depth,
+                detail=integrity_fail.detail[:300],
+                distinct_states=total,
+            )
+            obs_.close()
+        except OSError:
+            pass
+        raise integrity_fail
     if exhausted is not None:
         # typed terminal: stamp the run manifest, mark the shard
         # heartbeat (fleet supervisors and `cli report` attribute the

@@ -18,6 +18,10 @@ share:
                   per-level deadline watchdog, soft-breach reclamation,
                   the typed RESOURCE_EXHAUSTED clean exit, and the
                   supervisor's --reclaim sweep);
+- `integrity`   — the silent-corruption defense (level digest chains,
+                  shadow re-execution sampling, the typed
+                  INTEGRITY_VIOLATION exit 76, and the jax-free chain
+                  validator shared by resume and `cli verify-checkpoint`);
 - `heartbeat`   — the shared JSONL heartbeat envelope ({kind, ts, unix})
                   written by the engines' per-level stats streams and
                   consumed by the supervisor's stall detector;
@@ -32,6 +36,7 @@ tunnel.
 
 from .checkpoints import CheckpointCorrupt, CheckpointStore
 from .faults import FaultPlan, InjectedCrash, InjectedFault, corrupt_file
+from .integrity import EXIT_INTEGRITY, IntegrityError, LevelDigestChain
 from .heartbeat import append_jsonl, heartbeat_record
 from .resources import (
     EXIT_RESOURCE_EXHAUSTED,
@@ -45,8 +50,11 @@ from .retry import RetryPolicy, classify
 __all__ = [
     "CheckpointCorrupt",
     "CheckpointStore",
+    "EXIT_INTEGRITY",
     "EXIT_RESOURCE_EXHAUSTED",
     "FaultPlan",
+    "IntegrityError",
+    "LevelDigestChain",
     "InjectedCrash",
     "InjectedFault",
     "ResourceExhausted",

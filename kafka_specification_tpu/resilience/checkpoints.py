@@ -115,12 +115,25 @@ class CheckpointStore:
         keep: int = 3,
         fault_plan: Optional[FaultPlan] = None,
         ident_aliases: tuple = (),
+        validators: tuple = (),
     ):
         """`ident_aliases`: additional identity strings accepted on LOAD
         (new saves always stamp `ident`).  The sharded engine passes its
         pre-elastic ident form (which baked the mesh layout in) so
         checkpoints written by older code stay resumable on the same
-        mesh after an upgrade."""
+        mesh after an upgrade.
+
+        `validators`: callables ``arrays -> list[str]`` run on each main
+        generation during load AFTER the CRC manifest passes; a non-empty
+        return marks the generation corrupt and `load()` falls back to
+        an older one exactly as it does for a checksum failure.  The
+        engines pass the digest-chain validator
+        (``resilience.integrity.checkpoint_chain_errors``) here — this is
+        what makes the supervisor's restart-after-exit-76 policy "resume
+        from the newest CHAIN-VERIFIED generation" without any new
+        supervisor machinery: a CRC-consistent corrupted generation (one
+        whose corruption happened before the write, so its checksums
+        faithfully cover corrupt content) simply never resumes."""
         if not basename.endswith(".npz"):
             raise ValueError(f"basename must end in .npz, got {basename!r}")
         self.directory = directory
@@ -129,6 +142,7 @@ class CheckpointStore:
         self.ident_aliases = tuple(ident_aliases)
         self.keep = max(1, int(keep))
         self.fault_plan = fault_plan
+        self.validators = tuple(validators)
         os.makedirs(directory, exist_ok=True)
         # startup janitor: a save killed mid-tmp-write leaves
         # `<name>.tmp.npz` behind (no manifest ever references it) —
@@ -304,6 +318,18 @@ class CheckpointStore:
                 errors.append(str(e))
                 continue
             self._check_ident(self.path(g), main)
+            val_errors = [
+                err for v in self.validators for err in v(main)
+            ]
+            if val_errors:
+                # semantically corrupt (CRC-consistent content corruption,
+                # e.g. a digest-chain mismatch): same fallback as a
+                # checksum failure — never resume a generation whose
+                # content fails validation
+                errors.extend(
+                    f"{self.path(g)}: {err}" for err in val_errors
+                )
+                continue
             depth = int(main["depth"]) if "depth" in main else None
             match = {"depth": depth}
             for k in ("mesh_D", "mesh_P"):
@@ -474,6 +500,19 @@ def verify_checkpoint_dir(directory: str, spill_dir=None) -> dict:
             gen_rep["depth"] = depth
             if "ident" in arrays:
                 gen_rep["ident"] = str(arrays["ident"])
+            # level-digest-chain validation (resilience.integrity): the
+            # layer ABOVE the per-array CRCs — a generation whose content
+            # was corrupted before the write has internally consistent
+            # checksums over corrupt data, and only the chain (linkage,
+            # levels agreement, cumulative visited digest) flags it
+            from .integrity import checkpoint_chain_errors
+
+            if "digest_chain" in arrays:
+                chain_errs = checkpoint_chain_errors(arrays)
+                gen_rep["digest_chain"] = "ok" if not chain_errs else "FAILED"
+                gen_rep["errors"].extend(chain_errs)
+            else:
+                gen_rep["digest_chain"] = "absent"
             match = {"depth": depth}
             for k in ("mesh_D", "mesh_P"):
                 if k in arrays:

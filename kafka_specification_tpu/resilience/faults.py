@@ -51,6 +51,44 @@ state still passes `cli verify-checkpoint`):
                               rehearsal; fires at the level-N boundary
                               once the run is durably past it)
 
+Bit-flip faults (the silent-data-corruption family — resilience.integrity;
+every one must be *detected* by the always-on integrity layer and end in a
+typed INTEGRITY_VIOLATION exit 76 whose on-disk state resumes from the
+newest chain-verified checkpoint generation):
+
+    flip@frontier:N           flip one bit in the in-memory frontier
+                              buffer at the level-N boundary (detected by
+                              the level digest chain's frontier verify)
+    flip@fpset:N              flip one bit in the visited-set dump taken
+                              for the first checkpoint past level N
+                              (detected by the save-time cumulative-digest
+                              self-check BEFORE the write — corruption
+                              never enters a checkpoint)
+    flip@exchange:N           the level-N sharded exchange framing check
+                              observes a corrupted payload digest on the
+                              scoped shard (like stall@level, the fault
+                              drives the detector's observation; the
+                              in-jit sent/received digests are what a
+                              real ICI bit flip would desync)
+    flip@spill:N              flip bytes in the Nth spill-run file of this
+                              process after its atomic promote (detected
+                              by the read-side CRC verify on the run's
+                              first lookup; N is a per-process ordinal —
+                              in-process test use, like crash@merge)
+    flip@ckpt:N               flip the `levels` array of the first
+                              checkpoint written past level N BEFORE its
+                              CRC manifest is built — a CRC-consistent
+                              corrupted generation (detected by the
+                              post-save chain read-back, by the resume
+                              path's chain validator, and by the offline
+                              `cli verify-checkpoint`)
+
+    Level-keyed flip sites (frontier/fpset/exchange/ckpt) use the same
+    checkpoint deferral as crash@level — on a checkpointing run they fire
+    only once a generation at or past N exists, so a supervised restart
+    resumes at or past N, the resume-depth relief applies, and the
+    restart converges instead of flip-looping.
+
 Shard scoping (the distributed engine's fault surface): any `@` fault may
 carry a `shard<d>:` scope immediately after the `@`, and the bare faults
 accept `@shard<d>` — the fault then fires only on the process that hosts
@@ -110,6 +148,51 @@ class InjectedCrash(InjectedFault):
     """An injected hard crash (the process is expected to die)."""
 
 
+#: THE single registry of injectable sites — the parser validates against
+#: it and `cli faults --list` renders it, so a new fault family cannot be
+#: added without becoming enumerable and parse-checked at the same time.
+#: kind -> (valid sites (None = bare fault), grammar form, description)
+FAULT_REGISTRY = (
+    ("crash", ("level", "ckpt", "merge"), "crash@level|ckpt|merge:N",
+     "raise InjectedCrash at the level-N boundary / mid-checkpoint-write "
+     "(tmp written, pre-promote) / mid-way through the Nth disk-run merge"),
+    ("corrupt_ckpt", ("ckpt",), "corrupt_ckpt[@ckpt:N]",
+     "corrupt the newest checkpoint right after its write (checksum-"
+     "fallback rehearsal); bytes flipped AFTER the CRC manifest, so the "
+     "zip/manifest checks catch it on load"),
+    ("compile_oom", None, "compile_oom",
+     "the next escalated chunk step raises an LLVM-OOM-shaped error once "
+     "(degrades fused/adaptive paths to the uniform fallback)"),
+    ("transient_device_err", None, "transient_device_err:N",
+     "the next N chunk/exchange steps raise a transient-classified "
+     "backend error (bounded-backoff retry rehearsal)"),
+    ("enospc", ("spill", "ckpt", "merge", "plog"),
+     "enospc@spill|ckpt|merge|plog:N",
+     "OSError(ENOSPC) at the writer's pre-promote point (typed "
+     "RESOURCE_EXHAUSTED exit 75; state stays verifiable)"),
+    ("stall", ("level",), "stall@level:N",
+     "the per-level deadline watchdog reports level N stalled (typed "
+     "exit 75)"),
+    ("flip", ("frontier", "fpset", "exchange", "spill", "ckpt"),
+     "flip@frontier|fpset|exchange|spill|ckpt:N",
+     "silent bit-flip at the named state surface (typed "
+     "INTEGRITY_VIOLATION exit 76; detected by the digest-chain / "
+     "framing / read-side-CRC layer — resilience.integrity)"),
+)
+
+_SITES_BY_KIND = {k: sites for k, sites, _g, _d in FAULT_REGISTRY}
+
+
+def list_faults() -> list:
+    """[{kind, grammar, description, scopeable}] for `cli faults --list`
+    (every fault composes with a `shard<d>:` scope)."""
+    return [
+        {"kind": k, "grammar": g, "sites": list(sites or ()),
+         "description": d, "scopeable": True}
+        for k, sites, g, d in FAULT_REGISTRY
+    ]
+
+
 @dataclass
 class _Spec:
     kind: str  # crash | corrupt_ckpt | compile_oom | transient_device_err
@@ -166,15 +249,21 @@ def _parse_token(tok: str) -> _Spec:
             # level (start_depth < N), so level 0 could never fire — reject
             # it instead of silently rehearsing nothing
             raise ValueError(f"fault {tok!r}: level must be >= 1")
-        if name == "crash" and point in ("level", "ckpt", "merge"):
-            return _Spec("crash", point, level, 1, shard)
-        if name == "corrupt_ckpt" and point == "ckpt":
-            return _Spec("corrupt_ckpt", "ckpt", level, 1, shard)
-        if name == "enospc" and point in ("spill", "ckpt", "merge", "plog"):
-            return _Spec("enospc", point, level, 1, shard)
-        if name == "stall" and point == "level":
-            return _Spec("stall", "level", level, 1, shard)
-        raise ValueError(f"unknown fault {tok!r}")
+        if name in _SITES_BY_KIND and _SITES_BY_KIND[name]:
+            if point in _SITES_BY_KIND[name]:
+                return _Spec(name, point, level, 1, shard)
+            # a typo'd SITE must be as loud as a typo'd kind: a silently
+            # no-op'd `crash@lvl:3` would report the drill as passed
+            raise ValueError(
+                f"fault {tok!r}: unknown site {point!r} for {name!r} "
+                f"(valid sites: {', '.join(_SITES_BY_KIND[name])}; "
+                f"run `cli faults --list` for the full grammar)"
+            )
+        raise ValueError(
+            f"unknown fault {tok!r} (known kinds: "
+            f"{', '.join(k for k, *_ in FAULT_REGISTRY)}; run "
+            f"`cli faults --list` for the full grammar)"
+        )
     name, _, count = tok.partition(":")
     if name == "corrupt_ckpt":
         if count:
@@ -187,12 +276,11 @@ def _parse_token(tok: str) -> _Spec:
             "transient_device_err", None, None, int(count) if count else 1
         )
     raise ValueError(
-        f"unknown fault {tok!r} (grammar: crash@level:N, crash@ckpt:N, "
-        f"crash@merge:N, corrupt_ckpt[@ckpt:N], compile_oom, "
-        f"transient_device_err:N, enospc@spill|ckpt|merge|plog:N, "
-        f"stall@level:N, each '@'-scopeable as "
-        f"crash@shard<d>:level:N / corrupt_ckpt@shard<d> / "
-        f"transient_device_err@shard<d>:N)"
+        f"unknown fault {tok!r} (grammar: "
+        + ", ".join(g for _k, _s, g, _d in FAULT_REGISTRY)
+        + "; each '@'-scopeable as crash@shard<d>:level:N / "
+        "corrupt_ckpt@shard<d> / transient_device_err@shard<d>:N; run "
+        "`cli faults --list` for descriptions)"
     )
 
 
@@ -344,6 +432,39 @@ class FaultPlan:
             if s.kind == "compile_oom" and s.budget > 0 and escalated:
                 s.budget -= 1
                 return RuntimeError(OOM_MARKER)
+        return None
+
+    def flip(self, site: str, n: int, ckpt_depth=None):
+        """The matching `flip@<site>:N` spec (truthy; carries the shard
+        scope so the sharded engine flips the TARGETED shard's buffer),
+        once per spec, else None — the caller then performs the actual
+        bit flip (or, for the exchange framing check, the
+        corrupted-digest observation) at its site.
+
+        Level-keyed sites (frontier/fpset/exchange/ckpt): `n` is a BFS
+        level; resume-depth relief applies, and with `ckpt_depth` given
+        (a checkpointing run's newest durable level) firing DEFERS until
+        a generation at or past the target exists — the same convergence
+        rule as FaultPlan.crash, so a supervised restart resumes at or
+        past N and never flip-loops.  `spill`: `n` is a per-process
+        ordinal (in-process test use, like crash@merge)."""
+        for s in self.specs:
+            if s.kind != "flip" or s.point != site or s.budget <= 0:
+                continue
+            if not self._is_local(s):
+                continue
+            if site == "spill":
+                if n != s.arg:
+                    continue
+            else:
+                if self.start_depth >= s.arg:
+                    continue  # resumed at/past the target: counts as fired
+                if n < s.arg:
+                    continue
+                if ckpt_depth is not None and ckpt_depth < s.arg:
+                    continue  # not durably past the target yet: defer
+            s.budget -= 1
+            return s
         return None
 
     def should_corrupt(self, depth: int) -> bool:

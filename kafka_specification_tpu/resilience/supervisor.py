@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .heartbeat import append_jsonl, heartbeat_record
+from .integrity import EXIT_INTEGRITY
 from .resources import EXIT_RESOURCE_EXHAUSTED, reclaim_disk
 
 
@@ -232,6 +233,15 @@ def supervise(cfg: SupervisorConfig) -> int:
                 _try_reclaim(cfg, attempt)
                 continue
             return _resource_verdict(cfg, attempt, rc, reclaimed)
+        if rc == EXIT_INTEGRITY:
+            # integrity violations (exit 76, resilience.integrity) ARE
+            # restartable — the child's resume path skips corrupted
+            # generations via the digest-chain validators, so the restart
+            # resumes from the newest CHAIN-VERIFIED checkpoint
+            # generation.  Restarts stay bounded by the normal budget:
+            # persistent violations (failing DIMM, rotting disk) must
+            # converge to a give-up, never a corruption-retry hot-loop
+            cfg.event(event="integrity-violation", attempt=attempt, rc=rc)
         if restarts_used >= cfg.max_restarts:
             break
         restarts_used += 1
@@ -491,6 +501,17 @@ def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> str:
             if failed is not None:
                 # one shard's process died: the rest are (or will be)
                 # wedged in a collective — fail the whole attempt
+                if done[failed] == EXIT_INTEGRITY:
+                    # typed integrity exit: restartable like a crash (the
+                    # resume path skips chain-failed generations), but
+                    # the classification is recorded for attribution
+                    cfg.event(
+                        event="shard-integrity-violation",
+                        attempt=attempt,
+                        proc=failed,
+                        pid=children[failed].pid,
+                        rc=done[failed],
+                    )
                 cfg.event(
                     event="shard-exit",
                     attempt=attempt,

@@ -43,6 +43,7 @@ from ..obs import RunContext
 from ..obs.metrics import MetricsRegistry
 from ..resilience.faults import FaultPlan
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..resilience.integrity import EXIT_INTEGRITY, IntegrityError
 from ..resilience.resources import ResourceExhausted
 from .batch import Member, derive_member, explore_shared
 from .kernel_cache import (
@@ -373,6 +374,37 @@ class Daemon:
                 except Exception:  # noqa: BLE001 — a second ENOSPC must
                     pass  # not crash the daemon; the claim stays for the
                     # next janitor
+            return n
+        except IntegrityError as e:
+            # typed like the resource path: the engine stamped the run
+            # manifest 'integrity-violation' and closed its observer;
+            # each member job gets an rc-76 verdict and the daemon (and
+            # its sibling jobs) keeps serving — one tenant's corrupted
+            # run never takes the service down
+            self._close_run(leader_ctx, None)
+            self._event(
+                "job-integrity-violation", tenant=tenant, site=e.site,
+                jobs=[s["job_id"] for s in specs],
+            )
+            n = 0
+            for spec in specs:
+                try:
+                    self._finish_job(
+                        spec,
+                        self._stamp(
+                            spec,
+                            error_verdict(
+                                f"INTEGRITY_VIOLATION[{e.site}]: "
+                                f"{e.detail}",
+                                run_id=leader_ctx.run_id,
+                                exit_code=EXIT_INTEGRITY,
+                            ),
+                            status="integrity-violation",
+                        ),
+                    )
+                    n += 1
+                except Exception:  # noqa: BLE001 — same belt as rc-75
+                    pass
             return n
         except Exception as e:  # noqa: BLE001 — keep the daemon alive
             # the engine does NOT close its observer on a generic raise:

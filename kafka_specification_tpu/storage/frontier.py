@@ -105,11 +105,18 @@ class FrontierReader:
                 f"level {self.level}: segment rows sum {self._starts[-1]} "
                 f"!= manifest rows {self.rows}"
             )
+        # segments verify on READ, not just at resume: verify=False (the
+        # writer's own freshly-cut reader) defers each segment's content
+        # CRC to its first read instead of skipping it, so a bit flipped
+        # on disk between the cut and the replay is caught at consumption
+        # time (once per segment; replays re-read segments every chunk and
+        # must not re-CRC every time)
+        self._read_verified: set = set()
         if verify:
-            for s in manifest["segments"]:
-                self._open(s, verify=True)
+            for s in manifest["segments"]:  # eager warm-up verify pass
+                self._open(s)
 
-    def _open(self, seg: dict, verify: bool = False) -> np.ndarray:
+    def _open(self, seg: dict) -> np.ndarray:
         path = os.path.join(self.dir, seg["name"])
         n = int(seg["rows"])
         if not os.path.exists(path) or os.path.getsize(path) != (
@@ -120,8 +127,10 @@ class FrontierReader:
             path, dtype=np.uint32, mode="r", offset=_HEADER,
             shape=(n, self.K),
         )
-        if verify and zlib.crc32(arr.tobytes()) != int(seg["crc32"]):
-            raise SegmentCorrupt(f"{path}: content CRC mismatch")
+        if seg["name"] not in self._read_verified:
+            if zlib.crc32(arr.tobytes()) != int(seg["crc32"]):
+                raise SegmentCorrupt(f"{path}: content CRC mismatch")
+            self._read_verified.add(seg["name"])
         return arr
 
     def paths(self) -> list:

@@ -78,8 +78,16 @@ class SortedRun:
             self.path, dtype=np.uint64, mode="r", offset=_HEADER,
             shape=(self.count,),
         )
-        if verify and zlib.crc32(self.arr.tobytes()) != int(meta["crc32"]):
-            raise RunCorrupt(f"{self.path}: content CRC mismatch")
+        # verify=False (a run this process just wrote) defers the content
+        # CRC to the FIRST lookup instead of skipping it: reads verify,
+        # not just writes — a bit flipped on disk between the atomic
+        # promote and the first probe (resilience.integrity's flip@spill
+        # rehearsal, or real bit rot under a long-lived run) is caught at
+        # consumption time, before a wrong membership answer can corrupt
+        # the search
+        self._read_verified = False
+        if verify:
+            self._verify_content()
         bloom_path = self.path + ".bloom"
         self.bloom = BloomFilter.load(bloom_path)
         if self.bloom is None:  # missing/rotted sidecar: rebuild, re-save
@@ -91,11 +99,21 @@ class SortedRun:
         self.bloom_maybe = 0  # of those, bloom said "maybe" (disk touched)
         self.hits = 0  # of those, actually present
 
+    def _verify_content(self) -> None:
+        if zlib.crc32(self.arr.tobytes()) != int(self.meta["crc32"]):
+            raise RunCorrupt(f"{self.path}: content CRC mismatch")
+        self._read_verified = True
+
     def contains(self, fps: np.ndarray) -> np.ndarray:
         """Exact membership mask for a (possibly unsorted) query batch."""
         out = np.zeros(fps.shape[0], bool)
         if not self.count:
             return out
+        if not self._read_verified:
+            # read-side integrity: one full-content CRC at first lookup
+            # (unconditional — the bloom/interval gates must not be able
+            # to defer detection indefinitely), then mmap reads as usual
+            self._verify_content()
         cand = (fps >= self.lo) & (fps <= self.hi)
         if not cand.any():
             return out
@@ -126,6 +144,13 @@ def merge_runs(runs: list, out_path: str, block: int = 1 << 20,
     before the atomic promote — the mid-merge torn-write injection point
     (`KSPEC_FAULT=crash@merge:N`).  -> the merged run's manifest entry.
     """
+    # every input must pass its content CRC BEFORE its values are
+    # streamed: merging an as-yet-unverified corrupt run would launder
+    # the corruption into a merged run with a fresh VALID checksum,
+    # defeating the read-side verification contract permanently
+    for r in runs:
+        if not r._read_verified:
+            r._verify_content()
     cursors = [0] * len(runs)
     state = {"crc": 0, "total": 0, "lo": None, "hi": None}
     # the filter's bit count is fixed at build time — size it for the final

@@ -250,6 +250,9 @@ class TieredFpSet:
     def dump(self) -> np.ndarray:
         """Every fingerprint, hot + disk (tests / tiny sets only — the
         whole point of this class is that this does not fit in RAM)."""
+        for r in self.runs:  # read-side CRC: dumps verify like lookups
+            if not r._read_verified:
+                r._verify_content()
         parts = [self.hot.dump()] + [np.asarray(r.arr) for r in self.runs]
         return np.concatenate(parts) if parts else np.empty(0, np.uint64)
 
@@ -328,6 +331,16 @@ class TieredFpSet:
                 path, fps, bloom_path=path + ".bloom", before_replace=hook
             )
         _met.inc("kspec_spill_runs_total")
+        if self.fault_plan is not None and self.fault_plan.flip(
+            "spill", self.spills + 1
+        ):
+            # silent on-disk corruption AFTER the atomic promote (the
+            # window atomic writes cannot close): caught by the run's
+            # read-side CRC on its first lookup (SortedRun.contains),
+            # typed INTEGRITY_VIOLATION by the engines
+            from ..resilience.faults import corrupt_file
+
+            corrupt_file(path)
         self.runs.append(SortedRun(self.dir, meta, verify=False))
         self.disk_n += fps.shape[0]
         self.spills += 1

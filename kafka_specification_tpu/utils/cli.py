@@ -44,6 +44,9 @@ _COMPUTE_TIMEOUT = int(os.environ.get("KSPEC_CLI_COMPUTE_TIMEOUT", "90"))
 # typed resource exit (resilience.resources) — duplicated as a literal for
 # help strings; asserted equal at the use site
 _EXIT_RESOURCE_EXHAUSTED = 75
+# typed integrity exit (resilience.integrity): a state-integrity check
+# tripped; resume skips chain-failed generations automatically
+_EXIT_INTEGRITY = 76
 
 
 def _enable_compile_cache():
@@ -263,8 +266,23 @@ def main(argv=None):
         "--fault",
         metavar="PLAN",
         help="deterministic fault injection plan (sets KSPEC_FAULT; e.g. "
-        "'crash@level:7', 'corrupt_ckpt', 'transient_device_err:2' — "
-        "grammar in docs/resilience.md)",
+        "'crash@level:7', 'corrupt_ckpt', 'flip@frontier:3', "
+        "'transient_device_err:2' — `cli faults --list` enumerates every "
+        "injectable site; grammar in docs/resilience.md)",
+    )
+    pc.add_argument(
+        "--integrity-shadow",
+        type=float,
+        metavar="RATE",
+        help="sampled shadow re-execution rate in [0,1] "
+        "(KSPEC_INTEGRITY_SHADOW is the env twin): deterministically "
+        "sampled chunks re-run through an independent path (the legacy "
+        "pipeline / host fingerprint oracle) and must match the primary "
+        "result bit-for-bit; a mismatch exits typed "
+        f"INTEGRITY_VIOLATION (code {_EXIT_INTEGRITY}).  The per-level "
+        "digest chain and storage read-side checksums are always on "
+        "regardless (KSPEC_INTEGRITY=0 disables; docs/resilience.md).  "
+        "Single-device engine only",
     )
     pc.add_argument(
         "--resilient",
@@ -398,6 +416,18 @@ def main(argv=None):
     )
     pvc.add_argument("--json", action="store_true",
                      help="machine-readable report")
+
+    pf = sub.add_parser(
+        "faults",
+        help="enumerate every injectable fault site (the KSPEC_FAULT / "
+        "--fault grammar) from the single registry the parser validates "
+        "against — never imports jax",
+    )
+    pf.add_argument(
+        "--list", action="store_true", dest="list_faults",
+        help="list the fault registry (the default action)",
+    )
+    pf.add_argument("--json", action="store_true")
 
     pr = sub.add_parser(
         "report",
@@ -602,6 +632,24 @@ def main(argv=None):
 
     args = p.parse_args(argv)
 
+    if args.cmd == "faults":
+        # pure registry dump (resilience.faults.FAULT_REGISTRY): jax-free
+        from ..resilience.faults import list_faults
+
+        entries = list_faults()
+        if args.json:
+            print(json.dumps(entries))
+            return 0
+        print("Injectable faults (KSPEC_FAULT / --fault; comma-separate "
+              "to compose; every fault takes a `shard<d>:` scope after "
+              "the '@'):")
+        for e in entries:
+            print(f"  {e['grammar']}")
+            print(f"      {e['description']}")
+        print("Examples: crash@level:7   enospc@spill:2   "
+              "flip@shard1:exchange:3   corrupt_ckpt@ckpt:4")
+        return 0
+
     if args.cmd == "verify-checkpoint":
         # like `report`, this must run on a box whose accelerator is
         # wedged (that is when an operator reaches for it): jax-free
@@ -742,6 +790,19 @@ def main(argv=None):
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+
+    if args.cmd == "check" and args.sharded \
+            and getattr(args, "integrity_shadow", None):
+        # shadow re-execution is a single-device-engine oracle; silently
+        # dropping the flag on a sharded run would report a clean pass an
+        # operator (sent here by the report's own guidance) would trust
+        print(
+            "error: --integrity-shadow is single-device only (the shadow "
+            "oracles are the legacy pipeline + host fingerprint oracle); "
+            "re-run without --sharded to localize corruption",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.cmd == "check" and args.fault:
         from ..resilience.faults import FaultPlan
@@ -930,16 +991,53 @@ def main(argv=None):
 
         prof = jax.profiler.trace(args.profile)
     chunk_kw = {} if args.chunk_size is None else {"chunk_size": args.chunk_size}
+    from ..resilience.integrity import EXIT_INTEGRITY, IntegrityError
     from ..resilience.resources import (
         EXIT_RESOURCE_EXHAUSTED,
         ResourceExhausted,
     )
 
     assert EXIT_RESOURCE_EXHAUSTED == _EXIT_RESOURCE_EXHAUSTED
+    assert EXIT_INTEGRITY == _EXIT_INTEGRITY
     try:
         with prof:
             res = _run_engine(args, model, tlc_cfg, progress, chunk_kw,
                               run=run_ctx)
+    except IntegrityError as e:
+        # typed integrity terminal: the run's DATA failed a check (digest
+        # chain / shadow / framing / read-side CRC), the manifest is
+        # stamped `integrity-violation`, and the distinct exit code lets
+        # supervisors restart from the newest chain-verified generation
+        # (corrupted ones are skipped by the resume-path validators)
+        print(f"INTEGRITY VIOLATION: {e}", file=sys.stderr)
+        if args.json:
+            from ..service.verdict import error_verdict
+
+            json.dump(
+                error_verdict(
+                    f"INTEGRITY_VIOLATION[{e.site}]: {e.detail}",
+                    run_id=run_ctx.run_id if run_ctx is not None else None,
+                    exit_code=EXIT_INTEGRITY,
+                ),
+                sys.stdout,
+            )
+            print()
+        if args.checkpoint:
+            print(
+                f"  re-running resumes from the newest chain-verified "
+                f"generation in {args.checkpoint} (verify offline with "
+                f"`... verify-checkpoint {args.checkpoint}`).  Recurring "
+                f"violations on one host suggest failing hardware",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "  no --checkpoint was configured: a re-run starts over "
+                "(add --checkpoint so integrity exits resume from the "
+                "newest chain-verified generation)",
+                file=sys.stderr,
+            )
+        return EXIT_INTEGRITY
     except ResourceExhausted as e:
         # the typed terminal: the engine already checkpointed what it
         # could, stamped the run manifest, and left every promoted
@@ -1154,6 +1252,8 @@ def _print_verify_checkpoint(rep: dict) -> None:
             bits = [f"gen {g['gen']}", f"depth {g.get('depth')}"]
             if "mesh_D" in g:
                 bits.append(f"shards {g['mesh_D']} x procs {g.get('mesh_P')}")
+            if g.get("digest_chain") and g["digest_chain"] != "absent":
+                bits.append(f"chain {g['digest_chain']}")
             if g.get("parts"):
                 bits.append(
                     "parts " + ",".join(
@@ -1338,6 +1438,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw, run=None):
             stats_path=args.stats,
             visited_backend=args.visited_backend,
             pipeline=getattr(args, "pipeline", None),
+            integrity_shadow=getattr(args, "integrity_shadow", None),
             **store_kw,
             **chunk_kw,
         )

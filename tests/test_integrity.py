@@ -1,0 +1,729 @@
+"""End-to-end state-integrity defense (resilience.integrity).
+
+Every `flip@` injection site must be DETECTED by the always-on layer,
+exit typed (IntegrityError -> CLI exit 76) with the run manifest stamped
+`integrity-violation`, and a restart must complete bit-identically from
+the newest chain-verified checkpoint generation — on both engines,
+including a shard-scoped case.  Plus: the offline `cli verify-checkpoint`
+must flag a corrupted generation whose per-array CRCs still pass, the
+digest chain must be engine/pipeline/layout-invariant, and shadow
+re-execution must be clean on healthy runs and catch injected
+divergence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import jax
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import finite_replicated_log as frl
+from kafka_specification_tpu.models import variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.parallel.sharded import check_sharded
+from kafka_specification_tpu.resilience import integrity
+from kafka_specification_tpu.resilience.checkpoints import (
+    CheckpointStore,
+    build_manifest,
+    verify_checkpoint_dir,
+    verify_file,
+)
+from kafka_specification_tpu.resilience.faults import FaultPlan, list_faults
+from kafka_specification_tpu.resilience.integrity import (
+    EXIT_INTEGRITY,
+    IntegrityError,
+    LevelDigestChain,
+    checkpoint_chain_errors,
+    digest_fps,
+    fingerprint_rows,
+    pair_u64,
+)
+
+pytestmark = pytest.mark.integrity
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TINY = Config(n_replicas=2, log_size=2, max_records=1, max_leader_epoch=1)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices("cpu")[:n]), ("d",))
+
+
+def _mk_violating():
+    return variants.make_model(
+        "KafkaTruncateToHighWatermark", TINY, ("TypeOk", "WeakIsr")
+    )
+
+
+def _verdict(res):
+    return (
+        res.total,
+        res.diameter,
+        tuple(res.levels),
+        res.ok,
+        (res.violation.invariant, res.violation.depth)
+        if res.violation
+        else None,
+    )
+
+
+# --- the numpy fingerprint twin ------------------------------------------
+
+
+def test_numpy_fingerprint_matches_jax():
+    """fingerprint_rows must be bit-exact with the jax kernel (hashed and
+    exact modes) — it is what the digest fold, the frontier verify and
+    the shadow host-oracle trust."""
+    from kafka_specification_tpu.ops.fingerprint import fingerprint_lanes
+
+    rng = np.random.default_rng(7)
+    for k in (1, 2, 5, 9):
+        rows = rng.integers(0, 2**32, size=(257, k), dtype=np.uint32)
+        hi, lo = fingerprint_lanes(jax.numpy.asarray(rows), False)
+        assert np.array_equal(
+            pair_u64(np.asarray(hi), np.asarray(lo)),
+            fingerprint_rows(rows, False),
+        )
+    for k in (1, 2):
+        rows = rng.integers(0, 2**32, size=(64, k), dtype=np.uint32)
+        hi, lo = fingerprint_lanes(jax.numpy.asarray(rows), True)
+        assert np.array_equal(
+            pair_u64(np.asarray(hi), np.asarray(lo)),
+            fingerprint_rows(rows, True),
+        )
+
+
+# --- chain algebra --------------------------------------------------------
+
+
+def test_digest_chain_order_invariant_and_roundtrips():
+    fps = (np.arange(50, dtype=np.uint64) * np.uint64(0x9E3779B97F4A7C15))
+    a = LevelDigestChain()
+    a.fold(fps[:20])
+    a.fold(fps[20:])
+    a.seal(0, 50)
+    b = LevelDigestChain()
+    for chunk in np.array_split(fps[::-1], 7):  # any order, any chunking
+        b.fold(chunk)
+    b.seal(0, 50)
+    assert a.entries == b.entries
+    c = LevelDigestChain.from_array(a.to_array())
+    assert c.entries == a.entries
+    # count disagreement between accounting and folded multiset is itself
+    # a violation
+    d = LevelDigestChain()
+    d.fold(fps[:10])
+    with pytest.raises(IntegrityError):
+        d.seal(0, 11)
+
+
+def test_chain_validator_flags_tampered_arrays():
+    chain = LevelDigestChain()
+    for d, n in enumerate((1, 4, 12)):
+        chain.fold(np.arange(n, dtype=np.uint64) + np.uint64(1000 * d))
+        chain.seal(d, n)
+    arrays = {
+        "digest_chain": chain.to_array(),
+        "levels": np.asarray([1, 4, 12]),
+        "total": 17,
+    }
+    assert checkpoint_chain_errors(arrays) == []
+    bad = dict(arrays, levels=np.asarray([1, 5, 11]))
+    assert checkpoint_chain_errors(bad)
+    tampered = arrays["digest_chain"].copy()
+    tampered[1, 1] ^= np.uint64(1)
+    assert checkpoint_chain_errors(dict(arrays, digest_chain=tampered))
+    assert checkpoint_chain_errors(dict(arrays, total=18))
+    # fpset cumulative digest: the stored visited multiset must match
+    fps = np.concatenate(
+        [np.arange(n, dtype=np.uint64) + np.uint64(1000 * d)
+         for d, n in enumerate((1, 4, 12))]
+    )
+    ok = dict(arrays, host_fps=fps)
+    assert checkpoint_chain_errors(ok) == []
+    flipped = fps.copy()
+    flipped[3] ^= np.uint64(1 << 17)
+    assert checkpoint_chain_errors(dict(arrays, host_fps=flipped))
+
+
+# --- fault grammar + registry (satellite) ---------------------------------
+
+
+def test_flip_grammar_parses_and_scopes():
+    p = FaultPlan(
+        "flip@frontier:3,flip@shard2:exchange:4,flip@spill:1,"
+        "flip@ckpt:2,flip@fpset:5"
+    )
+    assert [(s.kind, s.point, s.arg, s.shard) for s in p.specs] == [
+        ("flip", "frontier", 3, None),
+        ("flip", "exchange", 4, 2),
+        ("flip", "spill", 1, None),
+        ("flip", "ckpt", 2, None),
+        ("flip", "fpset", 5, None),
+    ]
+
+
+def test_unknown_site_rejected_loudly_with_valid_sites():
+    """A typo'd SITE (not just a typo'd kind) must fail at parse with an
+    actionable message naming the valid sites (satellite fix)."""
+    for bad, expect in (
+        ("crash@lvl:3", "level, ckpt, merge"),
+        ("flip@frntier:2", "frontier, fpset, exchange, spill, ckpt"),
+        ("enospc@frontier:1", "spill, ckpt, merge, plog"),
+        ("stall@ckpt:1", "level"),
+    ):
+        with pytest.raises(ValueError) as ei:
+            FaultPlan(bad)
+        assert expect in str(ei.value), (bad, str(ei.value))
+        assert "faults --list" in str(ei.value)
+    with pytest.raises(ValueError) as ei:
+        FaultPlan("bogus@level:1")
+    assert "known kinds" in str(ei.value)
+
+
+def test_fault_registry_enumerates_every_kind():
+    entries = list_faults()
+    kinds = {e["kind"] for e in entries}
+    assert kinds == {
+        "crash", "corrupt_ckpt", "compile_oom", "transient_device_err",
+        "enospc", "stall", "flip",
+    }
+    flip = next(e for e in entries if e["kind"] == "flip")
+    assert set(flip["sites"]) == {
+        "frontier", "fpset", "exchange", "spill", "ckpt"
+    }
+
+
+def test_cli_faults_list_is_jax_free_registry_dump(capsys):
+    from kafka_specification_tpu.utils.cli import main as cli_main
+
+    assert cli_main(["faults", "--json"]) == 0
+    entries = json.loads(capsys.readouterr().out)
+    assert {e["kind"] for e in entries} >= {"flip", "crash", "enospc"}
+    assert cli_main(["faults"]) == 0
+    out = capsys.readouterr().out
+    assert "flip@frontier|fpset|exchange|spill|ckpt:N" in out
+
+
+def test_flip_deferral_and_resume_relief():
+    p = FaultPlan("flip@frontier:3")
+    assert not p.flip("frontier", 2)
+    assert not p.flip("frontier", 3, ckpt_depth=2)  # not durable: defer
+    assert p.flip("frontier", 3, ckpt_depth=3)
+    assert not p.flip("frontier", 4, ckpt_depth=4)  # budget spent
+    p2 = FaultPlan("flip@frontier:3")
+    p2.set_start_depth(3)  # resumed at/past target: counts as fired
+    assert not p2.flip("frontier", 3, ckpt_depth=3)
+    p3 = FaultPlan("flip@spill:2")
+    assert not p3.flip("spill", 1)
+    assert p3.flip("spill", 2)
+
+
+# --- the fault matrix: every site detected, typed, recovered --------------
+
+
+@pytest.mark.parametrize(
+    "site,backend",
+    [
+        ("frontier", "device"),
+        ("fpset", "device"),
+        ("fpset", "host"),
+        ("fpset", "device-hash"),
+        ("ckpt", "device"),
+    ],
+)
+def test_flip_detected_and_recovered_single_device(
+    tmp_path, monkeypatch, site, backend
+):
+    """Single-device matrix: flip injected -> typed IntegrityError; a
+    restart (fault cleared, as after the one-shot fired) resumes from the
+    newest chain-verified generation bit-identically."""
+    model = frl.make_model(2, 2, 2)
+    golden = _verdict(check(model, min_bucket=32, store_trace=False))
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", f"flip@{site}:2")
+    with pytest.raises(IntegrityError) as ei:
+        check(model, min_bucket=32, checkpoint_dir=ck,
+              visited_backend=backend)
+    assert ei.value.site in (site, "ckpt", "fpset", "frontier")
+    monkeypatch.delenv("KSPEC_FAULT")
+    rep = verify_checkpoint_dir(ck)
+    assert rep["ok"], rep  # a chain-verified generation survives
+    resumed = check(model, min_bucket=32, checkpoint_dir=ck,
+                    visited_backend=backend)
+    assert _verdict(resumed) == golden
+    assert resumed.total == 49
+
+
+def test_flip_spill_detected_on_read_and_recovered(tmp_path, monkeypatch):
+    """flip@spill corrupts a promoted run file; the read-side CRC catches
+    it at the next lookup; resume falls back past every generation that
+    references the corrupt file (the deterministic re-run rewrites it)."""
+    model = frl.make_model(2, 2, 2)
+    golden = _verdict(check(model, min_bucket=32, store_trace=False))
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", "flip@spill:1")
+    with pytest.raises(IntegrityError) as ei:
+        check(model, min_bucket=32, checkpoint_dir=ck, mem_budget=256,
+              store="disk")
+    assert ei.value.site == "storage"
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check(model, min_bucket=32, checkpoint_dir=ck,
+                    mem_budget=256, store="disk")
+    assert _verdict(resumed) == golden
+
+
+@pytest.mark.parametrize("site", ["frontier", "exchange", "fpset", "ckpt"])
+def test_flip_detected_and_recovered_sharded(tmp_path, monkeypatch, site):
+    model = frl.make_model(2, 2, 2)
+    golden = _verdict(check_sharded(model, min_bucket=32,
+                                    store_trace=False))
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", f"flip@{site}:2")
+    with pytest.raises(IntegrityError):
+        check_sharded(model, min_bucket=32, checkpoint_dir=ck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check_sharded(model, min_bucket=32, checkpoint_dir=ck)
+    assert _verdict(resumed) == golden
+    assert resumed.total == 49
+
+
+def test_flip_shard_scoped_targets_one_shard(tmp_path, monkeypatch):
+    """The acceptance matrix's shard<d>:-scoped case: the flip lands in
+    the targeted shard's buffer and is still detected globally."""
+    model = frl.make_model(2, 2, 2)
+    golden = _verdict(check_sharded(model, min_bucket=32,
+                                    store_trace=False))
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", "flip@shard1:frontier:2")
+    with pytest.raises(IntegrityError):
+        check_sharded(model, min_bucket=32, checkpoint_dir=ck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check_sharded(model, min_bucket=32, checkpoint_dir=ck)
+    assert _verdict(resumed) == golden
+
+
+def test_flip_recovery_preserves_trace_values_both_engines(
+    tmp_path, monkeypatch
+):
+    """Counts AND trace VALUES bit-identical after a flip -> restart, on
+    a violating workload (the acceptance criterion's strongest clause).
+    The golden is the same storage configuration run fault-free: the
+    disk-tier parent log's trace is pinned against ITS OWN fault-free
+    twin (disk-vs-RAM trace equivalence is test_storage's concern)."""
+    golden = check(_mk_violating(), min_bucket=32, mem_budget=512,
+                   store="disk")
+    assert golden.violation is not None and golden.violation.depth == 8
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", "flip@frontier:3")
+    with pytest.raises(IntegrityError):
+        check(_mk_violating(), min_bucket=32, checkpoint_dir=ck,
+              mem_budget=512, store="disk")
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check(_mk_violating(), min_bucket=32, checkpoint_dir=ck,
+                    mem_budget=512, store="disk")
+    assert resumed.violation is not None
+    assert resumed.violation.invariant == golden.violation.invariant
+    assert resumed.violation.depth == golden.violation.depth
+    assert resumed.violation.trace == golden.violation.trace
+
+    sgolden = check_sharded(_mk_violating(), mesh=_mesh(2), min_bucket=32)
+    assert sgolden.violation is not None
+    sck = str(tmp_path / "sck")
+    monkeypatch.setenv("KSPEC_FAULT", "flip@frontier:3")
+    with pytest.raises(IntegrityError):
+        check_sharded(_mk_violating(), mesh=_mesh(2), min_bucket=32,
+                      checkpoint_dir=sck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    sresumed = check_sharded(_mk_violating(), mesh=_mesh(2), min_bucket=32,
+                             checkpoint_dir=sck)
+    assert sresumed.violation is not None
+    assert sresumed.violation.trace == sgolden.violation.trace
+
+
+def test_integrity_violation_stamps_manifest_and_metrics(
+    tmp_path, monkeypatch
+):
+    """The obs contract: manifest status `integrity-violation` (what `cli
+    report`'s verdict beat keys on) + the violation event + counters."""
+    from kafka_specification_tpu.obs import RunContext
+    from kafka_specification_tpu.obs.report import report_data
+
+    model = frl.make_model(2, 2, 2)
+    run_dir = str(tmp_path / "run")
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_FAULT", "flip@frontier:2")
+    run = RunContext(run_dir)
+    with pytest.raises(IntegrityError):
+        check(model, min_bucket=32, checkpoint_dir=ck, run=run)
+    monkeypatch.delenv("KSPEC_FAULT")
+    man = json.load(open(os.path.join(run_dir, "manifest.json")))
+    assert man["status"] == "integrity-violation"
+    assert man["result"]["site"] == "frontier"
+    rep = report_data(run_dir)
+    assert rep["verdict"]["status"] == "integrity-violation"
+    integ = rep["integrity"]
+    assert integ["violations"] >= 1
+    assert integ["checks"] >= 1
+    assert any(
+        e.get("event") == "integrity-violation" for e in rep["timeline"]
+    )
+
+
+# --- the offline verifier vs CRC-consistent corruption --------------------
+
+
+def test_verify_checkpoint_flags_crc_passing_corruption(tmp_path):
+    """Hand-craft the corruption class CRCs cannot see: rewrite a
+    generation's `levels` with the manifest REBUILT over the corrupt
+    content.  verify_file passes; the digest chain flags it; a fresh
+    engine resume skips it."""
+    model = frl.make_model(2, 2, 2)
+    ck = str(tmp_path / "ck")
+    res = check(model, min_bucket=32, checkpoint_dir=ck)
+    assert res.total == 49
+    path = os.path.join(ck, "bfs_checkpoint.npz")
+    arrays = verify_file(path)
+    arrays["levels"] = np.asarray(arrays["levels"])
+    arrays["levels"][2] += 7  # silent content corruption
+    man = {"__manifest__": json.dumps(build_manifest(arrays))}
+    np.savez(path, **man, **arrays)
+    assert verify_file(path) is not None  # the CRC-only check PASSES
+    rep = verify_checkpoint_dir(ck)
+    gen0 = rep["stores"][0]["generations"][0]
+    assert gen0["digest_chain"] == "FAILED"
+    assert not gen0["ok"]
+    assert rep["ok"]  # an older chain-verified generation still resumes
+    resumed = check(model, min_bucket=32, checkpoint_dir=ck)
+    assert resumed.total == 49
+
+
+def test_verify_checkpoint_is_jax_free(tmp_path, monkeypatch):
+    """`cli verify-checkpoint` (incl. chain validation) must run with a
+    poisoned jax — the operator's box may have a wedged accelerator."""
+    model = frl.make_model(2, 2, 2)
+    ck = str(tmp_path / "ck")
+    check(model, min_bucket=32, checkpoint_dir=ck)
+    code = (
+        "import sys; sys.modules['jax'] = None\n"
+        "from kafka_specification_tpu.utils.cli import main\n"
+        f"raise SystemExit(main(['verify-checkpoint', {ck!r}, '--json']))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=_REPO, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout)
+    assert rep["ok"]
+    assert rep["stores"][0]["generations"][0]["digest_chain"] == "ok"
+
+
+# --- shadow re-execution --------------------------------------------------
+
+
+def test_shadow_clean_on_healthy_run_and_bit_identical():
+    model = frl.make_model(2, 2, 2)
+    base = check(model, min_bucket=32)
+    shadowed = check(model, min_bucket=32, integrity_shadow=1.0)
+    assert _verdict(shadowed) == _verdict(base)
+    assert shadowed.violation == base.violation
+
+
+def test_shadow_host_oracle_catches_corrupted_fingerprints(monkeypatch):
+    """Corrupt the committed chunk fingerprints between the kernel and
+    the host (the wire the host oracle guards) -> typed shadow violation."""
+    from kafka_specification_tpu.engine import pipeline as pl
+
+    orig = pl.FusedPipeline.run_chunk
+
+    def corrupting(self, piece, fp_n, bucket, depth, vhi, vlo, vn, vcap):
+        outs = orig(self, piece, fp_n, bucket, depth, vhi, vlo, vn, vcap)
+        out_hi = np.array(outs[12])
+        nn = int(outs[3])
+        if nn:
+            out_hi[0] ^= np.uint32(1 << 9)
+            return outs[:12] + (out_hi,) + outs[13:]
+        return outs
+
+    monkeypatch.setattr(pl.FusedPipeline, "run_chunk", corrupting)
+    with pytest.raises(IntegrityError) as ei:
+        check(frl.make_model(2, 2, 2), min_bucket=32, integrity_shadow=1.0)
+    assert ei.value.site in ("shadow", "chain", "frontier")
+
+
+def test_shadow_sampling_is_deterministic():
+    assert integrity.sample_chunk(3, 0, 1.0)
+    assert not integrity.sample_chunk(3, 0, 0.0)
+    picks = [integrity.sample_chunk(d, s, 0.5)
+             for d in range(20) for s in (0, 32768)]
+    assert picks == [integrity.sample_chunk(d, s, 0.5)
+                     for d in range(20) for s in (0, 32768)]
+    rate = sum(picks) / len(picks)
+    assert 0.2 < rate < 0.8  # sanity: roughly the requested rate
+
+
+# --- chain invariance across engines / pipelines / layouts ----------------
+
+
+def _load_chain(ck, name):
+    arrays = verify_file(os.path.join(ck, name))
+    return np.asarray(arrays["digest_chain"])
+
+
+def test_chain_identical_across_pipelines_engines_and_layouts(tmp_path):
+    """The digest is over the per-level new-state fingerprint MULTISET —
+    pinned engine-invariant, pipeline-invariant, and shard-layout-
+    invariant (the property that makes cross-engine auditing possible)."""
+    model_kw = dict(min_bucket=32, store_trace=False)
+    chains = {}
+    for tag, kw in (
+        ("fused", dict(pipeline="fused")),
+        ("legacy", dict(pipeline="legacy")),
+        ("host", dict(visited_backend="host")),
+    ):
+        ck = str(tmp_path / tag)
+        check(frl.make_model(2, 2, 2), checkpoint_dir=ck, **model_kw, **kw)
+        chains[tag] = _load_chain(ck, "bfs_checkpoint.npz")
+    for tag, mesh in (("sh2", _mesh(2)), ("sh4", _mesh(4))):
+        ck = str(tmp_path / tag)
+        check_sharded(frl.make_model(2, 2, 2), mesh=mesh,
+                      checkpoint_dir=ck, **model_kw)
+        chains[tag] = _load_chain(ck, "sharded_checkpoint.npz")
+    ref = chains.pop("fused")
+    for tag, arr in chains.items():
+        assert np.array_equal(ref, arr), tag
+
+
+# --- storage read-side verification (units) -------------------------------
+
+
+def test_frontier_segments_verify_on_read(tmp_path):
+    from kafka_specification_tpu.storage.frontier import (
+        FrontierWriter,
+        SegmentCorrupt,
+    )
+
+    w = FrontierWriter(str(tmp_path), 1, 3, seg_rows=8)
+    rows = np.arange(60, dtype=np.uint32).reshape(20, 3)
+    w.append(rows)
+    reader = w.finalize()
+    assert np.array_equal(reader.read_all(), rows)
+    # corrupt one segment ON DISK; a FRESH reader (no verified cache)
+    # must catch it at first read, without an explicit verify pass
+    from kafka_specification_tpu.storage.frontier import FrontierReader
+
+    seg_path = os.path.join(str(tmp_path), reader.man["segments"][1]["name"])
+    raw = bytearray(open(seg_path, "rb").read())
+    raw[-5] ^= 0x40
+    open(seg_path, "wb").write(bytes(raw))
+    cold = FrontierReader(str(tmp_path), reader.man, verify=False)
+    with pytest.raises(SegmentCorrupt):
+        cold.read_all()
+
+
+def test_spill_run_verifies_on_first_lookup(tmp_path):
+    from kafka_specification_tpu.resilience.faults import corrupt_file
+    from kafka_specification_tpu.storage.runs import (
+        RunCorrupt,
+        SortedRun,
+        write_run,
+    )
+
+    fps = np.sort(
+        np.random.default_rng(3).integers(
+            0, 2**63, size=500, dtype=np.uint64
+        )
+    )
+    path = os.path.join(str(tmp_path), "run-000000.fps")
+    meta = write_run(path, fps, bloom_path=path + ".bloom")
+    run = SortedRun(str(tmp_path), meta, verify=False)  # writer's own open
+    corrupt_file(path)
+    with pytest.raises(RunCorrupt):
+        run.contains(fps[:10])
+
+
+# --- supervised end-to-end (exit 76 -> restart -> converge) ----------------
+
+
+def test_supervised_flip_restarts_and_converges(tmp_path):
+    """scripts/resilient_run.py around a flip fault: attempt 1 exits 76
+    (typed, classified `integrity-violation`), the supervisor restarts
+    with the SAME env, the checkpoint deferral + resume relief make the
+    restart converge, and the final verdict matches a clean run."""
+    hb = str(tmp_path / "hb.jsonl")
+    ev = str(tmp_path / "events.jsonl")
+    logs = str(tmp_path / "logs")
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ, KSPEC_FAULT="flip@frontier:3")
+    rc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_REPO, "scripts", "resilient_run.py"),
+            "--heartbeat", hb, "--events", ev, "--log-dir", logs,
+            "--stall-timeout", "300", "--max-restarts", "3",
+            "--backoff", "0.05",
+            "--",
+            sys.executable, "-m", "kafka_specification_tpu.utils.cli",
+            "check", os.path.join(_REPO, "configs", "IdSequence.cfg"),
+            "--hand", "--cpu", "--json", "--checkpoint", ck,
+            "--stats", hb,
+        ],
+        cwd=_REPO,
+        env=env,
+        timeout=540,
+    ).returncode
+    assert rc == 0
+    events = [json.loads(l) for l in open(ev).read().splitlines()]
+    kinds = [e["event"] for e in events]
+    assert "integrity-violation" in kinds  # attempt 1 classified typed
+    assert kinds.count("start") == 2 and kinds[-1] == "complete"
+    exit76 = [e for e in events if e["event"] == "exit" and e["rc"] == 76]
+    assert exit76  # the child really exited with the integrity code
+    # final attempt's verdict: the clean IdSequence answer
+    final = None
+    for name in sorted(os.listdir(logs), reverse=True):
+        for line in reversed(
+            open(os.path.join(logs, name), errors="replace")
+            .read().splitlines()
+        ):
+            if line.startswith("{"):
+                final = json.loads(line)
+                break
+        if final:
+            break
+    # kspec-verdict/1 record of the final (clean) attempt: the exhaustive
+    # IdSequence answer (configs/IdSequence.cfg)
+    assert final and final["exit_code"] == 0
+    assert final["violation"] is None
+    assert final["distinct_states"] == 12
+
+
+# --- the untested triple: elastic reshard x disk tier x fused -------------
+
+
+def test_elastic_reshard_disk_tier_fused_triple(tmp_path, monkeypatch):
+    """The satellite matrix corner: a sharded DISK-TIER run crashes, is
+    ELASTICALLY resumed (4 -> 2 shards) still on the disk tier, and the
+    result — counts AND the level digest chain — is bit-identical to the
+    single-device FUSED-pipeline disk-tier run of the same model (every
+    pair of the triple was pinned before; this pins all three at once)."""
+    model_kw = dict(min_bucket=32, store_trace=False)
+    fck = str(tmp_path / "fused_ck")
+    golden = check(frl.make_model(2, 2, 2), pipeline="fused",
+                   checkpoint_dir=fck, mem_budget=256, store="disk",
+                   **model_kw)
+    assert golden.total == 49
+    sck = str(tmp_path / "sck")
+    from kafka_specification_tpu.resilience import InjectedCrash
+
+    monkeypatch.setenv("KSPEC_FAULT", "crash@level:2")
+    with pytest.raises(InjectedCrash):
+        check_sharded(frl.make_model(2, 2, 2), mesh=_mesh(4),
+                      checkpoint_dir=sck, mem_budget=256, store="disk",
+                      **model_kw)
+    monkeypatch.delenv("KSPEC_FAULT")
+    resumed = check_sharded(frl.make_model(2, 2, 2), mesh=_mesh(2),
+                            checkpoint_dir=sck, mem_budget=256,
+                            store="disk", **model_kw)
+    assert _verdict(resumed) == _verdict(golden)
+    spilled = [s for s in resumed.stats["spill"] if s]
+    assert sum(x["disk"] + x["hot"] for x in spilled) == 49
+    assert np.array_equal(
+        _load_chain(fck, "bfs_checkpoint.npz"),
+        _load_chain(sck, "sharded_checkpoint.npz"),
+    )
+
+
+# --- kill switch ----------------------------------------------------------
+
+
+def test_kill_switch_disables_layer(tmp_path, monkeypatch):
+    """KSPEC_INTEGRITY=0: no chain stamped, flips fly silent (the escape
+    hatch contract — and the bench baseline mode)."""
+    monkeypatch.setenv("KSPEC_INTEGRITY", "0")
+    ck = str(tmp_path / "ck")
+    res = check(frl.make_model(2, 2, 2), min_bucket=32, checkpoint_dir=ck)
+    assert res.total == 49
+    arrays = verify_file(os.path.join(ck, "bfs_checkpoint.npz"))
+    assert "digest_chain" not in arrays
+
+
+def test_exit_code_contract():
+    assert EXIT_INTEGRITY == 76  # one past EXIT_RESOURCE_EXHAUSTED (75)
+
+
+# --- review-pass regressions ----------------------------------------------
+
+
+def test_pre_integrity_checkpoint_resume_upgrade_path(tmp_path, monkeypatch):
+    """A checkpoint written WITHOUT the integrity layer (pre-upgrade /
+    kill-switch) resumes under the integrity-enabled build: the rebuilt
+    chain is unanchored, so it is NOT stamped into new checkpoints —
+    a stamped zero-digest chain would fail the cumulative visited check
+    on the next load and permanently reject every post-upgrade
+    generation (review-pass regression)."""
+    from kafka_specification_tpu.resilience import InjectedCrash
+
+    model = frl.make_model(2, 2, 2)
+    golden = _verdict(check(model, min_bucket=32, store_trace=False))
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("KSPEC_INTEGRITY", "0")
+    monkeypatch.setenv("KSPEC_FAULT", "crash@level:2")
+    with pytest.raises(InjectedCrash):
+        check(model, min_bucket=32, checkpoint_dir=ck)
+    monkeypatch.delenv("KSPEC_FAULT")
+    monkeypatch.delenv("KSPEC_INTEGRITY")  # the upgraded build takes over
+    resumed = check(model, min_bucket=32, checkpoint_dir=ck)
+    assert _verdict(resumed) == golden
+    # post-upgrade generations carry no chain (unanchored) ...
+    arrays = verify_file(os.path.join(ck, "bfs_checkpoint.npz"))
+    assert "digest_chain" not in arrays
+    # ... and every generation still verifies and resumes
+    assert verify_checkpoint_dir(ck)["ok"]
+    again = check(model, min_bucket=32, checkpoint_dir=ck)
+    assert _verdict(again) == golden
+
+
+def test_merge_refuses_to_launder_corrupt_run(tmp_path):
+    """A corrupt-but-not-yet-probed run must fail its content CRC when a
+    k-way MERGE streams it — merging first would re-checksum corrupted
+    values into a 'valid' merged run and defeat read-side verification
+    forever (review-pass regression)."""
+    from kafka_specification_tpu.resilience.faults import corrupt_file
+    from kafka_specification_tpu.storage.runs import (
+        RunCorrupt,
+        SortedRun,
+        merge_runs,
+        write_run,
+    )
+
+    rng = np.random.default_rng(11)
+    fps = np.sort(rng.integers(0, 2**63, size=1000, dtype=np.uint64))
+    runs = []
+    for i, part in enumerate((fps[::2], fps[1::2])):
+        path = os.path.join(str(tmp_path), f"run-{i:06d}.fps")
+        meta = write_run(path, part, bloom_path=path + ".bloom")
+        runs.append(SortedRun(str(tmp_path), meta, verify=False))
+    corrupt_file(runs[1].path)
+    with pytest.raises(RunCorrupt):
+        merge_runs(runs, os.path.join(str(tmp_path), "merged.fps"))
+
+
+def test_cli_rejects_shadow_with_sharded(capsys):
+    """--integrity-shadow on --sharded must error, not silently no-op
+    (the report's guidance sends operators to this flag)."""
+    from kafka_specification_tpu.utils.cli import main as cli_main
+
+    rc = cli_main([
+        "check", os.path.join(_REPO, "configs", "IdSequence.cfg"),
+        "--sharded", "--integrity-shadow", "1.0", "--hand",
+    ])
+    assert rc == 2
+    assert "single-device only" in capsys.readouterr().err
