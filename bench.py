@@ -166,6 +166,129 @@ def _child_main():
         "shadow": shadow,
     }
 
+    # Async overlap (PR 10, overlap.py): alternate overlap on/off on the
+    # FORCED-SPILL + CHECKPOINT-CADENCE config — the configuration whose
+    # storage/checkpoint wall the overlap layer exists to hide (the plain
+    # headline config above has no storage I/O to overlap, so measuring
+    # it there would just bank noise).  Best-of-3 alternating, same
+    # throttled-venue practice as the integrity measurement.  The
+    # per-level wall decomposition (compute vs exposed-I/O vs hidden-I/O)
+    # comes from the engine's own per-level attribution
+    # (result.stats["levels"][*]["io_hidden_ms"/"io_exposed_ms"]).
+    import shutil
+    import tempfile
+
+    ov_cfg = dict(
+        store_trace=False,
+        min_bucket=4096,
+        chunk_size=16384,
+        store="disk",
+        mem_budget=1 << 20,  # ~65k fps/spill -> ~11 spills + merges
+        checkpoint_every=3,
+        stats_path=os.devnull,
+    )
+    ov_on_w, ov_off_w = [], []
+    ov_on_stats = ov_off_stats = None
+    for _ in range(3):
+        for flag in ("0", "1"):
+            os.environ["KSPEC_OVERLAP"] = flag
+            sd = tempfile.mkdtemp(prefix="kspec-bench-ov-")
+            try:
+                r = check(
+                    model,
+                    spill_dir=os.path.join(sd, "spill"),
+                    checkpoint_dir=os.path.join(sd, "ck"),
+                    **ov_cfg,
+                )
+            finally:
+                shutil.rmtree(sd, ignore_errors=True)
+            assert r.ok and r.total == 737_794, (r.total, r.violation)
+            if flag == "1":
+                ov_on_w.append(r.seconds)
+                ov_on_stats = r.stats
+            else:
+                ov_off_w.append(r.seconds)
+                ov_off_stats = r.stats
+    del os.environ["KSPEC_OVERLAP"]
+
+    def _decompose(stats):
+        lv = stats.get("levels") or []
+        wall = sum(l.get("level_ms", 0.0) for l in lv)
+        step = sum(l.get("step_ms", 0.0) for l in lv)
+        hid = sum(l.get("io_hidden_ms", 0.0) for l in lv)
+        exp = sum(l.get("io_exposed_ms", 0.0) for l in lv)
+        return {
+            "wall_ms": round(wall, 1),
+            "compute_ms": round(step, 1),
+            "exposed_io_ms": round(exp, 1),
+            "hidden_io_ms": round(hid, 1),
+            "overlap_efficiency": round(
+                hid / (hid + exp), 4
+            ) if (hid + exp) > 0 else None,
+        }
+
+    overlap_rec = {
+        "config": "forced-spill disk tier (mem_budget 1M) + "
+        "checkpoint cadence 3 (the storage-heavy configuration)",
+        "on_best_s": round(min(ov_on_w), 2),
+        "off_best_s": round(min(ov_off_w), 2),
+        "on_walls_s": [round(s, 2) for s in ov_on_w],
+        "off_walls_s": [round(s, 2) for s in ov_off_w],
+        "speedup": round(min(ov_off_w) / min(ov_on_w), 3),
+        "speedup_target": 1.15,
+        "staged_chunks_peak": ov_on_stats["overlap"]["staged_chunks_peak"],
+        "decomposition_on": _decompose(ov_on_stats),
+        "decomposition_off": _decompose(ov_off_stats),
+        # venue honesty (the PR 7 precedent): the wall win is bounded by
+        # the venue's concurrency and storage latency.  On a 1-core
+        # page-cached container the hideable I/O share is the
+        # decomposition's hidden+exposed over wall (~5% here), so even
+        # PERFECT hiding cannot reach the 1.15x target — the mechanism
+        # is proven by the decomposition (exposed ~0 with overlap on)
+        # and the span-overlap tests; the wall target needs a venue
+        # with >=2 cores or real storage latency.
+        "venue": {
+            "cores": os.cpu_count(),
+            "note": "1-core CPU-share-throttled container, page-cached "
+            "disk: speedup bounded by the hideable-I/O share "
+            "(Amdahl), absolute walls not comparable across rounds",
+        }
+        if (os.cpu_count() or 1) <= 2
+        else {"cores": os.cpu_count()},
+    }
+
+    # Exchange compression on the 8-device CI mesh (ROADMAP item 5's
+    # measure): run in a sub-child — the virtual 8-device platform must
+    # be configured before jax initializes, which this process already
+    # did.  Failure degrades to exchange=null, never the whole bench.
+    exchange_rec = None
+    try:
+        env = dict(os.environ)
+        env["KSPEC_BENCH_EXCHANGE"] = "1"
+        env["KSPEC_EXCHANGE_COMPRESS"] = "1"  # measuring the codec IS the point
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            timeout=int(os.environ.get("KSPEC_BENCH_EXCH_TIMEOUT", "1500")),
+            capture_output=True,
+            text=True,
+        )
+        if p.returncode == 0:
+            exchange_rec = json.loads(p.stdout.strip().splitlines()[-1])
+        else:
+            print(
+                "# exchange sub-bench failed (rc="
+                f"{p.returncode}): {p.stderr[-300:]}",
+                file=sys.stderr,
+            )
+    except Exception as e:  # noqa: BLE001 — degrade, never fail the bench
+        print(f"# exchange sub-bench error: {e}", file=sys.stderr)
+
     def launches(r):
         lv = r.stats["levels"]
         return {
@@ -205,9 +328,27 @@ def _child_main():
                 ),
                 "hand_sps": round(hres.states_per_sec, 1),
                 "integrity": integrity_rec,
+                "overlap": overlap_rec,
+                "exchange": exchange_rec,
             }
         )
     )
+    print(
+        f"# overlap (forced-spill + ckpt cadence): on "
+        f"{overlap_rec['on_best_s']}s vs off {overlap_rec['off_best_s']}s "
+        f"= {overlap_rec['speedup']}x; hidden/exposed io "
+        f"{overlap_rec['decomposition_on']['hidden_io_ms']:.0f}/"
+        f"{overlap_rec['decomposition_on']['exposed_io_ms']:.0f} ms",
+        file=sys.stderr,
+    )
+    if exchange_rec:
+        print(
+            f"# exchange (8-device CI mesh): "
+            f"{exchange_rec['bytes_per_level_compressed']:,} B/level "
+            f"compressed vs {exchange_rec['bytes_per_level_raw']:,} raw = "
+            f"{exchange_rec['ratio']}x fewer bytes",
+            file=sys.stderr,
+        )
     print(
         f"# {kernel_source} fused (default path): {res.seconds:.1f}s wall "
         f"on {platform}, diameter {res.diameter}; legacy pipeline same "
@@ -513,9 +654,72 @@ def _serve_bench():
     print(json.dumps(rec))
 
 
+def _exchange_child_main():
+    """8-device CI-mesh exchange measurement (ROADMAP item 5): the same
+    sharded workload with the compressed exchange on vs off — verdicts
+    must be identical (a runtime bit-identity assert), and the record
+    banks the measured bytes/level both ways."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kafka_specification_tpu.models import kip320
+    from kafka_specification_tpu.models.kafka_replication import Config
+    from kafka_specification_tpu.parallel.sharded import check_sharded
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    mesh = Mesh(np.array(devs[:8]), ("d",))
+    model = kip320.make_model(
+        Config(2, 2, 2, 2), ("TypeOk", "LeaderInIsr", "WeakIsr")
+    )
+    kwargs = dict(
+        mesh=mesh,
+        store_trace=False,
+        min_bucket=512,
+        stats_path=os.devnull,
+    )
+    os.environ["KSPEC_OVERLAP"] = "0"
+    off = check_sharded(model, **kwargs)
+    os.environ["KSPEC_OVERLAP"] = "1"
+    os.environ["KSPEC_EXCHANGE_COMPRESS"] = "1"
+    on = check_sharded(model, **kwargs)
+    assert on.stats["exchange_compressed"], "codec not engaged"
+    assert (on.total, on.levels, on.ok) == (off.total, off.levels, off.ok), (
+        "compressed exchange diverged from the raw oracle"
+    )
+    n_levels = max(1, len(on.levels) - 1)
+    sent = on.stats["exchange_bytes_total"]
+    raw = on.stats["exchange_raw_bytes_total"]
+    print(
+        json.dumps(
+            {
+                "devices": 8,
+                "model": "Kip320 Config(2,2,2,2) sharded all_to_all",
+                "total_states": on.total,
+                "bit_identical_to_raw": True,
+                "bytes_per_level_compressed": int(sent / n_levels),
+                "bytes_per_level_raw": int(raw / n_levels),
+                "ratio": round(raw / max(sent, 1), 2),
+                "wall_on_s": round(on.seconds, 2),
+                "wall_off_s": round(off.seconds, 2),
+            }
+        )
+    )
+
+
 def main():
     if "--serve" in sys.argv[1:]:
         _serve_bench()
+        return
+    if os.environ.get("KSPEC_BENCH_EXCHANGE"):
+        _exchange_child_main()
         return
     if os.environ.get("KSPEC_BENCH_PROBE"):
         from kafka_specification_tpu.utils.platform_guard import (

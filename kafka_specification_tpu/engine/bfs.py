@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.base import Model
+from ..obs import metrics as _met
 from ..obs.observer import RunObserver
 from ..ops import dedup, hashset
 from ..ops.fingerprint import fingerprint_lanes
@@ -984,6 +985,7 @@ def check(
     collect_trace: Optional[list] = None,
     governor: Optional[ResourceGovernor] = None,
     integrity_shadow: Optional[float] = None,
+    overlap: Optional[bool] = None,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -1131,6 +1133,25 @@ def check(
     stamped ``integrity-violation`` (resilience.integrity,
     docs/resilience.md).  KSPEC_INTEGRITY=0 disables the whole layer.
 
+    overlap: async level-pipelined execution ($KSPEC_OVERLAP is the env
+    twin; default ON, ``off``/False = the historical serial behavior and
+    the bit-identity oracle).  Three overlaps (docs/engine.md § Async
+    execution): (1) a two-slot staged chunk pipeline — chunk k+1's
+    device programs are dispatched before chunk k's host commit
+    (fingerprint-set insert, arena assembly, digest folds) runs, so host
+    work drains behind the in-flight update-skeleton launch (JAX async
+    dispatch; per-chunk ``step`` spans carry dispatch/device-wait
+    attribution); (2) disk-tier spill-run merges run on a background
+    worker (storage/tiered.py — lookups keep serving from the immutable
+    inputs, adoption and error propagation happen on this thread);
+    (3) checkpoint writes move to a writer thread (the engine snapshots
+    metadata + digest chain + dumps synchronously; verification, the
+    checksummed write and the atomic promote run in the background,
+    with ENOSPC/fault errors re-raised here at the next level
+    boundary).  Results are bit-identical either way — counts,
+    duplicate accounting, first-violation rule, trace values, digest
+    chains (tests/test_overlap.py pins the matrix).
+
     disk_budget: byte budget for the spill + checkpoint directories
     (resilience.resources.ResourceGovernor; KSPEC_DISK_BUDGET is the env
     twin, KSPEC_RSS_BUDGET / KSPEC_LEVEL_DEADLINE arm the RSS and
@@ -1166,6 +1187,27 @@ def check(
 
     fault = FaultPlan.from_env()
     chunk_retry = ChunkRetryHandler.from_env("[engine]")
+    # async overlap layer (overlap.py; $KSPEC_OVERLAP, default on):
+    # io_worker carries background spill-run merges, ckpt_worker the
+    # async checkpoint writes; the two-slot chunk pipeline below needs
+    # no thread (JAX async dispatch is the worker)
+    from ..overlap import (
+        AsyncWorker,
+        close_workers,
+        overlap_enabled,
+        worker_counters,
+    )
+
+    overlap_on = overlap_enabled(overlap)
+    io_worker = AsyncWorker("kspec-io") if overlap_on else None
+    ckpt_worker = (
+        AsyncWorker("kspec-ckpt")
+        if overlap_on and checkpoint_dir is not None
+        else None
+    )
+
+    def _shutdown_async(drain: bool) -> None:
+        close_workers((io_worker, ckpt_worker), drain)
     # state-integrity defense (resilience.integrity): always-on level
     # digest chain + sampled shadow re-execution; KSPEC_INTEGRITY=0 is
     # the kill switch (bench baselines, emergency escape hatch)
@@ -1250,6 +1292,7 @@ def check(
                 ),
                 fault_plan=fault,
                 trace=want_trace or checkpoint_dir is not None,
+                merge_worker=io_worker,
             )
             host_set = disk.fpset  # init fps inserted at start_fresh/resume
         else:
@@ -1354,6 +1397,7 @@ def check(
                     trace=[("<init>", decode_state(init_packed[idx]))],
                 )
                 _drop_ephemeral_spill()
+                _shutdown_async(drain=True)
                 res = CheckResult(
                     model.name, levels, total, 0, viol, dt, total / max(dt, 1e-9)
                 )
@@ -1426,6 +1470,8 @@ def check(
                 else (_spill_ref_errors,)
             ),
         )
+        if ckpt_worker is not None:
+            ckpt_store.attach_writer(ckpt_worker)
         loaded = ckpt_store.load()
         if loaded is not None:
             resumed = True
@@ -1507,27 +1553,101 @@ def check(
             else {}
         )
 
-    def _readback_chain(path: str) -> None:
+    def _readback_chain(path: str, at_depth: int) -> None:
         if chain is not None and chain.anchored:
-            _integ.readback_chain(path, depth=depth)
+            _integ.readback_chain(path, depth=at_depth)
 
-    def _save_checkpoint():
+    # async-checkpoint bookkeeping (KSPEC_OVERLAP): `last_ckpt_depth`
+    # stays the SUBMITTED depth (save-cadence decisions), while
+    # `ckpt_durable_depth` advances only when a write has atomically
+    # promoted — crash-fault deferral and flip gating key on durability,
+    # so a deferred crash can never fire ahead of the checkpoint that
+    # makes its restart converge.  `ckpt_barrier_tokens` carries each
+    # in-flight save's deletion-barrier watermark (DeferredDeleter.mark):
+    # the barrier advances for exactly the files scheduled BEFORE that
+    # save's snapshot, preserving the sync ordering contract.
+    ckpt_durable_depth = last_ckpt_depth
+    ckpt_barrier_tokens: list = []
+    sync_io_s = 0.0  # wall spent on SYNChronous checkpoint writes
+
+    def _ckpt_reap(completed) -> None:
+        nonlocal ckpt_durable_depth
+        for d, _path in completed:
+            ckpt_durable_depth = (
+                d if ckpt_durable_depth is None
+                else max(ckpt_durable_depth, d)
+            )
+            if disk is not None:
+                tok = ckpt_barrier_tokens.pop(0) if ckpt_barrier_tokens \
+                    else None
+                disk.fpset.deleter.on_save(upto=tok)
+
+    def _ckpt_poll(block: bool = False) -> None:
+        # join point for async saves: surfaces writer errors (typed
+        # ENOSPC, injected crashes) on the engine thread and advances
+        # the durable-depth + deletion-barrier bookkeeping
+        if ckpt_worker is None or ckpt_store is None:
+            return
+        _ckpt_reap(
+            ckpt_store.drain_async() if block else ckpt_store.poll_async()
+        )
+
+    def _save_checkpoint(sync: bool = False):
+        # The async-checkpoint split (docs/resilience.md): everything
+        # mutable is SNAPSHOTTED here, synchronously — level metadata,
+        # the digest chain, the visited dump (a fresh array from every
+        # backend), a copy of the frontier — and the checksummed write,
+        # rotation and atomic promote run on the writer thread.  The
+        # save-time chain verification moves to the writer too, still
+        # BEFORE the promote (detected corruption never enters a
+        # checkpoint); ENOSPC and injected faults re-raise at the next
+        # _ckpt_poll, preserving the typed exits.
+        nonlocal ckpt_durable_depth, sync_io_s
+        run_async = ckpt_worker is not None and not sync
+        t_sync0 = time.perf_counter()
         # only the live prefix of the visited set is saved (the sentinel
         # padding is rebuilt on resume from vcap/vn); uncompressed — live
         # fingerprints are high-entropy and zlib only burns time
         n = int(vn)
+        d_save = depth
         levels_arr = np.asarray(levels)
         # flip injections are gated on an ANCHORED chain: they rehearse
         # detection, and an unanchored chain (pre-integrity resume)
         # cannot detect — injecting there would just silently corrupt
         if chain is not None and chain.anchored and fault.flip(
-            "ckpt", depth, ckpt_depth=last_ckpt_depth
+            "ckpt", d_save, ckpt_depth=ckpt_durable_depth
         ):
             # CRC-consistent metadata corruption: the manifest is built
             # AFTER this flip, so every per-array checksum passes over
             # the corrupt content — only the digest chain flags it
             levels_arr = levels_arr.copy()
             _integ.flip_bit(levels_arr)
+
+        def _dispatch(arrays: dict, pre_write=None, barrier: bool = False):
+            nonlocal ckpt_durable_depth, sync_io_s
+            if run_async:
+                if barrier:
+                    ckpt_barrier_tokens.append(disk.fpset.deleter.mark())
+                ckpt_store.save_async(
+                    d_save, arrays, pre_write=pre_write,
+                    after_promote=lambda p: _readback_chain(p, d_save),
+                )
+                return
+            if pre_write is not None:
+                pre_write()
+            path = ckpt_store.save(d_save, arrays)
+            if barrier:
+                # a new durable generation exists: advance the deferred-
+                # deletion barrier (merged-away runs / consumed frontier
+                # segments older than every retained generation unlink)
+                disk.on_checkpoint_saved()
+            _readback_chain(path, d_save)
+            ckpt_durable_depth = (
+                d_save if ckpt_durable_depth is None
+                else max(ckpt_durable_depth, d_save)
+            )
+            sync_io_s += time.perf_counter() - t_sync0
+
         if disk is not None:
             # the disk tier IS the durable state: record the run manifest
             # + frontier-segment offsets + the (budget-bounded) hot dump,
@@ -1535,8 +1655,7 @@ def check(
             # SUBSET of the visited set, so the cumulative-digest
             # self-check does not apply here — the spilled runs carry
             # their own read-side-verified CRCs instead.)
-            path = ckpt_store.save(
-                depth,
+            _dispatch(
                 dict(
                     spill_manifest=json.dumps(disk.manifest()),
                     host_fps=disk.fpset.hot_dump(),
@@ -1545,12 +1664,8 @@ def check(
                     total=total,
                     **_chain_stamp(),
                 ),
+                barrier=True,
             )
-            # a new durable generation exists: advance the deferred-
-            # deletion barrier (merged-away runs / consumed frontier
-            # segments older than every retained generation get unlinked)
-            disk.on_checkpoint_saved()
-            _readback_chain(path)
             return
         if host_set is not None:
             extra = {"host_fps": host_set.dump()}
@@ -1568,8 +1683,9 @@ def check(
                 "vn": n,
             }
             pk = "vhi"
+        pre_write = None
         if chain is not None and chain.anchored:
-            if fault.flip("fpset", depth, ckpt_depth=last_ckpt_depth):
+            if fault.flip("fpset", d_save, ckpt_depth=ckpt_durable_depth):
                 corrupted = np.array(extra[pk], copy=True)
                 _integ.flip_bit(corrupted)
                 extra[pk] = corrupted
@@ -1581,21 +1697,35 @@ def check(
                 dump_fps = _integ.pair_u64(extra["vhi"], extra["vlo"])
             # save-time self-check: the dump must digest to the chain's
             # running total BEFORE the write — corruption detected here
-            # never enters a checkpoint
-            _integ.count_check()
-            chain.verify_visited(dump_fps, depth=depth)
-        path = ckpt_store.save(
-            depth,
+            # never enters a checkpoint.  Async: the chain is snapshotted
+            # now (it keeps evolving on this thread) and the check runs
+            # on the writer, still pre-promote.
+            chain_snap = (
+                _integ.LevelDigestChain.from_array(chain.to_array())
+                if run_async
+                else chain
+            )
+
+            def pre_write(chain_snap=chain_snap, dump_fps=dump_fps):
+                _integ.count_check()
+                chain_snap.verify_visited(dump_fps, depth=d_save)
+
+        frontier_arr = frontier_np
+        if run_async and isinstance(frontier_arr, np.ndarray):
+            # the live frontier buffer stays mutable on this thread
+            # (arena growth, flip injection) — the writer gets a copy
+            frontier_arr = np.array(frontier_arr, copy=True)
+        _dispatch(
             dict(
-                frontier=frontier_np,
+                frontier=frontier_arr,
                 vcap=vcap,
                 levels=levels_arr,
                 total=total,
                 **extra,
                 **_chain_stamp(),
             ),
+            pre_write=pre_write,
         )
-        _readback_chain(path)
 
     chunk = _next_pow2(max(min_bucket, chunk_size))
     chunk_floor = _next_pow2(max(32, min_bucket))
@@ -1615,30 +1745,41 @@ def check(
     def _final_save():
         # checkpoint-then-clean-exit: persist the just-completed level
         # even off the checkpoint_every cadence, so the operator resumes
-        # from the breach point, not checkpoint_every-1 levels earlier
+        # from the breach point, not checkpoint_every-1 levels earlier.
+        # Synchronous + drained: the typed exit's contract is a DURABLE
+        # state, so the async tail is joined first
         nonlocal last_ckpt_depth
-        if ckpt_store is not None and last_ckpt_depth != depth:
-            _save_checkpoint()
+        if ckpt_store is None:
+            return
+        _ckpt_poll(block=True)
+        if last_ckpt_depth != depth or ckpt_durable_depth != depth:
+            _save_checkpoint(sync=True)
             last_ckpt_depth = depth
 
     def _reclaim():
         # soft-breach reclamation, in dependency order (docs/resilience.md):
-        # tmp janitor -> eager run merge -> fresh checkpoint (references
-        # the merged state) -> prune older generations -> flush the
-        # deletion barrier (everything still pending was referenced only
-        # by the generations just pruned)
+        # quiesce background work -> tmp janitor -> eager run merge ->
+        # fresh checkpoint (references the merged state) -> prune older
+        # generations -> flush the deletion barrier (everything still
+        # pending was referenced only by the generations just pruned).
+        # The quiesce (inside sweep_tmp/reclaim_merge/flush_deleted and
+        # the blocking ckpt poll here) is what keeps a reclaim from
+        # racing a background merge promote or an in-flight checkpoint
+        # write (PR 10 small fix; regression-tested)
         nonlocal last_ckpt_depth
         merged = False
         if disk is not None:
             disk.sweep_tmp()
             merged = disk.reclaim_merge()
         if ckpt_store is not None:
+            _ckpt_poll(block=True)
             # skip the save when the periodic one just ran at this depth
             # and no merge changed the on-disk state (the newest gen
             # already references everything the flush keeps) — the
             # pressure path is exactly where write bandwidth is scarcest
-            if merged or last_ckpt_depth != depth:
-                _save_checkpoint()
+            if merged or last_ckpt_depth != depth or \
+                    ckpt_durable_depth != depth:
+                _save_checkpoint(sync=True)
                 last_ckpt_depth = depth
             ckpt_store.prune(keep_gens=1)
             if disk is not None:
@@ -1754,6 +1895,269 @@ def check(
             depth=depth, start=start, rows=int(fp_n), mode=mode,
         )
 
+
+    def _commit_chunk(st) -> bool:
+        """Commit one staged chunk: block on its device outputs
+        (finalize), run the verdict checks and shadow oracle, then the
+        backend-specific host assembly — the visited-set insert, arena/
+        trace accumulation and digest folds.  Commits run strictly in
+        dispatch order on this thread; returns True when a verdict
+        fired (the level stops and any younger staged chunk is
+        discarded uncommitted)."""
+        nonlocal vhi, vlo, vn, verdict, lvl_new, prof_step, prof_host_s
+        nonlocal lvl_launches, lvl_launches_max, run_launches_max
+        nonlocal lvl_act_en, a_rows, a_parent, a_act, a_w, a_cap
+        nonlocal ht_hi, ht_lo, ht_claim, hash_n, pallas_vmem_noted
+        (start, fp_n, bucket, finalize, pre_v, shadow, dispatch_s,
+         t_staged, piece, pre_vcap) = st
+        queued_s = time.perf_counter() - t_staged
+        t_wait = time.perf_counter()
+        (
+            out,
+            out_parent,
+            out_act,
+            new_n,
+            _vh,
+            _vl,
+            _vn,
+            viol_any,
+            viol_idx,
+            dl_any,
+            dl_idx,
+            act_en,
+            out_hi,
+            out_lo,
+            act_guard,
+            launches,
+        ) = finalize()
+        act_en_np = np.asarray(act_en, np.int64)
+        # frontier-level verdicts (states being expanded = level `depth`)
+        if check_invariants:
+            viol_any_np = np.asarray(viol_any)
+            if viol_any_np.any():
+                inv_i = int(np.argmax(viol_any_np))
+                idx = start + int(np.asarray(viol_idx)[inv_i])
+                verdict = ("invariant", idx, model.invariants[inv_i].name)
+                return True
+        if check_deadlock and bool(dl_any):
+            verdict = ("deadlock", start + int(dl_idx), "Deadlock")
+            return True
+        nn = int(new_n)
+        if shadow:
+            # pre_vcap: the visited capacity AT DISPATCH — the next
+            # chunk's dispatch may have grown `vcap` before this commit,
+            # and the shadow cross-exec replays against the pre-chunk
+            # visited refs, which are sized at the old capacity
+            _shadow_exec(
+                piece, fp_n, bucket, start, pre_v, pre_vcap,
+                out, out_hi, out_lo, nn, viol_any, dl_any,
+            )
+        wait_s = time.perf_counter() - t_wait
+        step_s = dispatch_s + wait_s
+        prof_step += step_s
+        lvl_launches += launches
+        lvl_launches_max = max(lvl_launches_max, launches)
+        run_launches_max = max(run_launches_max, launches)
+        # dispatch vs device-wait attribution (overlap accounting): with
+        # overlap on, queued_ms is how long the chunk sat staged while
+        # the previous chunk committed — device time hidden behind host
+        # work; wait_ms is the residual block on the outputs at commit
+        obs_.chunk_span(
+            "step", step_s, depth=depth, start=start, rows=fp_n,
+            bucket=bucket, launches=launches,
+            dispatch_ms=round(dispatch_s * 1e3, 2),
+            wait_ms=round(wait_s * 1e3, 2),
+            queued_ms=round(queued_s * 1e3, 2),
+        )
+        t_host = time.perf_counter()
+        if host_set is not None and nn:
+            if use_arena:
+                if a_w + nn > a_cap:
+                    a_cap = max(2 * a_cap, a_w + nn)
+                    na = np.empty((a_cap, K), np.uint32)
+                    na[:a_w] = a_rows[:a_w]
+                    a_rows = na
+                    npar = np.empty(a_cap, np.int64)
+                    npar[:a_w] = a_parent[:a_w]
+                    a_parent = npar
+                    nact = np.empty(a_cap, np.int32)
+                    nact[:a_w] = a_act[:a_w]
+                    a_act = nact
+                w = host_set.insert_compact(
+                    np.ascontiguousarray(out_hi[:nn], np.uint32),
+                    np.ascontiguousarray(out_lo[:nn], np.uint32),
+                    np.ascontiguousarray(out[:nn], np.uint32),
+                    np.ascontiguousarray(out_parent[:nn], np.int32),
+                    start,
+                    np.ascontiguousarray(out_act[:nn], np.int32),
+                    a_rows[a_w:],
+                    a_parent[a_w:],
+                    a_act[a_w:],
+                )
+                a_w += w
+                lvl_new += w
+                if chain is not None and w:
+                    # arena rows are the committed novel states;
+                    # the numpy twin recomputes their fps (the C
+                    # pass hands back rows, not fingerprints)
+                    chain.fold(
+                        _integ.fingerprint_rows(
+                            a_rows[a_w - w : a_w], spec.exact64
+                        )
+                    )
+            else:  # tiered disk store, or no native toolchain
+                rows = np.asarray(out[:nn])
+                fps_u64 = _u64(
+                    np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn])
+                )
+                mask = host_set.insert(fps_u64)
+                if disk is not None:
+                    # novel rows stream straight to the spilled
+                    # frontier + parent log in discovery order (int64
+                    # parents: level-global indices can pass 2^31 at
+                    # the scales this tier exists for)
+                    disk.append(
+                        rows[mask],
+                        np.asarray(out_parent[:nn], np.int64)[mask] + start,
+                        np.asarray(out_act[:nn])[mask],
+                    )
+                else:
+                    lvl_rows.append(rows[mask])
+                    lvl_parent.append(
+                        np.asarray(out_parent[:nn])[mask] + start
+                    )
+                    lvl_act.append(np.asarray(out_act[:nn])[mask])
+                lvl_new += int(mask.sum())
+                if chain is not None:
+                    chain.fold(fps_u64[mask.astype(bool)])
+        elif ht_hi is not None and nn:
+            # device-hash backend: insert-or-find on the HBM table; a
+            # probe-budget overflow grows the table and re-runs the
+            # SAME batch, OR-accumulating novelty (rows inserted by the
+            # failed attempt report "seen" on the re-run, so nothing is
+            # double-counted or lost)
+            valid = jnp.arange(out_hi.shape[0]) < new_n
+            isnew = np.zeros(out_hi.shape[0], bool)
+            while True:
+                # Pallas probe kernel (ops/pallas_hashset) — the actual
+                # TPU dedup kernel a live hardware window profiles;
+                # interpret mode on CPU, bit-identical winners
+                # (tests/test_pallas.py).  It stages the whole table in
+                # VMEM, so beyond MAX_VMEM_CAP slots it cannot compile
+                # — fall back to the jnp HBM probe, loudly, and keep
+                # checking per iteration (a mid-run rehash can cross
+                # the threshold).
+                use_p = use_p_hbm = False
+                if step_builder.use_pallas:
+                    # lazy import: the default (non-pallas) path must
+                    # not depend on jax.experimental.pallas at all
+                    from ..ops import pallas_hashset as pallas_hs
+
+                    use_p = pallas_hs.fits_vmem(ht_hi.shape[0])
+                    # beyond the VMEM gate: the HBM-resident DMA
+                    # kernel (opt-in until a hardware window profiles
+                    # its per-slot descriptor overhead)
+                    use_p_hbm = not use_p and (
+                        os.environ.get("KSPEC_PALLAS_HBM") == "1"
+                    )
+                if (
+                    step_builder.use_pallas
+                    and not use_p
+                    and not use_p_hbm
+                    and not pallas_vmem_noted
+                ):
+                    pallas_vmem_noted = True
+                    print(
+                        "[kspec] KSPEC_USE_PALLAS: table capacity "
+                        f"{ht_hi.shape[0]} exceeds the VMEM-staged "
+                        f"kernel's limit ({pallas_hs.MAX_VMEM_CAP}); "
+                        "falling back to the jnp HBM probe path "
+                        "(KSPEC_PALLAS_HBM=1 selects the HBM-resident "
+                        "DMA kernel instead)",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                if use_p_hbm:
+                    ht_hi, ht_lo, m, _ni, ovf = (
+                        pallas_hs.probe_insert_pallas_hbm(
+                            ht_hi,
+                            ht_lo,
+                            out_hi,
+                            out_lo,
+                            valid,
+                            interpret=jax.default_backend() == "cpu",
+                        )
+                    )
+                    ht_claim = None
+                elif use_p:
+                    # KSPEC_PALLAS_GROUP: interleaved probe chains per
+                    # round (memory-level parallelism; winners
+                    # bit-identical — ops/pallas_hashset)
+                    ht_hi, ht_lo, m, _ni, ovf = (
+                        pallas_hs.probe_insert_pallas(
+                            ht_hi,
+                            ht_lo,
+                            out_hi,
+                            out_lo,
+                            valid,
+                            interpret=jax.default_backend() == "cpu",
+                            group=int(
+                                os.environ.get("KSPEC_PALLAS_GROUP", "8")
+                            ),
+                        )
+                    )
+                    ht_claim = None
+                else:
+                    if ht_claim is None:
+                        ht_claim = hashset.new_claim(ht_hi.shape[0])
+                    ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
+                        ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
+                    )
+                isnew |= np.asarray(m)
+                if not bool(ovf):
+                    break
+                ht_hi, ht_lo = hashset.rehash_into(
+                    ht_hi, ht_lo, 2 * ht_hi.shape[0]
+                )
+                ht_claim = None
+            mask = isnew[:nn]
+            hash_n += int(mask.sum())
+            lvl_rows.append(np.asarray(out[:nn])[mask])
+            lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
+            lvl_act.append(np.asarray(out_act[:nn])[mask])
+            lvl_new += int(mask.sum())
+            if chain is not None:
+                chain.fold(
+                    _integ.pair_u64(
+                        np.asarray(out_hi[:nn])[mask],
+                        np.asarray(out_lo[:nn])[mask],
+                    )
+                )
+        elif nn:
+            lvl_rows.append(np.asarray(out[:nn]))
+            lvl_parent.append(np.asarray(out_parent[:nn]) + start)
+            lvl_act.append(np.asarray(out_act[:nn]))
+            lvl_new += nn
+            if chain is not None:
+                # device backend: the in-jit dedup already
+                # compacted exactly the new states to the front
+                chain.fold(
+                    _integ.pair_u64(
+                        np.asarray(out_hi[:nn]),
+                        np.asarray(out_lo[:nn]),
+                    )
+                )
+        host_s = time.perf_counter() - t_host
+        prof_host_s += host_s
+        obs_.chunk_span(
+            "host-assembly", host_s, depth=depth, start=start, new=nn,
+            backend=visited_backend,
+        )
+        if collect_stats:
+            lvl_act_en += act_en_np
+
+        return False
+
     # storage read-side corruption (read-verified CRCs on spill runs /
     # frontier segments / parent-log levels) surfaces as these typed
     # exceptions mid-run — all integrity violations, exit 76
@@ -1764,13 +2168,35 @@ def check(
     exhausted: Optional[ResourceExhausted] = None
     integrity_fail: Optional[IntegrityError] = None
     run_launches_max = 0  # per-chunk max actually DISPATCHED this run
+    overlap_staged_peak = 0  # most chunks ever staged at once (<= 2)
+
+    def _io_counters():
+        return worker_counters((io_worker, ckpt_worker))
     try:
         while _f_rows(frontier_np) > 0:
-            # level-boundary fault injection point (resilience.faults)
-            fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
+            # async join point: adopt finished background merges and
+            # promoted checkpoints, surfacing any worker error (typed
+            # faults, ENOSPC) on this thread before more work builds on
+            # un-validated state.  With an armed fault plan the join is
+            # BLOCKING: deterministic injection (crash deferral, flip
+            # gating, enospc surfacing) must not depend on writer-thread
+            # timing — fault rehearsals trade the overlap win for
+            # reproducibility at level boundaries
+            _ckpt_poll(block=bool(fault.specs))
+            if disk is not None:
+                if fault.specs:
+                    disk.quiesce()
+                disk.poll_async()
+            lvl_io0 = _io_counters()
+            lvl_sync_io0 = sync_io_s
+            # level-boundary fault injection point (resilience.faults);
+            # crash deferral keys on the DURABLE checkpoint depth, so an
+            # in-flight async save can never arm a crash whose restart
+            # would not converge
+            fault.crash("level", depth, ckpt_depth=ckpt_durable_depth)
             if chain is not None:
                 sp = fault.flip(
-                    "frontier", depth, ckpt_depth=last_ckpt_depth
+                    "frontier", depth, ckpt_depth=ckpt_durable_depth
                 )
                 if isinstance(frontier_np, np.ndarray):
                     if sp:
@@ -1831,6 +2257,19 @@ def check(
                 a_act = np.empty(a_cap, np.int32)
                 a_w = 0
             prof_step = prof_host_s = 0.0
+            # Two-slot staged chunk pipeline (KSPEC_OVERLAP, docs/
+            # engine.md § Async execution): each chunk's device programs
+            # are DISPATCHED first (pipe.run_chunk_staged — JAX async
+            # dispatch leaves the update-skeleton launch draining), and
+            # the PREVIOUS chunk's host commit (fingerprint-set insert,
+            # arena assembly, digest folds) runs while it drains.  At
+            # most two chunks are ever staged (the one committing + the
+            # one dispatched); commits happen strictly in chunk order,
+            # so counts, novelty decisions, first-violation and traces
+            # are bit-identical to the serial path — which is literally
+            # this same code with overlap_on False (dispatch followed by
+            # an immediate commit).
+            staged = None
             for start, piece in _f_chunks(frontier_np, chunk):
                 governor.poll(depth)  # deadline watchdog (cheap)
                 fp_n = piece.shape[0]
@@ -1872,239 +2311,32 @@ def check(
                 # arrays are immutable, so holding them is free)
                 pre_v = (vhi, vlo, vn) if shadow else None
                 t_attempt = time.perf_counter()
-                (
-                    out,
-                    out_parent,
-                    out_act,
-                    new_n,
-                    vhi,
-                    vlo,
-                    vn,
-                    viol_any,
-                    viol_idx,
-                    dl_any,
-                    dl_idx,
-                    act_en,
-                    out_hi,
-                    out_lo,
-                    act_guard,
-                    launches,
-                ) = pipe.run_chunk(
+                vhi, vlo, vn, finalize = pipe.run_chunk_staged(
                     piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
                 )
-                act_en_np = np.asarray(act_en, np.int64)
-                # frontier-level verdicts (states being expanded = level `depth`)
-                if check_invariants:
-                    viol_any_np = np.asarray(viol_any)
-                    if viol_any_np.any():
-                        inv_i = int(np.argmax(viol_any_np))
-                        idx = start + int(np.asarray(viol_idx)[inv_i])
-                        verdict = ("invariant", idx, model.invariants[inv_i].name)
-                        break
-                if check_deadlock and bool(dl_any):
-                    verdict = ("deadlock", start + int(dl_idx), "Deadlock")
-                    break
-                nn = int(new_n)
-                if shadow:
-                    _shadow_exec(
-                        piece, fp_n, bucket, start, pre_v, vcap,
-                        out, out_hi, out_lo, nn, viol_any, dl_any,
+                cur = (
+                    start, fp_n, bucket, finalize, pre_v, shadow,
+                    time.perf_counter() - t_attempt, time.perf_counter(),
+                    piece, vcap,
+                )
+                if overlap_on:
+                    overlap_staged_peak = max(
+                        overlap_staged_peak, 2 if staged is not None else 1
                     )
-                step_s = time.perf_counter() - t_attempt
-                prof_step += step_s
-                lvl_launches += launches
-                lvl_launches_max = max(lvl_launches_max, launches)
-                run_launches_max = max(run_launches_max, launches)
-                obs_.chunk_span(
-                    "step", step_s, depth=depth, start=start, rows=fp_n,
-                    bucket=bucket, launches=launches,
-                )
-                t_host = time.perf_counter()
-                if host_set is not None and nn:
-                    if use_arena:
-                        if a_w + nn > a_cap:
-                            a_cap = max(2 * a_cap, a_w + nn)
-                            na = np.empty((a_cap, K), np.uint32)
-                            na[:a_w] = a_rows[:a_w]
-                            a_rows = na
-                            npar = np.empty(a_cap, np.int64)
-                            npar[:a_w] = a_parent[:a_w]
-                            a_parent = npar
-                            nact = np.empty(a_cap, np.int32)
-                            nact[:a_w] = a_act[:a_w]
-                            a_act = nact
-                        w = host_set.insert_compact(
-                            np.ascontiguousarray(out_hi[:nn], np.uint32),
-                            np.ascontiguousarray(out_lo[:nn], np.uint32),
-                            np.ascontiguousarray(out[:nn], np.uint32),
-                            np.ascontiguousarray(out_parent[:nn], np.int32),
-                            start,
-                            np.ascontiguousarray(out_act[:nn], np.int32),
-                            a_rows[a_w:],
-                            a_parent[a_w:],
-                            a_act[a_w:],
-                        )
-                        a_w += w
-                        lvl_new += w
-                        if chain is not None and w:
-                            # arena rows are the committed novel states;
-                            # the numpy twin recomputes their fps (the C
-                            # pass hands back rows, not fingerprints)
-                            chain.fold(
-                                _integ.fingerprint_rows(
-                                    a_rows[a_w - w : a_w], spec.exact64
-                                )
-                            )
-                    else:  # tiered disk store, or no native toolchain
-                        rows = np.asarray(out[:nn])
-                        fps_u64 = _u64(
-                            np.asarray(out_hi[:nn]), np.asarray(out_lo[:nn])
-                        )
-                        mask = host_set.insert(fps_u64)
-                        if disk is not None:
-                            # novel rows stream straight to the spilled
-                            # frontier + parent log in discovery order (int64
-                            # parents: level-global indices can pass 2^31 at
-                            # the scales this tier exists for)
-                            disk.append(
-                                rows[mask],
-                                np.asarray(out_parent[:nn], np.int64)[mask] + start,
-                                np.asarray(out_act[:nn])[mask],
-                            )
-                        else:
-                            lvl_rows.append(rows[mask])
-                            lvl_parent.append(
-                                np.asarray(out_parent[:nn])[mask] + start
-                            )
-                            lvl_act.append(np.asarray(out_act[:nn])[mask])
-                        lvl_new += int(mask.sum())
-                        if chain is not None:
-                            chain.fold(fps_u64[mask.astype(bool)])
-                elif ht_hi is not None and nn:
-                    # device-hash backend: insert-or-find on the HBM table; a
-                    # probe-budget overflow grows the table and re-runs the
-                    # SAME batch, OR-accumulating novelty (rows inserted by the
-                    # failed attempt report "seen" on the re-run, so nothing is
-                    # double-counted or lost)
-                    valid = jnp.arange(out_hi.shape[0]) < new_n
-                    isnew = np.zeros(out_hi.shape[0], bool)
-                    while True:
-                        # Pallas probe kernel (ops/pallas_hashset) — the actual
-                        # TPU dedup kernel a live hardware window profiles;
-                        # interpret mode on CPU, bit-identical winners
-                        # (tests/test_pallas.py).  It stages the whole table in
-                        # VMEM, so beyond MAX_VMEM_CAP slots it cannot compile
-                        # — fall back to the jnp HBM probe, loudly, and keep
-                        # checking per iteration (a mid-run rehash can cross
-                        # the threshold).
-                        use_p = use_p_hbm = False
-                        if step_builder.use_pallas:
-                            # lazy import: the default (non-pallas) path must
-                            # not depend on jax.experimental.pallas at all
-                            from ..ops import pallas_hashset as pallas_hs
-
-                            use_p = pallas_hs.fits_vmem(ht_hi.shape[0])
-                            # beyond the VMEM gate: the HBM-resident DMA
-                            # kernel (opt-in until a hardware window profiles
-                            # its per-slot descriptor overhead)
-                            use_p_hbm = not use_p and (
-                                os.environ.get("KSPEC_PALLAS_HBM") == "1"
-                            )
-                        if (
-                            step_builder.use_pallas
-                            and not use_p
-                            and not use_p_hbm
-                            and not pallas_vmem_noted
-                        ):
-                            pallas_vmem_noted = True
-                            print(
-                                "[kspec] KSPEC_USE_PALLAS: table capacity "
-                                f"{ht_hi.shape[0]} exceeds the VMEM-staged "
-                                f"kernel's limit ({pallas_hs.MAX_VMEM_CAP}); "
-                                "falling back to the jnp HBM probe path "
-                                "(KSPEC_PALLAS_HBM=1 selects the HBM-resident "
-                                "DMA kernel instead)",
-                                file=sys.stderr,
-                                flush=True,
-                            )
-                        if use_p_hbm:
-                            ht_hi, ht_lo, m, _ni, ovf = (
-                                pallas_hs.probe_insert_pallas_hbm(
-                                    ht_hi,
-                                    ht_lo,
-                                    out_hi,
-                                    out_lo,
-                                    valid,
-                                    interpret=jax.default_backend() == "cpu",
-                                )
-                            )
-                            ht_claim = None
-                        elif use_p:
-                            # KSPEC_PALLAS_GROUP: interleaved probe chains per
-                            # round (memory-level parallelism; winners
-                            # bit-identical — ops/pallas_hashset)
-                            ht_hi, ht_lo, m, _ni, ovf = (
-                                pallas_hs.probe_insert_pallas(
-                                    ht_hi,
-                                    ht_lo,
-                                    out_hi,
-                                    out_lo,
-                                    valid,
-                                    interpret=jax.default_backend() == "cpu",
-                                    group=int(
-                                        os.environ.get("KSPEC_PALLAS_GROUP", "8")
-                                    ),
-                                )
-                            )
-                            ht_claim = None
-                        else:
-                            if ht_claim is None:
-                                ht_claim = hashset.new_claim(ht_hi.shape[0])
-                            ht_hi, ht_lo, ht_claim, m, _ni, ovf = _hash_insert(
-                                ht_hi, ht_lo, ht_claim, out_hi, out_lo, valid
-                            )
-                        isnew |= np.asarray(m)
-                        if not bool(ovf):
-                            break
-                        ht_hi, ht_lo = hashset.rehash_into(
-                            ht_hi, ht_lo, 2 * ht_hi.shape[0]
-                        )
-                        ht_claim = None
-                    mask = isnew[:nn]
-                    hash_n += int(mask.sum())
-                    lvl_rows.append(np.asarray(out[:nn])[mask])
-                    lvl_parent.append(np.asarray(out_parent[:nn])[mask] + start)
-                    lvl_act.append(np.asarray(out_act[:nn])[mask])
-                    lvl_new += int(mask.sum())
-                    if chain is not None:
-                        chain.fold(
-                            _integ.pair_u64(
-                                np.asarray(out_hi[:nn])[mask],
-                                np.asarray(out_lo[:nn])[mask],
-                            )
-                        )
-                elif nn:
-                    lvl_rows.append(np.asarray(out[:nn]))
-                    lvl_parent.append(np.asarray(out_parent[:nn]) + start)
-                    lvl_act.append(np.asarray(out_act[:nn]))
-                    lvl_new += nn
-                    if chain is not None:
-                        # device backend: the in-jit dedup already
-                        # compacted exactly the new states to the front
-                        chain.fold(
-                            _integ.pair_u64(
-                                np.asarray(out_hi[:nn]),
-                                np.asarray(out_lo[:nn]),
-                            )
-                        )
-                host_s = time.perf_counter() - t_host
-                prof_host_s += host_s
-                obs_.chunk_span(
-                    "host-assembly", host_s, depth=depth, start=start, new=nn,
-                    backend=visited_backend,
-                )
-                if collect_stats:
-                    lvl_act_en += act_en_np
+                    if staged is not None and _commit_chunk(staged):
+                        # a verdict in chunk k: the just-dispatched chunk
+                        # k+1 is DISCARDED uncommitted — exactly what the
+                        # serial path's break does (its device work is
+                        # pure and side-effect-free until commit)
+                        staged = None
+                        break
+                    staged = cur
+                else:
+                    if _commit_chunk(cur):
+                        break
+            if staged is not None and verdict is None:
+                _commit_chunk(staged)
+            staged = None
 
             if verdict is not None:
                 kind, idx, inv_name = verdict
@@ -2211,6 +2443,33 @@ def check(
             # level-boundary resource governance: pressure gauges, injected
             # stall, soft-breach reclamation, hard-breach typed clean exit
             governor.level_end(depth, reclaim=_reclaim, save_hook=_final_save)
+            # per-level overlap accounting (obs: `kspec_overlap_efficiency`
+            # is how machine-readable "storage I/O fully hidden" is —
+            # ROADMAP item 2's acceptance): hidden = worker-busy wall not
+            # re-exposed as caller blocking; exposed = blocking waits on
+            # workers + synchronous checkpoint writes.  Attached to the
+            # IN-MEMORY level records only (the emitted stats stream is a
+            # pinned historical contract, like the launch counters)
+            if collect_stats and result_stats.get("levels"):
+                busy1, blk1 = _io_counters()
+                hid = max(
+                    0.0, (busy1 - lvl_io0[0]) - (blk1 - lvl_io0[1])
+                )
+                exp = (blk1 - lvl_io0[1]) + (sync_io_s - lvl_sync_io0)
+                eff = hid / (hid + exp) if (hid + exp) > 1e-9 else 1.0
+                rec_mem = result_stats["levels"][-1]
+                rec_mem["io_hidden_ms"] = round(hid * 1e3, 2)
+                rec_mem["io_exposed_ms"] = round(exp * 1e3, 2)
+                rec_mem["overlap_efficiency"] = round(eff, 4)
+                _met.set_gauge("kspec_overlap_efficiency", round(eff, 4))
+                _met.inc("kspec_io_hidden_ms_total", round(hid * 1e3, 2))
+                _met.inc("kspec_io_exposed_ms_total", round(exp * 1e3, 2))
+        # drain the async tail INSIDE the typed-error scope: a pending
+        # checkpoint's ENOSPC or a background merge's injected fault must
+        # map to the same typed exits as their synchronous twins
+        _ckpt_poll(block=True)
+        if disk is not None:
+            disk.quiesce()
     except ResourceExhausted as e:
         exhausted = e
     except IntegrityError as e:
@@ -2251,6 +2510,7 @@ def check(
         except OSError:
             pass
         _drop_ephemeral_spill()
+        _shutdown_async(drain=False)
         raise integrity_fail
     if exhausted is not None:
         # the terminal path itself writes (manifest rewrite, metrics
@@ -2273,6 +2533,7 @@ def check(
             obs_.close()
         except OSError:
             pass
+        _shutdown_async(drain=False)
         raise exhausted
 
     if violation is None and check_invariants and model.invariants and _f_rows(frontier_np):
@@ -2315,6 +2576,23 @@ def check(
             ),
             "transient_retries": chunk_retry.retries_total,
             "degradations": chunk_retry.degradations,
+            # async-overlap accounting (overlap.py): the staged-chunk
+            # bound is structural (two slots) — tests pin peak <= 2
+            "overlap": {
+                "enabled": overlap_on,
+                "staged_chunks_peak": overlap_staged_peak,
+                "sync_ckpt_io_s": round(sync_io_s, 4),
+                **(
+                    {"io_worker": io_worker.stats()}
+                    if io_worker is not None
+                    else {}
+                ),
+                **(
+                    {"ckpt_worker": ckpt_worker.stats()}
+                    if ckpt_worker is not None
+                    else {}
+                ),
+            },
         }
     )
     if host_set is not None:
@@ -2327,6 +2605,7 @@ def check(
         result_stats["hash_table_capacity"] = int(ht_hi.shape[0])
         result_stats["hash_table_size"] = hash_n
     _drop_ephemeral_spill()
+    _shutdown_async(drain=True)
     res = CheckResult(
         model=model.name,
         levels=levels,

@@ -311,6 +311,19 @@ class LegacyPipeline:
             out_hi, out_lo, act_guard, dispatched,
         )
 
+    def run_chunk_staged(self, piece, fp_n, bucket, depth,
+                         vhi, vlo, vn, vcap):
+        """Staged form of :meth:`run_chunk` for the overlap driver:
+        -> (vhi, vlo, vn, finalize).  The legacy path must read its
+        overflow flags before committing (the retry ladder), which
+        forces the whole program — so its dispatch is already complete
+        and finalize is a no-op closure over the committed tuple.  The
+        overlap win for legacy chunks is therefore only the reordering
+        of host commits, never deferred device work (docs/engine.md)."""
+        outs = self.run_chunk(piece, fp_n, bucket, depth, vhi, vlo, vn,
+                              vcap)
+        return outs[4], outs[5], outs[6], lambda: outs
+
 
 # --------------------------------------------------------------------------
 # fused pipeline: guard matrix + pooled update skeleton (2 launches)
@@ -567,13 +580,35 @@ class FusedPipeline:
 
     # --- the chunk driver -------------------------------------------------
     def run_chunk(self, piece, fp_n, bucket, depth, vhi, vlo, vn, vcap):
+        _h1, _h2, _h3, finalize = self.run_chunk_staged(
+            piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
+        )
+        return finalize()
+
+    def run_chunk_staged(self, piece, fp_n, bucket, depth,
+                         vhi, vlo, vn, vcap, reset: bool = True):
+        """Dispatch both fused launches; -> (vhi, vlo, vn, finalize).
+
+        The guard matrix is forced here (its counts drive the host
+        compaction that shapes launch 2), but launch 2's outputs stay
+        in-flight: the overlap driver in check() dispatches chunk k+1's
+        programs BEFORE calling chunk k's finalize(), so the host
+        compaction/arena assembly of one chunk runs while the other's
+        update-skeleton/dedup launch drains on device (the two-slot
+        staging queue; docs/engine.md § Async execution).  finalize()
+        blocks on the outputs and returns run_chunk's exact tuple —
+        with overlap off check() finalizes immediately, which IS the
+        historical serial behavior.  The returned visited refs chain the
+        next chunk's dispatch on the device backend (functional, still
+        in-flight — JAX async dispatch pipelines them)."""
         if not self._gate(bucket):
-            return self.legacy.run_chunk(
+            return self.legacy.run_chunk_staged(
                 piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
             )
         from .bfs import _pad_rows
 
-        self.chunk_retry.reset_chunk()
+        if reset:
+            self.chunk_retry.reset_chunk()
         dispatched = 0  # successor programs actually dispatched,
         # retries included — what "launches" honestly means
         while True:
@@ -602,25 +637,7 @@ class FusedPipeline:
                     jnp.asarray(rowvalid), vhi, vlo, vn,
                 )
                 dispatched += 1  # launch 2: the update skeleton
-                if self.visited_backend == "host":
-                    cand, ok, hi, lo = outs
-                    ok_np = np.asarray(ok)
-                    nn = int(ok_np.sum())
-                    out = np.asarray(cand)[ok_np]
-                    out_parent = sidx[ok_np]
-                    out_act = self._actid_np(widths)[ok_np]
-                    out_hi = np.asarray(hi)[ok_np]
-                    out_lo = np.asarray(lo)[ok_np]
-                    offs = np.cumsum([0] + list(widths))
-                    act_en = np.asarray(
-                        [
-                            int(ok_np[offs[i]: offs[i + 1]].sum())
-                            for i in range(len(widths))
-                        ],
-                        np.int64,
-                    )
-                    new_n = nn
-                else:
+                if self.visited_backend != "host":
                     (out, out_parent, out_act, new_n, out_hi, out_lo,
                      vhi, vlo, vn, act_en) = outs
             except Exception as e:  # noqa: BLE001 — XLA compile/run
@@ -638,14 +655,82 @@ class FusedPipeline:
 
                 _obs.event("pipeline-fallback", depth=depth,
                            error=f"{type(e).__name__}: {e}"[:200])
-                return self.legacy.run_chunk(
+                return self.legacy.run_chunk_staged(
                     piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
                 )
-            return (
+            if self.visited_backend == "host":
+                # host backend: validity is resolved at C speed on the
+                # host — deferred into finalize so the np conversions
+                # (the device-wait) land at commit time, off the next
+                # chunk's dispatch path
+                def finalize(outs=outs, sidx=sidx, widths=widths,
+                             vhi=vhi, vlo=vlo, vn=vn,
+                             act_guard_np=act_guard_np,
+                             verdicts=(viol_any, viol_idx, dl_any,
+                                       dl_idx),
+                             dispatched=dispatched):
+                    cand, ok, hi, lo = outs
+                    viol_any, viol_idx, dl_any, dl_idx = verdicts
+                    try:
+                        # JAX async dispatch defers runtime errors to the
+                        # first materialization — which is HERE, outside
+                        # the dispatch-time try.  Route them through the
+                        # same failure ladder: transients re-run the
+                        # whole chunk synchronously; anything else
+                        # degrades the run to legacy (the documented
+                        # fused failure contract)
+                        ok_np = np.asarray(ok)
+                    except Exception as e:  # noqa: BLE001 — XLA runtime
+                        action = self.chunk_retry.handle(
+                            e, escalated=True, depth=depth
+                        )
+                        if action != "retry":
+                            self.fallback = True
+                            from ..obs import tracer as _obs
+
+                            _obs.event(
+                                "pipeline-fallback", depth=depth,
+                                error=f"{type(e).__name__}: {e}"[:200],
+                            )
+                            return self.legacy.run_chunk(
+                                piece, fp_n, bucket, depth,
+                                vhi, vlo, vn, vcap,
+                            )
+                        # re-run the chunk WITHOUT resetting the
+                        # per-chunk retry budget: handle() raises once
+                        # it is exhausted, so the recursion is bounded
+                        _r1, _r2, _r3, fin2 = self.run_chunk_staged(
+                            piece, fp_n, bucket, depth, vhi, vlo, vn,
+                            vcap, reset=False,
+                        )
+                        return fin2()
+                    nn = int(ok_np.sum())
+                    out = np.asarray(cand)[ok_np]
+                    out_parent = sidx[ok_np]
+                    out_act = self._actid_np(widths)[ok_np]
+                    out_hi = np.asarray(hi)[ok_np]
+                    out_lo = np.asarray(lo)[ok_np]
+                    offs = np.cumsum([0] + list(widths))
+                    act_en = np.asarray(
+                        [
+                            int(ok_np[offs[i]: offs[i + 1]].sum())
+                            for i in range(len(widths))
+                        ],
+                        np.int64,
+                    )
+                    return (
+                        out, out_parent, out_act, nn, vhi, vlo, vn,
+                        viol_any, viol_idx, dl_any, dl_idx, act_en,
+                        out_hi, out_lo, act_guard_np, dispatched,
+                    )
+
+                return vhi, vlo, vn, finalize
+            committed = (
                 out, out_parent, out_act, new_n, vhi, vlo, vn,
                 viol_any, viol_idx, dl_any, dl_idx, act_en,
                 out_hi, out_lo, act_guard_np, dispatched,
             )
+            return vhi, vlo, vn, lambda: committed
 
     def _actid_np(self, widths: tuple) -> np.ndarray:
         return np.concatenate(

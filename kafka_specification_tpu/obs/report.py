@@ -337,6 +337,7 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
         "died_shards": died,
         "resource": resource,
         "integrity": _integrity(data),
+        "overlap": _overlap(data),
     }
 
 
@@ -355,6 +356,48 @@ def _integrity(data: dict) -> dict:
             if e.get("event") == "integrity-violation"
         ],
     }
+
+
+def _overlap(data: dict) -> dict:
+    """Async-overlap beat (KSPEC_OVERLAP, docs/engine.md § Async
+    execution): how much storage/checkpoint/exchange wall hid behind
+    device compute.  `kspec_overlap_efficiency` is the per-level gauge
+    (1.0 = every background-I/O second overlapped; snapshots give its
+    history), the io counters are run totals, and `exposed_io_stalled`
+    is the machine-readable acceptance signal for ROADMAP item 2's
+    "storage I/O fully hidden": True when more exposed than hidden I/O
+    wall accumulated — the engine is stalling on I/O it should hide."""
+    last = data.get("metrics") or {}
+    counters = last.get("counters") or {}
+    gauges = last.get("gauges") or {}
+    series = []
+    for snap in data.get("metrics_history") or ():
+        v = (snap.get("gauges") or {}).get("kspec_overlap_efficiency")
+        if v is not None:
+            series.append(v)
+    hidden = counters.get("kspec_io_hidden_ms_total", 0)
+    exposed = counters.get("kspec_io_exposed_ms_total", 0)
+    out = {
+        "efficiency": gauges.get("kspec_overlap_efficiency"),
+        "series": series,
+        "io_hidden_ms": hidden,
+        "io_exposed_ms": exposed,
+        "exchange_bytes_level": gauges.get("kspec_exchange_bytes_level"),
+        "exchange_compression_ratio": gauges.get(
+            "kspec_exchange_compression_ratio"
+        ),
+        "exposed_io_stalled": bool(
+            (hidden + exposed) > 0 and exposed > hidden
+        ),
+    }
+    out["present"] = bool(
+        series
+        or hidden
+        or exposed
+        or out["efficiency"] is not None
+        or out["exchange_compression_ratio"] is not None
+    )
+    return out
 
 
 def _resource_pressure(data: dict) -> dict:
@@ -595,6 +638,34 @@ def render_report(run_dir: str, now: Optional[float] = None,
             f"{integ.get('shadow_samples', 0)} shadow samples, "
             f"{integ.get('violations', 0)} violations"
         )
+    ov = r.get("overlap") or {}
+    if ov.get("present"):
+        eff = ov.get("efficiency")
+        bits = []
+        if eff is not None:
+            bits.append(f"overlap efficiency {eff:.0%}"
+                        + (" " + _spark(ov["series"])
+                           if ov.get("series") else ""))
+        bits.append(
+            f"I/O hidden {ov.get('io_hidden_ms', 0):.0f}ms / exposed "
+            f"{ov.get('io_exposed_ms', 0):.0f}ms"
+        )
+        if ov.get("exchange_compression_ratio"):
+            bits.append(
+                f"exchange compressed {ov['exchange_compression_ratio']}x"
+            )
+        out.append("  overlap: " + "  ".join(bits))
+        if ov.get("exposed_io_stalled"):
+            # the exposed-I/O stall beat: ROADMAP item 2's acceptance
+            # ("storage I/O fully hidden") made machine-readable — more
+            # I/O wall was exposed on the critical path than hidden
+            out.append(
+                "  EXPOSED-I/O STALL: more storage/checkpoint wall "
+                "landed on the critical path than was hidden behind "
+                "compute — check --overlap is on, and whether the "
+                "spill disk or checkpoint cadence is outrunning the "
+                "per-level compute budget."
+            )
     if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
         out.append(f"  died mid-level: level {r['open_level']} began but "
                    f"never completed")

@@ -1,0 +1,285 @@
+"""Bit-packed delta codec for sorted fingerprint exchange payloads.
+
+The sharded engine's ``all_to_all`` routes each chunk's candidate
+fingerprints to their owner shard in per-destination buckets of W slots,
+padded with sentinels — at typical enablement the buckets are mostly
+padding, and every slot ships 8 bytes of (hi, lo) u32 lanes regardless.
+This module is the compressed wire format (ROADMAP item 5):
+
+- each destination bucket is **sorted** (stable, sentinels last — the
+  property that keeps dedup winners bit-identical, see
+  parallel/sharded.py), so its live prefix is an ascending u64 sequence;
+- the live values are **delta-encoded** from a per-bucket base (the
+  first value rides the header), deltas of the padding tail are forced
+  to zero via the bucket's live count (also in the header);
+- deltas are **bit-packed** in blocks of :data:`BLK` values, each block
+  at the bitwidth of its largest delta — zero-delta padding blocks pack
+  to zero bits, live blocks to ~(64 - log2(live density)) bits/value —
+  into a static ``n_words``-word u32 stream (static shapes under jit;
+  a stream that does not fit raises the overflow flag and the chunk
+  re-runs wider, the same ladder as every other exchange overflow).
+
+Everything is u32-lane arithmetic (64-bit values as (hi, lo) pairs with
+explicit carries/borrows): TPUs run with x64 disabled, exactly like the
+fingerprint lanes themselves.  ``pack_np``/``unpack_np`` are the numpy
+twins — bit-identical to the traced kernels (tests/test_overlap.py
+round-trips both).  Integrity: the exchange's in-jit framing digests are
+computed over the *decoded* payload (parallel/sharded.py), so a bit the
+fabric flips anywhere in the packed stream, the header, or the codec
+itself desyncs the sent/received digests — compression does not weaken
+the PR 9 fabric contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: values per bit-packing block (one shared bitwidth per block; 32 keeps
+#: the block-granularity waste tolerable on small destination buckets)
+BLK = 32
+#: header layout: [count, first_hi, first_lo] + one bitwidth per block
+HDR = 3
+
+_SENT = 0xFFFFFFFF
+
+
+def n_blocks(width: int) -> int:
+    return -(-int(width) // BLK)
+
+
+def header_words(width: int) -> int:
+    return HDR + n_blocks(width)
+
+
+def default_stream_words(width: int) -> int:
+    """Default packed-stream budget: ONE u32 word per slot — a 2x byte
+    reduction on the fingerprint lanes (vs 2 words/slot raw).  Random
+    64-bit fingerprints only delta-compress to ~(66 - log2(live count))
+    bits/value, so the real win is the padding tail packing to zero
+    bits: one word/slot fits live prefixes up to ~1/2 bucket occupancy;
+    denser chunks trip the overflow flag and re-run on the existing
+    destination-width ladder (one doubling halves the occupancy)."""
+    return max(BLK, int(width))
+
+
+def _nbits32(x):
+    """Bit length of a u32 (0 -> 0), branch-free (no clz in jnp)."""
+    x = x.astype(jnp.uint32)
+    n = jnp.zeros(x.shape, jnp.int32)
+    for s in (16, 8, 4, 2, 1):
+        big = x >> s
+        take = big > jnp.uint32(0)
+        x = jnp.where(take, big, x)
+        n = n + take.astype(jnp.int32) * s
+    return n + (x > 0).astype(jnp.int32)
+
+
+def pack_sorted(hi, lo, count, n_words: int):
+    """Pack one sorted bucket's fingerprint lanes -> (words, header, ovf).
+
+    hi/lo: [W] u32 lanes, ascending as u64 with the sentinel padding
+    tail sorted last.  count: live (non-sentinel) values.  Returns the
+    packed u32 stream [n_words], the header [HDR + n_blocks(W)] u32
+    (count, first value lanes, per-block bitwidths), and the overflow
+    flag (stream too small for this bucket's delta entropy — outputs are
+    then incomplete and the caller must re-run wider).  Traced; static
+    shapes from (W, n_words)."""
+    W = hi.shape[0]
+    NB = n_blocks(W)
+    Wp = NB * BLK
+    count = jnp.minimum(count, W).astype(jnp.int32)
+    idx = jnp.arange(Wp, dtype=jnp.int32)
+    live = idx < count
+    hi_p = jnp.concatenate(
+        [hi, jnp.full((Wp - W,), _SENT, jnp.uint32)]
+    ) if Wp > W else hi
+    lo_p = jnp.concatenate(
+        [lo, jnp.full((Wp - W,), _SENT, jnp.uint32)]
+    ) if Wp > W else lo
+    first_hi = jnp.where(count > 0, hi_p[0], jnp.uint32(0))
+    first_lo = jnp.where(count > 0, lo_p[0], jnp.uint32(0))
+    # two-limb delta v[i] - v[i-1] (ascending => non-negative u64);
+    # index 0 deltas from the header base (delta 0), padding deltas 0
+    ph = jnp.concatenate([first_hi[None], hi_p[:-1]])
+    pl = jnp.concatenate([first_lo[None], lo_p[:-1]])
+    dlo = lo_p - pl
+    borrow = (lo_p < pl).astype(jnp.uint32)
+    dhi = hi_p - ph - borrow
+    dhi = jnp.where(live, dhi, jnp.uint32(0))
+    dlo = jnp.where(live, dlo, jnp.uint32(0))
+    bw = jnp.where(dhi > 0, 32 + _nbits32(dhi), _nbits32(dlo))  # [Wp]
+    bwb = bw.reshape(NB, BLK).max(axis=1)  # [NB] bits/value per block
+    blk_bits = bwb * BLK
+    blk_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(blk_bits)[:-1]]
+    )
+    total_bits = jnp.sum(blk_bits)
+    ovf = total_bits > n_words * 32
+    b = idx // BLK
+    pos = blk_off[b] + (idx % BLK) * bwb[b]
+    w = pos // 32
+    sh = (pos % 32).astype(jnp.uint32)
+    sh32 = (jnp.uint32(32) - sh) & jnp.uint32(31)
+    nz = sh > 0
+    # a value spans <= 3 words; contributions never overlap bit-wise
+    # (each value owns [pos, pos+bw) and bw >= its bit length), so
+    # scatter-add composes them exactly like bitwise-or
+    c0 = dlo << sh
+    c1 = jnp.where(nz, (dlo >> sh32) | (dhi << sh), dhi)
+    c2 = jnp.where(nz, dhi >> sh32, jnp.uint32(0))
+    words = jnp.zeros((n_words,), jnp.uint32)
+    words = words.at[w].add(c0, mode="drop")
+    words = words.at[w + 1].add(c1, mode="drop")
+    words = words.at[w + 2].add(c2, mode="drop")
+    header = jnp.concatenate(
+        [
+            count.astype(jnp.uint32)[None],
+            first_hi[None],
+            first_lo[None],
+            bwb.astype(jnp.uint32),
+        ]
+    )
+    return words, header, ovf
+
+
+def unpack_sorted(words, header, width: int):
+    """Inverse of :func:`pack_sorted` -> (hi, lo) [width] u32 lanes with
+    the sentinel tail restored.  Traced; bit-identical to the numpy
+    twin."""
+    NB = n_blocks(width)
+    Wp = NB * BLK
+    count = header[0].astype(jnp.int32)
+    first_hi = header[1]
+    first_lo = header[2]
+    bwb = header[HDR:].astype(jnp.int32)  # [NB]
+    blk_bits = bwb * BLK
+    blk_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(blk_bits)[:-1]]
+    )
+    idx = jnp.arange(Wp, dtype=jnp.int32)
+    b = idx // BLK
+    bw = bwb[b]
+    pos = blk_off[b] + (idx % BLK) * bw
+    w = pos // 32
+    sh = (pos % 32).astype(jnp.uint32)
+    sh32 = (jnp.uint32(32) - sh) & jnp.uint32(31)
+    nz = sh > 0
+    wpad = jnp.concatenate([words, jnp.zeros((4,), jnp.uint32)])
+    w0 = wpad[w]
+    w1 = wpad[w + 1]
+    w2 = wpad[w + 2]
+    vlo = (w0 >> sh) | jnp.where(nz, w1 << sh32, jnp.uint32(0))
+    vhi = jnp.where(nz, (w1 >> sh) | (w2 << sh32), w1)
+    lo_bits = jnp.minimum(bw, 32)
+    hi_bits = jnp.maximum(bw - 32, 0)
+    one = jnp.uint32(1)
+    lo_mask = jnp.where(
+        lo_bits >= 32,
+        jnp.uint32(_SENT),
+        (one << jnp.minimum(lo_bits, 31).astype(jnp.uint32)) - one,
+    )
+    hi_mask = jnp.where(
+        hi_bits >= 32,
+        jnp.uint32(_SENT),
+        (one << jnp.minimum(hi_bits, 31).astype(jnp.uint32)) - one,
+    )
+    dlo = vlo & lo_mask
+    dhi = vhi & hi_mask
+
+    def _add64(a, bb):
+        lo = a[1] + bb[1]
+        carry = (lo < bb[1]).astype(jnp.uint32)
+        return (a[0] + bb[0] + carry, lo)
+
+    # running 64-bit sum of deltas (associative two-limb addition), then
+    # re-base on the header's first value
+    shi, slo = jax.lax.associative_scan(_add64, (dhi, dlo))
+    lo = slo + first_lo
+    hi = shi + first_hi + (lo < first_lo).astype(jnp.uint32)
+    live = idx < count
+    hi = jnp.where(live, hi, jnp.uint32(_SENT))[:width]
+    lo = jnp.where(live, lo, jnp.uint32(_SENT))[:width]
+    return hi, lo
+
+
+def packed_bytes(width: int, n_words: int) -> int:
+    """Wire bytes of one packed bucket (stream + header)."""
+    return 4 * (int(n_words) + header_words(width))
+
+
+def raw_bytes(width: int) -> int:
+    """Wire bytes of one raw bucket's fingerprint lanes (hi + lo)."""
+    return 8 * int(width)
+
+
+# --- numpy twins (tests; jax-free consumers) ------------------------------
+
+
+def pack_np(hi, lo, count, n_words: int):
+    hi = np.asarray(hi, np.uint32)
+    lo = np.asarray(lo, np.uint32)
+    W = hi.shape[0]
+    NB = n_blocks(W)
+    Wp = NB * BLK
+    count = int(min(count, W))
+    v = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+    v = np.concatenate([v, np.full(Wp - W, np.uint64(0xFFFFFFFFFFFFFFFF))])
+    first = v[0] if count > 0 else np.uint64(0)
+    d = np.zeros(Wp, np.uint64)
+    if count > 0:
+        d[1:count] = v[1:count] - v[:count - 1]
+    bw = np.array([int(x).bit_length() for x in d], np.int64)
+    bwb = bw.reshape(NB, BLK).max(axis=1)
+    blk_off = np.concatenate([[0], np.cumsum(bwb * BLK)[:-1]])
+    total_bits = int((bwb * BLK).sum())
+    ovf = total_bits > n_words * 32
+    stream = 0
+    for i in range(Wp):
+        b = i // BLK
+        if bwb[b] == 0:
+            continue
+        pos = int(blk_off[b] + (i % BLK) * bwb[b])
+        stream |= int(d[i]) << pos
+    words = np.zeros(n_words, np.uint32)
+    mask = (1 << 32) - 1
+    for wI in range(n_words):
+        words[wI] = (stream >> (32 * wI)) & mask
+    header = np.concatenate(
+        [
+            np.asarray(
+                [count, int(first >> np.uint64(32)), int(first & np.uint64(0xFFFFFFFF))],
+                np.uint32,
+            ),
+            bwb.astype(np.uint32),
+        ]
+    )
+    return words, header, bool(ovf)
+
+
+def unpack_np(words, header, width: int):
+    words = np.asarray(words, np.uint32)
+    header = np.asarray(header, np.uint32)
+    NB = n_blocks(width)
+    count = int(header[0])
+    first = (np.uint64(header[1]) << np.uint64(32)) | np.uint64(header[2])
+    bwb = header[HDR:HDR + NB].astype(np.int64)
+    blk_off = np.concatenate([[0], np.cumsum(bwb * BLK)[:-1]])
+    stream = 0
+    for wI in range(words.shape[0] - 1, -1, -1):
+        stream = (stream << 32) | int(words[wI])
+    out = np.full(width, np.uint64(0xFFFFFFFFFFFFFFFF))
+    acc = int(first)
+    for i in range(min(count, width)):
+        b = i // BLK
+        bwv = int(bwb[b])
+        if bwv:
+            pos = int(blk_off[b] + (i % BLK) * bwv)
+            acc = (acc + ((stream >> pos) & ((1 << bwv) - 1))) & (
+                (1 << 64) - 1
+            )
+        out[i] = np.uint64(acc)
+    hi = (out >> np.uint64(32)).astype(np.uint32)
+    lo = (out & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo

@@ -64,6 +64,7 @@ from ..engine.bfs import (
     walk_trace,
 )
 from ..models.base import Model
+from ..obs import metrics as _met
 from ..obs.observer import RunObserver
 from ..ops import dedup, hashset
 from ..resilience import integrity as _integ
@@ -153,6 +154,7 @@ def _make_sharded_step(
     dest_w: Optional[int] = None,
     with_merge: bool = True,
     hash_table: bool = False,
+    compress: bool = False,
 ):
     """Jitted sharded level step.
 
@@ -180,6 +182,23 @@ def _make_sharded_step(
       - "all_gather": every shard receives ALL candidates and filters to
         the ones it owns — D× the bytes, kept as the simple/robust
         fallback.
+
+    compress (all_to_all only; KSPEC_OVERLAP's exchange leg, ROADMAP
+    item 5): each destination bucket is stably SORTED by fingerprint
+    (sentinels last), its fingerprint lanes ride the wire bit-packed/
+    delta-encoded (ops/fpcompress — the padding tail packs to ~zero
+    bits), its candidate rows/parents ride at a compacted half-width,
+    and action ids travel as u8 — >=2x fewer exchange bytes per chunk.
+    Decoding happens in-jit on the receiver, and the post-exchange
+    framing digest is computed over the DECODED payload, so the PR 9
+    fabric-integrity contract covers the codec itself.  Bit-identity
+    holds because the per-bucket sort is STABLE: duplicate fingerprints
+    keep their candidate order inside a bucket and buckets keep their
+    source-shard order, so the receiver's stable lexsort elects exactly
+    the winners the uncompressed path elects (same counts, same trace
+    values).  A bucket too dense for its packed stream or compact row
+    budget raises the destination-overflow flag and the chunk re-runs
+    on the existing width ladder.
     """
     spec = model.spec
     expander = _Step(model)
@@ -241,7 +260,79 @@ def _make_sharded_step(
 
         sent_dig = fp_digest(hi, lo, valid)
 
-        if exchange == "all_to_all":
+        if exchange == "all_to_all" and compress:
+            from ..ops import fpcompress as _fpc
+
+            Wr = max(32, W // 2)  # compact row budget (valid-first rows)
+            NWc = _fpc.default_stream_words(W)
+            owner = jnp.where(valid, (lo % jnp.uint32(D)).astype(jnp.int32), D)
+            s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
+            for d in range(D):
+                mask = owner == d
+                cnts.append(jnp.sum(mask, dtype=jnp.int32))
+                cpos = jnp.where(mask, jnp.cumsum(mask) - 1, W)
+                s_hi.append(jnp.full((W,), sent).at[cpos].set(hi))
+                s_lo.append(jnp.full((W,), sent).at[cpos].set(lo))
+                s_cand.append(jnp.zeros((W, K), jnp.uint32).at[cpos].set(cand))
+                s_par.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(parent_g))
+                s_act.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(actid))
+            b_hi = jnp.stack(s_hi)  # [D, W]
+            b_lo = jnp.stack(s_lo)
+            cnts_a = jnp.stack(cnts)  # [D]
+            # STABLE per-bucket fingerprint sort (vmapped: ONE batched
+            # sort program, not D copies — compile-time matters on this
+            # engine's many step shapes): sentinels (max u64) sink last,
+            # ties keep candidate order — the property the bit-identity
+            # argument in the docstring rests on
+            perm = jax.vmap(lambda h, l: jnp.lexsort((l, h)))(b_hi, b_lo)
+            b_hi = jnp.take_along_axis(b_hi, perm, axis=1)
+            b_lo = jnp.take_along_axis(b_lo, perm, axis=1)
+            b_cand = jnp.take_along_axis(
+                jnp.stack(s_cand), perm[:, :, None], axis=1
+            )
+            b_par = jnp.take_along_axis(jnp.stack(s_par), perm, axis=1)
+            b_act = jnp.take_along_axis(jnp.stack(s_act), perm, axis=1)
+            s_words, s_hdr, ovf_pack = jax.vmap(
+                lambda h, l, c: _fpc.pack_sorted(h, l, c, NWc)
+            )(b_hi, b_lo, cnts_a)
+            ovf_dest = jnp.any(cnts_a > W) | jnp.any(
+                ovf_pack | (cnts_a > Wr)
+            )
+            a2a = lambda x: jax.lax.all_to_all(  # noqa: E731
+                x, "d", split_axis=0, concat_axis=0, tiled=True
+            )
+            r_words = a2a(s_words)  # [D, NWc]
+            r_hdr = a2a(s_hdr)  # [D, HDR + NB]
+            r_cand_c = a2a(b_cand[:, :Wr])  # [D, Wr, K]
+            r_par_c = a2a(b_par[:, :Wr])
+            r_act_c = a2a(b_act[:, :Wr].astype(jnp.uint8))
+            # in-jit decode per source segment; the framing digest below
+            # runs over THESE decoded lanes, so fabric integrity covers
+            # the packed stream, the header and the codec
+            dec_hi, dec_lo = jax.vmap(
+                lambda wds, hd: _fpc.unpack_sorted(wds, hd, W)
+            )(r_words, r_hdr)
+            r_hi = dec_hi.reshape(R)
+            r_lo = dec_lo.reshape(R)
+            # compact rows pad back to W slots per source segment; the
+            # live rows are the first cnt of each (valid-first after the
+            # bucket sort), exactly aligned with the decoded lanes
+            r_cand = (
+                jnp.zeros((D, W, K), jnp.uint32)
+                .at[:, :Wr].set(r_cand_c)
+                .reshape(R, K)
+            )
+            r_parent = (
+                jnp.full((D, W), -1, jnp.int32)
+                .at[:, :Wr].set(r_par_c)
+                .reshape(R)
+            )
+            r_act = (
+                jnp.full((D, W), -1, jnp.int32)
+                .at[:, :Wr].set(r_act_c.astype(jnp.int32))
+                .reshape(R)
+            )
+        elif exchange == "all_to_all":
             owner = jnp.where(valid, (lo % jnp.uint32(D)).astype(jnp.int32), D)
             s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
             for d in range(D):
@@ -588,6 +679,7 @@ def check_sharded(
     disk_budget=None,
     run=None,
     shard_heartbeat_dir: Optional[str] = None,
+    overlap: Optional[bool] = None,
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -662,6 +754,24 @@ def check_sharded(
     gauge; spans/metrics/manifest land in the run directory.  In a
     multi-process job only the coordinator observes (the replicated host
     loops would otherwise write D copies of every artifact).
+
+    overlap: async level-pipelined execution ($KSPEC_OVERLAP, default
+    on; ``off`` = the historical serial behavior, the bit-identity
+    oracle).  In this engine it enables (1) the COMPRESSED all_to_all —
+    per-destination buckets stably sorted by fingerprint, fingerprint
+    lanes bit-packed/delta-encoded (ops/fpcompress), rows/parents at a
+    compacted half-width, action ids as u8, with the post-exchange
+    framing digest computed over the DECODED payload (>=2x fewer
+    exchange bytes, fabric integrity unweakened; defaults on only where
+    a real fabric carries the collective — on the virtual CPU mesh the
+    codec is pure compute overhead — and KSPEC_EXCHANGE_COMPRESS=1/0
+    forces either way); (2) staged chunk commit on the
+    host backend — chunk k+1's program (expand + exchange) is dispatched
+    before chunk k's host commit runs, so per-shard FpSet inserts hide
+    behind the in-flight exchange and vice versa; (3) background
+    spill-run merges per shard and (4) async checkpoint writes, exactly
+    as in engine.check.  Bit-identical results across the knob: counts,
+    traces, digest chains (tests/test_overlap.py).
 
     disk_budget: spill + checkpoint directory byte budget
     (resilience.resources) — soft breach reclaims (tmp janitor, eager
@@ -867,6 +977,12 @@ def check_sharded(
     result_levels: list = []  # per-level stats records (mirrors engine.check)
     steps = {}
     w_extra = 0  # extra doublings of the all_to_all per-destination width
+    exch_bytes_total = 0  # exchange wire bytes actually moved (all_to_all)
+    exch_raw_bytes_total = 0  # ... and the raw-layout bytes at same widths
+    overlap_staged_peak = 0  # most chunks ever staged at once (<= 2)
+
+    def _io_counters():
+        return worker_counters((io_worker, ckpt_worker))
 
     # Adaptive per-action compact sizing (round-5 port of the single-device
     # engine's policy — one shared implementation, engine.bfs.AdaptiveCompact).
@@ -892,6 +1008,43 @@ def check_sharded(
     # which is the failure the fleet supervisor exists to catch
     fault.set_local_shards(my_shards)
     fault.validate_shards(D)
+    # async overlap layer (overlap.py; $KSPEC_OVERLAP, default on) — the
+    # same knob as engine.check: background per-shard merges + async
+    # checkpoint writes ride worker threads, the staged chunk commit and
+    # the compressed exchange ride the step itself.  The resolution is
+    # env-replicated, so every process takes the same path (lockstep).
+    from ..overlap import (
+        AsyncWorker,
+        close_workers,
+        overlap_enabled,
+        worker_counters,
+    )
+
+    overlap_on = overlap_enabled(overlap)
+    # Compressed exchange default: ON where a real fabric carries the
+    # all_to_all (the bytes are the scarce resource compression buys
+    # back), OFF on the virtual CPU mesh (no wire — the codec's encode/
+    # decode compute is pure overhead there; BENCH_r10 measures the
+    # trade both ways).  KSPEC_EXCHANGE_COMPRESS=1/0 forces either.
+    _comp_env = os.environ.get("KSPEC_EXCHANGE_COMPRESS", "")
+    compress_on = (
+        overlap_on
+        and exchange == "all_to_all"
+        and len(model.actions) < 255  # act ids ride the wire as u8
+        and (
+            _comp_env == "1"
+            or (_comp_env != "0" and jax.default_backend() != "cpu")
+        )
+    )
+    io_worker = AsyncWorker("kspec-io") if overlap_on else None
+    ckpt_worker = (
+        AsyncWorker("kspec-ckpt")
+        if overlap_on and checkpoint_dir is not None
+        else None
+    )
+
+    def _shutdown_async(drain: bool) -> None:
+        close_workers((io_worker, ckpt_worker), drain)
     # state-integrity defense (resilience.integrity): the same always-on
     # level digest chain as the single-device engine — the digest is over
     # the new-state fingerprint MULTISET, which is shard-layout-invariant,
@@ -920,10 +1073,12 @@ def check_sharded(
 
     if use_disk:
         # the plan is parsed after the per-shard sets are built — hand it
-        # to them now (mid-merge crash injection, crash@merge:N)
+        # to them now (mid-merge crash injection, crash@merge:N), along
+        # with the background-merge worker (KSPEC_OVERLAP)
         for s in host_sets:
             if s is not None:
                 s.fault_plan = fault
+                s.merge_worker = io_worker
     chunk_retry = ChunkRetryHandler.from_env("[sharded]")
     ckpt_store = None
     # newest durably checkpointed level (None = not checkpointing):
@@ -992,6 +1147,8 @@ def check_sharded(
                 else (_spill_ref_errors,)
             ),
         )
+        if ckpt_worker is not None:
+            ckpt_store.attach_writer(ckpt_worker)
         if want_trace:
             # per-shard on-disk parent logs: counterexample traces that
             # survive checkpoint resume (the sharded twin of the single-
@@ -1103,7 +1260,6 @@ def check_sharded(
                     plog = None
                 if plog is not None and not plog.reshard(depth, pending):
                     plog = None  # old segments unreadable: trace-less
-                from ..obs import metrics as _met
                 from ..obs import tracer as _obs_t
 
                 _obs_t.event(
@@ -1213,14 +1369,72 @@ def check_sharded(
     dev_vlo = put_global(vlo, shard1)
     dev_vn = put_global(vn, shard1)
 
-    def _advance_spill_gc():
+    # async-checkpoint bookkeeping (KSPEC_OVERLAP; mirrors engine.bfs):
+    # `last_ckpt_depth` = submitted, `ckpt_durable_depth` = promoted.
+    # Crash deferral / flip gating key on durability; completion
+    # callbacks (deletion-barrier advance, chain read-back) run on THIS
+    # thread in submission order as saves promote.
+    ckpt_durable_depth = last_ckpt_depth
+    ckpt_cbs: list = []
+
+    def _ckpt_poll(block: bool = False) -> None:
+        nonlocal ckpt_durable_depth
+        if ckpt_worker is None or ckpt_store is None:
+            return
+        done = (
+            ckpt_store.drain_async() if block else ckpt_store.poll_async()
+        )
+        for d, path in done:
+            cb = ckpt_cbs.pop(0) if ckpt_cbs else None
+            if cb is not None:
+                cb(path)
+            ckpt_durable_depth = (
+                d if ckpt_durable_depth is None
+                else max(ckpt_durable_depth, d)
+            )
+
+    def _store_save(arrays, part=None, on_done=None,
+                    sync: bool = False) -> None:
+        """One checkpoint-store write, sync or on the writer thread.
+        `on_done(path)` runs after the atomic promote — on this thread
+        at the next _ckpt_poll when async (barrier advances and chain
+        read-backs stay on the engine thread / writer respectively)."""
+        nonlocal ckpt_durable_depth
+        if ckpt_worker is not None and not sync:
+            ckpt_cbs.append(on_done)
+            ckpt_store.save_async(depth, arrays, part=part)
+            return
+        path = ckpt_store.save(depth, arrays, part=part)
+        if on_done is not None:
+            on_done(path)
+        ckpt_durable_depth = (
+            depth if ckpt_durable_depth is None
+            else max(ckpt_durable_depth, depth)
+        )
+
+    def _advance_spill_gc(marks=None):
         # a new durable generation exists: advance each owned tiered
         # set's deferred-deletion barrier (merged-away runs older than
-        # every retained generation get unlinked)
+        # every retained generation get unlinked).  `marks` (async
+        # saves) restrict the advance to the files scheduled before the
+        # save's snapshot — see storage.tiered.DeferredDeleter.mark
         if use_disk:
             for s in host_sets:
                 if s is not None:
-                    s.on_checkpoint_saved()
+                    s.deleter.on_save(
+                        upto=None if marks is None else marks.get(id(s))
+                    )
+
+    def _gc_marks():
+        return (
+            {
+                id(s): s.deleter.mark()
+                for s in host_sets
+                if s is not None
+            }
+            if use_disk
+            else None
+        )
 
     def _levels_for_save():
         """The coordinator main's levels array, with the flip@ckpt
@@ -1231,7 +1445,7 @@ def check_sharded(
         # anchored-only, like every flip injection: an unanchored chain
         # cannot detect what it corrupts (engine.bfs._save_checkpoint)
         if chain is not None and chain.anchored and fault.flip(
-            "ckpt", depth, ckpt_depth=last_ckpt_depth
+            "ckpt", depth, ckpt_depth=ckpt_durable_depth
         ):
             levels_arr = levels_arr.copy()
             _integ.flip_bit(levels_arr)
@@ -1246,11 +1460,11 @@ def check_sharded(
             else {}
         )
 
-    def _readback_chain(path: str) -> None:
+    def _readback_chain(path: str, at_depth: int) -> None:
         if chain is not None and chain.anchored:
-            _integ.readback_chain(path, depth=depth)
+            _integ.readback_chain(path, depth=at_depth)
 
-    def _save_checkpoint():
+    def _save_checkpoint(sync: bool = False):
         if host_sets is not None and use_disk:
             # record run manifests + hot dumps — the runs ARE the durable
             # state; the checkpoint references them
@@ -1270,13 +1484,24 @@ def check_sharded(
                 "mesh_D": D,
                 "mesh_P": jax.process_count(),
             }
+            marks = _gc_marks()
             if is_multiprocess():
-                ckpt_store.save(depth, payload, part=f"host{my_proc}")
+                # non-coordinators: the part save is their only write —
+                # the deletion barrier advances when IT promotes
+                _store_save(
+                    payload,
+                    part=f"host{my_proc}",
+                    on_done=(
+                        None
+                        if is_coordinator()
+                        else lambda _p, m=marks: _advance_spill_gc(m)
+                    ),
+                    sync=sync,
+                )
                 extra = {}
             else:
                 extra = payload
             if not is_coordinator():
-                _advance_spill_gc()
                 return
             main = dict(
                 pending=np.concatenate(pending)
@@ -1293,9 +1518,12 @@ def check_sharded(
             # stamp) inline; multi-process mains stamp their own
             main["mesh_D"] = D
             main["mesh_P"] = jax.process_count()
-            path = ckpt_store.save(depth, main)
-            _advance_spill_gc()
-            _readback_chain(path)
+
+            def _main_done(path, m=marks, d=depth):
+                _advance_spill_gc(m)
+                _readback_chain(path, d)
+
+            _store_save(main, on_done=_main_done, sync=sync)
             return
         if host_sets is not None:
             dumps = [
@@ -1314,8 +1542,7 @@ def check_sharded(
                 # re-expanded frontier's subtrees — the depth cross-check
                 # on load skips that generation (falling back to an older
                 # consistent one) instead.
-                ckpt_store.save(
-                    depth,
+                _store_save(
                     dict(
                         host_fps=np.concatenate(dumps),
                         host_lens=np.asarray([len(x) for x in dumps]),
@@ -1323,6 +1550,7 @@ def check_sharded(
                         mesh_P=jax.process_count(),
                     ),
                     part=f"host{my_proc}",
+                    sync=sync,
                 )
                 extra = {}
             else:
@@ -1391,8 +1619,7 @@ def check_sharded(
                 chain.verify_visited(dump_fps, depth=depth)
         if not is_coordinator():
             return  # one writer per job; all processes hold identical state
-        path = ckpt_store.save(
-            depth,
+        _store_save(
             dict(
                 pending=np.concatenate(pending)
                 if any(p.shape[0] for p in pending)
@@ -1406,8 +1633,9 @@ def check_sharded(
                 **extra,
                 **_chain_stamp(),
             ),
+            on_done=lambda p, d=depth: _readback_chain(p, d),
+            sync=sync,
         )
-        _readback_chain(path)
 
     # Resource governance (resilience.resources): disk budget over the
     # spill + checkpoint dirs, RSS/deadline watchdogs, injected stall —
@@ -1421,10 +1649,14 @@ def check_sharded(
 
     def _final_save():
         # checkpoint-then-clean-exit: persist the just-completed level
-        # even off the checkpoint_every cadence
+        # even off the checkpoint_every cadence.  Synchronous + drained:
+        # the typed exit's contract is a DURABLE on-disk state
         nonlocal last_ckpt_depth
-        if ckpt_store is not None and last_ckpt_depth != depth:
-            _save_checkpoint()
+        if ckpt_store is None:
+            return
+        _ckpt_poll(block=True)
+        if last_ckpt_depth != depth or ckpt_durable_depth != depth:
+            _save_checkpoint(sync=True)
             last_ckpt_depth = depth
 
     def _reclaim():
@@ -1439,15 +1671,21 @@ def check_sharded(
 
             for s in host_sets:
                 if s is not None:
+                    # quiesce the merge worker BEFORE the tmp sweep: a
+                    # background merge's half-written tmp is live work,
+                    # not a stray (PR 10 small fix; regression-tested)
+                    s.quiesce()
                     sweep_tmp(s.dir)
                     if len(s.runs) > 1:
                         s.merge()
                         merged = True
         if ckpt_store is not None:
+            _ckpt_poll(block=True)
             # save only when something changed since the periodic save at
             # this depth (same guard as engine.bfs._reclaim)
-            if merged or last_ckpt_depth != depth:
-                _save_checkpoint()
+            if merged or last_ckpt_depth != depth or \
+                    ckpt_durable_depth != depth:
+                _save_checkpoint(sync=True)
                 last_ckpt_depth = depth
             if is_coordinator():
                 ckpt_store.prune(keep_gens=1)
@@ -1512,13 +1750,28 @@ def check_sharded(
 
     try:
         while any(p.shape[0] for p in pending):
+            # async join point (every process joins identically — the
+            # workers' job streams are replicated-deterministic): adopt
+            # finished merges/checkpoint promotes, surface worker errors.
+            # BLOCKING under an armed fault plan so deterministic
+            # injection never depends on writer-thread timing
+            _ckpt_poll(block=bool(fault.specs))
+            if use_disk:
+                for s in host_sets:
+                    if s is not None:
+                        if fault.specs:
+                            s.quiesce()
+                        s.poll_merge()
+            lvl_io0 = _io_counters()
             # level-boundary fault injection point (resilience.faults); the
             # plan derives from the replicated env, so every process raises
-            # (or not) in lockstep
-            fault.crash("level", depth, ckpt_depth=last_ckpt_depth)
+            # (or not) in lockstep; crash deferral keys on the DURABLE
+            # checkpoint depth (an in-flight async save must not arm a
+            # crash whose restart would not converge)
+            fault.crash("level", depth, ckpt_depth=ckpt_durable_depth)
             if chain is not None:
                 sp = fault.flip(
-                    "frontier", depth, ckpt_depth=last_ckpt_depth
+                    "frontier", depth, ckpt_depth=ckpt_durable_depth
                 )
                 if sp:
                     # a shard scope targets THAT shard's pending buffer
@@ -1566,14 +1819,18 @@ def check_sharded(
             # the novelty masks — received candidates per OWNER shard
             lvl_en_per_shard = np.zeros(D, np.int64)
             lvl_recv_per_shard = np.zeros(D, np.int64)
+            lvl_exch_bytes = lvl_exch_raw_bytes = 0
             offs = [0] * D
             # base offset of each shard's rows in this level's shard-major order
             prev_base = np.concatenate([[0], np.cumsum([p.shape[0] for p in pending])])
             verdict = None  # (inv_name, frontier_row_np, global_idx)
-            while verdict is None:
+
+            def _build_chunk():
+                """Assemble the next chunk's per-shard frontier slice, or
+                None when the level is exhausted."""
                 rem = max(p.shape[0] - o for p, o in zip(pending, offs))
                 if rem <= 0:
-                    break
+                    return None
                 governor.poll(depth)  # deadline watchdog (cheap)
                 bucket = min(_next_pow2(max(rem, min_bucket // D, 32)), chunk)
                 frontier = np.zeros((D, bucket, K), np.uint32)
@@ -1585,21 +1842,28 @@ def check_sharded(
                     took[d] = rows.shape[0]
                     offs[d] += rows.shape[0]
                 fvalid = np.arange(bucket)[None, :] < took[:, None]
+                return [bucket, frontier, took, chunk_off, fvalid,
+                        time.perf_counter()]
 
-                # overflow-retry loop: a uniform-shift expansion overflow
-                # escalates to per-action adaptive widths seeded from the
-                # overflowing attempt's guard counts (or, with adaptation off,
-                # steps the shift toward the full path); a per-action overflow
-                # doubles the offending buffers (floored for the rest of the
-                # run); destination-bucket overflow doubles the per-dest width.
-                # A failed attempt's visited arrays are simply discarded (the
-                # step is functional), so results stay exact at every width.
-                # Width retries are CHUNK-LOCAL (learned floors persist): one
-                # dense or skew-routed chunk must not pin the whole remaining
-                # run to a wider shape (the compiled steps stay cached).
-                attempt, w_try = adapt.widths_for(bucket), w_extra
-                chunk_retry.reset_chunk()
-                t_chunk = time.perf_counter()
+            def _attempt_once(ctx, attempt, w_try, compress=None):
+                """Dispatch ONE attempt of a chunk (no flag fetches) with
+                the shared failure policy applied around the dispatch.
+                -> (outs, (attempt, w_try, ca, T, W, R)).  The overflow-
+                retry ladder lives in _flags_retry/_resolve_chunk: a
+                uniform-shift expansion overflow escalates to per-action
+                adaptive widths seeded from the overflowing attempt's
+                guard counts (or, with adaptation off, steps the shift
+                toward the full path); a per-action overflow doubles the
+                offending buffers (floored for the rest of the run);
+                destination-bucket (or compressed-payload) overflow
+                doubles the per-dest width.  A failed attempt's visited
+                arrays are simply discarded (the step is functional), so
+                results stay exact at every width.  Width retries are
+                CHUNK-LOCAL (learned floors persist)."""
+                nonlocal vcap, dev_vhi, dev_vlo, chunk, adaptive_fallback
+                if compress is None:
+                    compress = compress_on
+                bucket = ctx[0]
                 while True:
                     if isinstance(attempt, int):
                         ca = _norm_shift(bucket, attempt) or None
@@ -1646,7 +1910,7 @@ def check_sharded(
                                     jnp.concatenate([dev_vlo, pad], axis=1), shard1
                                 )
 
-                    key = (bucket, vcap, ca, exchange, W)
+                    key = (bucket, vcap, ca, exchange, W, compress)
                     try:
                         # exchange-step fault injection point (the jitted step
                         # below carries the all_to_all/all_gather exchange)
@@ -1666,31 +1930,13 @@ def check_sharded(
                                 dest_w=W,
                                 with_merge=visited_backend == "device",
                                 hash_table=visited_backend == "device-hash",
+                                compress=compress,
                             )
-                        (
-                            out,
-                            out_parent,
-                            out_act,
-                            new_n,
-                            vhi_n,
-                            vlo_n,
-                            vn_n,
-                            viol_any,
-                            viol_idx,
-                            dl_any,
-                            dl_idx,
-                            act_en,
-                            ovf_expand,
-                            act_guard,
-                            ovf_dest,
-                            ovf_probe,
-                            out_hi,
-                            out_lo,
-                            sent_dig,
-                            recv_dig,
-                        ) = steps[key](
-                            put_global(frontier.reshape(D * bucket, K), shard1),
-                            put_global(fvalid.reshape(D * bucket), shard1),
+                        outs = steps[key](
+                            put_global(
+                                ctx[1].reshape(D * bucket, K), shard1
+                            ),
+                            put_global(ctx[4].reshape(D * bucket), shard1),
                             dev_vhi,
                             dev_vlo,
                             dev_vn,
@@ -1726,49 +1972,106 @@ def check_sharded(
                         attempt = adapt.compile_fallback(bucket)
                         adaptive_fallback = True
                         continue
-                    if ca is not None:
-                        ovf_np = fetch_global(ovf_expand)  # [D, n_actions]
-                        if ovf_np.any():
-                            # shared escalation policy (engine.bfs
-                            # .AdaptiveCompact): uniform overflow escalates to
-                            # per-action widths from THIS attempt's complete
-                            # guard counts; per-action overflow doubles the
-                            # offenders, floored for the rest of the run
-                            attempt = adapt.escalate(
-                                attempt,  # == ca: _norm_shift only zeroes
+                    return outs, (attempt, w_try, ca, T, W, R, compress)
+
+            def _flags_retry(ctx, outs, meta):
+                """Fetch the attempt's overflow flags; -> None when it
+                committed clean, else the (attempt, w_try) to re-run
+                with (applying the escalation/widening/table-growth
+                policy — see _attempt_once's docstring)."""
+                nonlocal vcap, dev_vhi, dev_vlo
+                attempt, w_try, ca, T, W, R, compress = meta
+                ovf_expand, act_guard = outs[12], outs[13]
+                ovf_dest, ovf_probe = outs[14], outs[15]
+                if ca is not None:
+                    ovf_np = fetch_global(ovf_expand)  # [D, n_actions]
+                    if ovf_np.any():
+                        return (
+                            adapt.escalate(
+                                attempt,
                                 ovf_np.any(axis=0),
-                                bucket,
-                                _shard_density(fetch_global(act_guard), took),
-                            )
-                            continue
-                    if exchange == "all_to_all" and W < T and fetch_global(ovf_dest).any():
-                        w_try += 1
-                        continue
-                    if visited_backend == "device-hash" and bool(
-                        fetch_global(ovf_probe).any()
-                    ):
-                        # a shard exhausted its probe budget: grow every
-                        # shard's table and re-run the chunk (the attempt's
-                        # returned tables are discarded — the step is
-                        # functional, so nothing was committed)
-                        dev_vhi, dev_vlo, vcap = _grow_hash_tables(
-                            dev_vhi, dev_vlo, 2 * vcap, shard1
+                                ctx[0],
+                                _shard_density(
+                                    fetch_global(act_guard), ctx[2]
+                                ),
+                            ),
+                            w_try,
+                            compress,
                         )
-                        continue
-                    dev_vhi, dev_vlo, dev_vn = vhi_n, vlo_n, vn_n
-                    break
+                if exchange == "all_to_all" and fetch_global(
+                    ovf_dest
+                ).any():
+                    if W < T:
+                        return (attempt, w_try + 1, compress)
+                    if compress:
+                        # the raw path CANNOT overflow at full width (every
+                        # candidate fits W == T slots) — only the codec's
+                        # packed-stream / compact-row budgets can.  The
+                        # ladder is topped out, so this chunk falls back
+                        # to the RAW exchange (results identical; only
+                        # the wire layout changes)
+                        return (attempt, w_try, False)
+                if visited_backend == "device-hash" and bool(
+                    fetch_global(ovf_probe).any()
+                ):
+                    # a shard exhausted its probe budget: grow every
+                    # shard's table and re-run the chunk (the attempt's
+                    # returned tables are discarded — the step is
+                    # functional, so nothing was committed)
+                    dev_vhi, dev_vlo, vcap = _grow_hash_tables(
+                        dev_vhi, dev_vlo, 2 * vcap, shard1
+                    )
+                    return (attempt, w_try, compress)
+                return None
+
+            def _resolve_chunk(st):
+                """Flag-check a dispatched chunk, re-running the ladder
+                synchronously on any overflow, then install the committed
+                attempt's visited arrays."""
+                nonlocal dev_vhi, dev_vlo, dev_vn
+                ctx, outs, meta = st
+                while True:
+                    nxt = _flags_retry(ctx, outs, meta)
+                    if nxt is None:
+                        break
+                    outs, meta = _attempt_once(
+                        ctx, nxt[0], nxt[1], compress=nxt[2]
+                    )
+                st[1], st[2] = outs, meta
+                dev_vhi, dev_vlo, dev_vn = outs[4], outs[5], outs[6]
+
+            def _commit_sharded(st):
+                """Commit one resolved chunk: exchange framing check,
+                verdict checks, output fetches and per-shard host-set
+                inserts/trace/digest accumulation.  Commits run strictly
+                in dispatch order; returns True when a verdict fired."""
+                nonlocal verdict, lvl_act_en, lvl_new_per_shard
+                nonlocal lvl_en_per_shard, lvl_recv_per_shard
+                nonlocal shard_visited, lvl_exch_bytes, lvl_exch_raw_bytes
+                ctx, outs, meta = st
+                bucket, frontier, took, chunk_off, _fv, t_chunk = ctx
+                _attempt, _wt, _ca, T, W, R, compress = meta
+                (
+                    out, out_parent, out_act, new_n, _vh, _vl, _vn,
+                    viol_any, viol_idx, dl_any, dl_idx, act_en,
+                    _ovfe, act_guard, _ovfd, _ovfp,
+                    out_hi, out_lo, sent_dig, recv_dig,
+                ) = outs
                 # exchange framing check (resilience.integrity): across
                 # the whole mesh, the received candidate multiset must
                 # combine to exactly the sent one — XOR/sum digests are
                 # commutative, so per-shard records compare globally.
                 # flip@exchange drives the detector's observation (like
                 # stall@level does the watchdog's): a real ICI bit flip
-                # desyncs the same two in-jit digests
+                # desyncs the same two in-jit digests.  With the
+                # compressed exchange the received digest is computed
+                # over the DECODED payload, so the codec + headers are
+                # inside the protection boundary.
                 if chain is not None:
                     sd = np.asarray(fetch_global(sent_dig), np.uint32)
                     rd = np.array(fetch_global(recv_dig), np.uint32)
                     sp = fault.flip(
-                        "exchange", depth + 1, ckpt_depth=last_ckpt_depth
+                        "exchange", depth + 1, ckpt_depth=ckpt_durable_depth
                     )
                     if sp:
                         rd[sp.shard if sp.shard is not None else 0, 1] ^= 0x10
@@ -1796,12 +2099,31 @@ def check_sharded(
                 # adapt buffer sizing from the committed attempt's guard counts
                 # (mirrors engine.check; no-op until escalation activates)
                 adapt.observe(_shard_density(fetch_global(act_guard), took))
+                # exchange wire accounting (ROADMAP item 5's measure):
+                # bytes this chunk's all_to_all actually moved vs the raw
+                # (uncompressed) layout's bytes at the same widths
+                if exchange == "all_to_all":
+                    raw_b = D * D * W * (8 + 4 * K + 4 + 4)
+                    if compress:
+                        from ..ops import fpcompress as _fpc
+
+                        Wr = max(32, W // 2)
+                        sent_b = D * D * (
+                            4 * _fpc.default_stream_words(W)
+                            + 4 * _fpc.header_words(W)
+                            + Wr * (4 * K + 4 + 1)
+                        )
+                    else:
+                        sent_b = raw_b
+                    lvl_exch_bytes += sent_b
+                    lvl_exch_raw_bytes += raw_b
                 obs_.chunk_span(
                     "exchange",
                     time.perf_counter() - t_chunk,
                     depth=depth,
                     bucket=bucket,
                     exchange=exchange,
+                    compressed=compress,
                 )
                 # frontier-level verdicts (states being expanded = level `depth`)
                 viol_any_np = fetch_global(viol_any)  # [D, n_inv]
@@ -1811,13 +2133,13 @@ def check_sharded(
                     idx = int(fetch_global(viol_idx)[d, inv_i])
                     gidx = int(prev_base[d] + chunk_off[d] + idx)
                     verdict = (model.invariants[inv_i].name, frontier[d, idx], gidx)
-                    break
+                    return True
                 if check_deadlock and fetch_global(dl_any).any():
                     d = int(np.argmax(fetch_global(dl_any)))
                     idx = int(fetch_global(dl_idx)[d])
                     gidx = int(prev_base[d] + chunk_off[d] + idx)
                     verdict = ("Deadlock", frontier[d, idx], gidx)
-                    break
+                    return True
                 counts = fetch_global(new_n)
                 # received candidates per OWNER shard (post-exchange, pre-host-
                 # dedup on the host backend; == novel on device backends)
@@ -1888,6 +2210,50 @@ def check_sharded(
                     act_en_np = fetch_global(act_en).astype(np.int64)
                     lvl_act_en += act_en_np.sum(axis=0)
                     lvl_en_per_shard += act_en_np.sum(axis=1)
+                return False
+
+            # Staged commit (KSPEC_OVERLAP, host backend only — the at-
+            # scale configuration; device backends chain each chunk's
+            # visited arrays through the step, so their chunks serialize
+            # by data flow): chunk k+1's program is dispatched — flags
+            # UNREAD, so nothing blocks on it — before chunk k's flag
+            # fetches and host commit run.  While the host inserts chunk
+            # k's fingerprints, chunk k+1's expand + all_to_all drain;
+            # on a per-shard imbalance the exchange wall hides behind
+            # the host wall and vice versa.  An overflow discovered at
+            # resolve time re-runs only that chunk (host-backend chunks
+            # are independent until commit — the FpSets are only touched
+            # here, in dispatch order), so results stay exact and
+            # bit-identical to the serial path.
+            stage_chunks = overlap_on and visited_backend == "host"
+            staged_sh = None
+            while verdict is None:
+                ctx = _build_chunk()
+                if ctx is None:
+                    break
+                outs, meta = _attempt_once(
+                    ctx, adapt.widths_for(ctx[0]), w_extra
+                )
+                cur = [ctx, outs, meta]
+                if stage_chunks:
+                    overlap_staged_peak = max(
+                        overlap_staged_peak,
+                        2 if staged_sh is not None else 1,
+                    )
+                    if staged_sh is not None:
+                        _resolve_chunk(staged_sh)
+                        if _commit_sharded(staged_sh):
+                            staged_sh = None
+                            break
+                    staged_sh = cur
+                else:
+                    _resolve_chunk(cur)
+                    if _commit_sharded(cur):
+                        break
+            if staged_sh is not None and verdict is None:
+                _resolve_chunk(staged_sh)
+                _commit_sharded(staged_sh)
+            staged_sh = None
 
             if verdict is not None:
                 inv_name, row, gidx = verdict
@@ -1900,6 +2266,8 @@ def check_sharded(
                 break
 
             n_new = int(lvl_new_per_shard.sum())
+            exch_bytes_total += lvl_exch_bytes
+            exch_raw_bytes_total += lvl_exch_raw_bytes
             depth += 1
             if n_new:
                 levels.append(n_new)
@@ -1940,7 +2308,27 @@ def check_sharded(
                         a.name: int(c) for a, c in zip(model.actions, lvl_act_en.tolist())
                     },
                 )
-                result_levels.append(rec)
+                # exchange wire accounting + overlap attribution ride the
+                # IN-MEMORY records only (the emitted stats stream is a
+                # pinned historical contract, like the launch counters)
+                busy1, blk1 = _io_counters()
+                result_levels.append({
+                    **rec,
+                    "exch_bytes": int(lvl_exch_bytes),
+                    "exch_raw_bytes": int(lvl_exch_raw_bytes),
+                    "io_hidden_ms": round(
+                        max(0.0, (busy1 - lvl_io0[0])
+                            - (blk1 - lvl_io0[1])) * 1e3, 2),
+                    "io_exposed_ms": round((blk1 - lvl_io0[1]) * 1e3, 2),
+                })
+                if lvl_exch_raw_bytes:
+                    _met.set_gauge(
+                        "kspec_exchange_bytes_level", int(lvl_exch_bytes)
+                    )
+                    _met.set_gauge(
+                        "kspec_exchange_compression_ratio",
+                        round(lvl_exch_raw_bytes / max(lvl_exch_bytes, 1), 3),
+                    )
             if progress:
                 progress(depth, n_new, total)
             _shard_beat(depth, new=n_new, total=total)
@@ -2005,6 +2393,14 @@ def check_sharded(
                 reclaim=None if multi else _reclaim,
                 save_hook=None if multi else _final_save,
             )
+        # drain the async tail INSIDE the typed-error scope: a pending
+        # checkpoint's ENOSPC or a background merge's injected fault must
+        # map to the same typed exits as their synchronous twins
+        _ckpt_poll(block=True)
+        if use_disk:
+            for s in host_sets:
+                if s is not None:
+                    s.quiesce()
     except ResourceExhausted as e:
         exhausted = e
     except IntegrityError as e:
@@ -2046,6 +2442,7 @@ def check_sharded(
             obs_.close()
         except OSError:
             pass
+        _shutdown_async(drain=False)
         raise integrity_fail
     if exhausted is not None:
         # typed terminal: stamp the run manifest, mark the shard
@@ -2071,6 +2468,7 @@ def check_sharded(
             obs_.close()
         except OSError:
             pass
+        _shutdown_async(drain=False)
         raise exhausted
 
     if violation is None and cut and model.invariants:
@@ -2094,6 +2492,7 @@ def check_sharded(
                     break
 
     dt = time.perf_counter() - t0
+    _shutdown_async(drain=True)
     _shard_beat(depth, event="finish", ok=violation is None)
     spill_stats = (
         {
@@ -2126,6 +2525,23 @@ def check_sharded(
             "adaptive_compile_fallback": adaptive_fallback,
             "transient_retries": chunk_retry.retries_total,
             "degradations": chunk_retry.degradations,
+            "overlap": {
+                "enabled": overlap_on,
+                "staged_chunks_peak": overlap_staged_peak,
+                **(
+                    {"io_worker": io_worker.stats()}
+                    if io_worker is not None
+                    else {}
+                ),
+                **(
+                    {"ckpt_worker": ckpt_worker.stats()}
+                    if ckpt_worker is not None
+                    else {}
+                ),
+            },
+            "exchange_compressed": compress_on,
+            "exchange_bytes_total": int(exch_bytes_total),
+            "exchange_raw_bytes_total": int(exch_raw_bytes_total),
             **(
                 {
                     "host_fpset_sizes": [

@@ -143,6 +143,11 @@ class CheckpointStore:
         self.keep = max(1, int(keep))
         self.fault_plan = fault_plan
         self.validators = tuple(validators)
+        # async-write state (attach_writer): at most one save in flight,
+        # completed (depth, path) pairs held until the engine polls them
+        self._writer = None
+        self._async_job = None
+        self._async_done: list = []
         os.makedirs(directory, exist_ok=True)
         # startup janitor: a save killed mid-tmp-write leaves
         # `<name>.tmp.npz` behind (no manifest ever references it) —
@@ -220,6 +225,81 @@ class CheckpointStore:
 
             corrupt_file(path)
         return path
+
+    # --- async writes (KSPEC_OVERLAP; docs/resilience.md) ---------------
+    def attach_writer(self, worker) -> None:
+        """Enable :meth:`save_async` on an :class:`~..overlap.AsyncWorker`.
+
+        The split of responsibilities is the async-checkpoint contract:
+        the ENGINE snapshots the (small) level metadata, the digest
+        chain, and the visited/frontier dumps synchronously — every
+        array handed to save_async is immutable from then on — and the
+        WRITER thread runs the pre-write chain verification, the
+        checksummed tmp write, rotation and the atomic promote.  Errors
+        (a real or injected ENOSPC, an injected crash) are stored on the
+        job and re-raised on the engine thread at its next
+        poll_async()/drain_async(), so the typed exit-75 path and the
+        crash-restart contract fire exactly as in serial mode."""
+        self._writer = worker
+
+    def save_async(self, depth: int, arrays: dict,
+                   part: Optional[str] = None,
+                   pre_write=None, after_promote=None) -> None:
+        """Queue one checksummed save on the attached writer thread.
+
+        Serialized: a still-pending previous save is drained first (its
+        error, if any, propagates here).  `pre_write` runs on the writer
+        BEFORE the tmp write (the engines pass the digest-chain
+        visited self-check — verification moves off the critical path
+        but stays ahead of the promote, so detected corruption still
+        never enters a checkpoint); `after_promote(path)` runs on the
+        writer after the atomic promote (the chain read-back)."""
+        assert self._writer is not None, "attach_writer first"
+        # join the previous save WITHOUT consuming its completion record:
+        # the engine's poll_async/drain_async is what processes the
+        # (depth, path) pairs (barrier advance, durable-depth tracking)
+        self._reap(block=True)
+
+        def job():
+            if pre_write is not None:
+                pre_write()
+            path = self.save(depth, arrays, part=part)
+            if after_promote is not None:
+                after_promote(path)
+            return path
+
+        self._async_job = (depth, self._writer.submit(
+            "checkpoint-write-async", job
+        ))
+
+    def _reap(self, block: bool) -> None:
+        if self._async_job is None:
+            return
+        depth, job = self._async_job
+        if not block and not job.done.is_set():
+            return
+        try:
+            # wait() re-raises THIS job's error (and consumes it from the
+            # worker's failed queue) — never some other client's failure
+            path = self._writer.wait(job)
+        except BaseException:
+            self._async_job = None
+            raise
+        self._async_job = None
+        self._async_done.append((depth, path))
+
+    def poll_async(self) -> list:
+        """Non-blocking: -> newly completed [(depth, path)], raising any
+        failed save's error on this (the engine's) thread."""
+        self._reap(block=False)
+        done, self._async_done = self._async_done, []
+        return done
+
+    def drain_async(self) -> list:
+        """Block for the pending save (if any); -> completed pairs."""
+        self._reap(block=True)
+        done, self._async_done = self._async_done, []
+        return done
 
     def prune(self, keep_gens: int = 1) -> list:
         """Resource reclamation: unlink every rotated generation (mains

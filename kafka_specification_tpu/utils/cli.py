@@ -380,6 +380,23 @@ def main(argv=None):
         "ignored by --sharded (the sharded engine keeps the per-action "
         "path)",
     )
+    pc.add_argument(
+        "--overlap",
+        choices=["on", "off"],
+        default=None,
+        help="async level-pipelined execution (engine + sharded; "
+        "$KSPEC_OVERLAP is the env twin; default on): two-slot staged "
+        "chunk pipeline (host assembly drains behind the in-flight "
+        "update-skeleton launch), background spill-run merges, "
+        "checkpoint writes on a writer thread, and — sharded — the "
+        "staged exchange commit + bit-packed/delta-encoded fingerprint "
+        "payload compression (codec defaults on for real accelerator "
+        "fabrics; KSPEC_EXCHANGE_COMPRESS=1/0 forces).  'off' restores "
+        "the exact serial "
+        "behavior (the bit-identity oracle): counts, traces and digest "
+        "chains are identical either way (docs/engine.md § Async "
+        "execution)",
+    )
     pc.add_argument("--cpu", action="store_true", help="force the CPU platform")
     pc.add_argument(
         "--emitted",
@@ -1401,6 +1418,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw, run=None):
         store=args.store,
         disk_budget=args.disk_budget,
         run=run,
+        overlap=getattr(args, "overlap", None),
     )
     if args.sharded:
         from ..parallel.sharded import check_sharded

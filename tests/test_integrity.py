@@ -441,18 +441,25 @@ def test_shadow_host_oracle_catches_corrupted_fingerprints(monkeypatch):
     the host (the wire the host oracle guards) -> typed shadow violation."""
     from kafka_specification_tpu.engine import pipeline as pl
 
-    orig = pl.FusedPipeline.run_chunk
+    orig = pl.FusedPipeline.run_chunk_staged
 
     def corrupting(self, piece, fp_n, bucket, depth, vhi, vlo, vn, vcap):
-        outs = orig(self, piece, fp_n, bucket, depth, vhi, vlo, vn, vcap)
-        out_hi = np.array(outs[12])
-        nn = int(outs[3])
-        if nn:
-            out_hi[0] ^= np.uint32(1 << 9)
-            return outs[:12] + (out_hi,) + outs[13:]
-        return outs
+        vh, vl, n, fin = orig(
+            self, piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
+        )
 
-    monkeypatch.setattr(pl.FusedPipeline, "run_chunk", corrupting)
+        def corrupt_fin():
+            outs = fin()
+            out_hi = np.array(outs[12])
+            nn = int(outs[3])
+            if nn:
+                out_hi[0] ^= np.uint32(1 << 9)
+                return outs[:12] + (out_hi,) + outs[13:]
+            return outs
+
+        return vh, vl, n, corrupt_fin
+
+    monkeypatch.setattr(pl.FusedPipeline, "run_chunk_staged", corrupting)
     with pytest.raises(IntegrityError) as ei:
         check(frl.make_model(2, 2, 2), min_bucket=32, integrity_shadow=1.0)
     assert ei.value.site in ("shadow", "chain", "frontier")

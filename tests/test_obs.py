@@ -196,11 +196,13 @@ def test_stats_shim_record_for_record_identical(tmp_path):
     assert all("run_id" not in r for r in recs_bare)
     assert all(r["run_id"] == run.run_id for r in recs_run)
     # result.stats['levels'] additionally carries the engine-local
-    # successor-launch accounting (engine/pipeline.py) — in-memory only,
-    # never in the pinned stream
+    # successor-launch accounting (engine/pipeline.py) and the PR 10
+    # overlap attribution — in-memory only, never in the pinned stream
     assert [
         {k: v for k, v in r.items()
-         if k not in ("successor_launches", "launches_per_chunk_max")}
+         if k not in ("successor_launches", "launches_per_chunk_max",
+                      "io_hidden_ms", "io_exposed_ms",
+                      "overlap_efficiency")}
         for r in r1.stats["levels"]
     ] == recs_bare
 
@@ -246,7 +248,14 @@ def test_sharded_per_shard_breakdowns_and_imbalance(tmp_path):
         assert sum(rec["shard_new"]) == rec["new"]
         assert sum(rec["shard_frontier"]) == rec["frontier"]
         assert sum(rec["shard_enabled"]) == rec["enabled_candidates"]
-    assert res.stats["levels"] == recs
+    # result.stats['levels'] additionally carries the PR 10 exchange/
+    # overlap accounting — in-memory only, never in the pinned stream
+    assert [
+        {k: v for k, v in r.items()
+         if k not in ("exch_bytes", "exch_raw_bytes", "io_hidden_ms",
+                      "io_exposed_ms")}
+        for r in res.stats["levels"]
+    ] == recs
     prom = open(run.metrics_prom).read()
     assert "kspec_shard_imbalance" in prom
     assert f'kspec_shard_new{{shard="0",run_id="{run.run_id}"}}' in prom
