@@ -1,0 +1,217 @@
+"""`kspec analyze` — static analysis of the specs and the engine.
+
+Three passes close the verdict-trust gap from the build side (PR 9's
+digest chains close it from the runtime side):
+
+1. **Encoding soundness** (analysis/encoding.py): interval abstract
+   interpretation of every action kernel over the declared tensor
+   schema proves each written field stays within its packed range —
+   the general form of the hand-written AsyncIsr "N <= 4" cliff check,
+   applied to every model and config at build time.  An unsound
+   (config, schema) pair refuses to explore with a machine-readable
+   interval counterexample instead of returning a wrong verdict.
+2. **Action/guard lint** (same module): vacuous guards, frame-condition
+   violations against declared write sets, read-of-unwritten /
+   dead-field detection.
+3. **Concurrency ownership** (analysis/ownership.py): the engine's
+   thread contract (docs/engine.md § Async execution) declared as
+   machine-readable ``THREAD_CONTRACT`` annotations on overlap.py,
+   storage/tiered.py and resilience/checkpoints.py, verified by an AST
+   pass; ``KSPEC_TSAN=1`` arms a runtime sanitizer that asserts the
+   same ownership on every attribute write (test-only).
+
+Front doors: ``cli analyze [--json]`` (jax-free; exits non-zero on any
+HIGH finding; emits the schema-versioned ``kspec-analysis/1`` record)
+and the build gates in ``utils/cfg.build_model`` / ``engine.bfs.check``
+/ ``parallel.sharded.check_sharded`` (KSPEC_ANALYZE=0 disables).
+
+This package must stay importable without jax: heavy passes live in
+submodules imported lazily, and :func:`install_jax_stub` lets the model
+modules (which bind ``jnp`` at import) load on a box with no working
+accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+#: the machine-readable findings record version (mirrors kspec-verdict/1)
+ANALYSIS_SCHEMA = "kspec-analysis/1"
+
+SEVERITIES = ("HIGH", "MEDIUM", "LOW", "INFO")
+
+ANALYZE_ENV = "KSPEC_ANALYZE"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analysis finding, machine-readable.
+
+    kind: spec-width | encoding-overflow | frame-violation |
+          vacuous-action |
+          read-of-unwritten-field | dead-field | analysis-skip |
+          ownership-breach | unlocked-shared-write |
+          unannotated-attribute | stale-annotation | worker-unsafe-write |
+          host-materialization | set-iteration-order
+    """
+
+    kind: str
+    severity: str
+    target: str
+    message: str
+    data: dict = dc_field(default_factory=dict)
+    suppressed: Optional[str] = None  # justification when downgraded
+
+    def record(self) -> dict:
+        out = {"kind": self.kind, "severity": self.severity,
+               "target": self.target, "message": self.message,
+               "data": self.data}
+        if self.suppressed:
+            out["suppressed"] = self.suppressed
+        return out
+
+
+def analysis_record(findings, targets=()) -> dict:
+    """The stable ``kspec-analysis/1`` findings record (`cli analyze
+    --json`); one schema for CI, the tier-1 gate and operators."""
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return {
+        "schema": ANALYSIS_SCHEMA,
+        "targets": list(targets),
+        "findings": [f.record() for f in findings],
+        "counts": counts,
+        "ok": counts.get("HIGH", 0) == 0,
+    }
+
+
+def analysis_enabled() -> bool:
+    """The build-gate kill switch (documented escape hatch)."""
+    return os.environ.get(ANALYZE_ENV, "1").strip().lower() not in (
+        "0", "off", "false", "no"
+    )
+
+
+# --------------------------------------------------------------------------
+# jax-free model imports (`cli analyze` on a box with no accelerator stack)
+# --------------------------------------------------------------------------
+
+
+def install_jax_stub() -> bool:
+    """Make ``import jax.numpy as jnp`` succeed WITHOUT importing jax.
+
+    The model modules bind ``jnp`` at import time but only *use* it
+    inside kernels — which the abstract interpreter runs with ``jnp``
+    rebound to the interval namespace.  The stub raises on any attribute
+    access, so a code path that genuinely needs jax fails loudly instead
+    of silently degrading.  Installed only when jax is absent (or
+    poisoned with a None sys.modules sentinel); a process that already
+    imported the real jax keeps it.  Returns True when the stub was
+    installed."""
+    if sys.modules.get("jax") is not None and "jax" in sys.modules:
+        return False
+
+    class _StubModule(types.ModuleType):
+        def __getattr__(self, name):
+            if name.startswith("__"):
+                raise AttributeError(name)
+            raise RuntimeError(
+                f"jax.{name} accessed under the kspec-analyze jax stub — "
+                f"the static-analysis path is jax-free by contract "
+                f"(docs/analysis.md)"
+            )
+
+    jax = _StubModule("jax")
+    jnp = _StubModule("jax.numpy")
+    jax.numpy = jnp
+    sys.modules["jax"] = jax
+    sys.modules["jax.numpy"] = jnp
+    return True
+
+
+# --------------------------------------------------------------------------
+# the engine build gate
+# --------------------------------------------------------------------------
+
+#: process-wide memo of verified model shapes — re-building the same
+#: (module, config), which tests do hundreds of times, re-verifies
+#: nothing.  The key is the full structural identity, NOT just the name:
+#: emitted names drop constants, and a same-named model with different
+#: field bounds or action structure must not ride a sibling's pass.
+_VERIFIED_MODELS: set = set()
+
+
+def _model_memo_key(model):
+    try:
+        return (
+            model.name,
+            tuple((f.name, f.shape, f.lo, f.hi)
+                  for f in model.spec.fields),
+            # kernel CODE identity matters: two same-shaped models with
+            # different kernel bodies must not share a verification
+            # (code objects are shared across rebuilds of the same
+            # factory, so the memo still hits where it should)
+            tuple((a.name, a.n_choices, getattr(a, "writes", None),
+                   getattr(a.kernel, "__code__", None))
+                  for a in model.actions),
+        )
+    except Exception:  # duck-typed test doubles: no memo, just verify
+        return None
+
+
+def require_encoding_sound(model) -> None:
+    """Refuse to explore an encoding-unsound model (the check/check_sharded
+    and build_model gate).  Raises analysis.encoding.EncodingUnsound (a
+    ValueError) carrying the interval counterexample; KSPEC_ANALYZE=0
+    skips.  Memoized on the model's structural identity (name + field
+    bounds + action inventory), so a rebuilt same-config model costs
+    nothing."""
+    if not analysis_enabled():
+        return
+    key = _model_memo_key(model)
+    if key is not None and key in _VERIFIED_MODELS:
+        return
+    from .encoding import verify_model_encoding
+
+    verify_model_encoding(model)
+    if key is not None:
+        _VERIFIED_MODELS.add(key)
+
+
+# --------------------------------------------------------------------------
+# full-repo analysis (the `cli analyze` driver)
+# --------------------------------------------------------------------------
+
+#: the engine modules the ownership/purity passes cover (repo-relative)
+OWNERSHIP_MODULES = (
+    "kafka_specification_tpu/overlap.py",
+    "kafka_specification_tpu/storage/tiered.py",
+    "kafka_specification_tpu/resilience/checkpoints.py",
+)
+PURITY_MODULES = (
+    "kafka_specification_tpu/engine/pipeline.py",
+    "kafka_specification_tpu/parallel/sharded.py",
+)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def analyze_engine_sources(root: Optional[str] = None) -> list:
+    """Ownership-contract + purity/order lint over the engine sources."""
+    from .ownership import check_module_contract, lint_purity
+
+    root = root or repo_root()
+    findings = []
+    for rel in OWNERSHIP_MODULES:
+        findings += check_module_contract(os.path.join(root, rel), rel)
+    for rel in PURITY_MODULES:
+        findings += lint_purity(os.path.join(root, rel), rel)
+    return findings

@@ -1,0 +1,327 @@
+"""Encoding-soundness and action/guard lint over built models.
+
+Two layers, one Finding vocabulary (docs/analysis.md):
+
+**Spec-width pass** (``spec_fits_errors``): every declared field range
+must fit the packed representation the engine actually uses —
+``ops/packing.StateSpec`` flattens states through an **int32 element
+dtype** before biasing into <=32-bit lanes, so a field with
+``hi > 2^31 - 1`` (or ``lo < -2^31``) silently wraps long before the
+lane packer would complain.  This is the general form of the AsyncIsr
+"N <= 4" encoding cliff: at N = 5 the per-version request bitset
+declares ``hi = 2^32 - 1``.  Pure arithmetic over the Field table —
+runs in microseconds at every model construction
+(``models.base.Model.__post_init__``).
+
+**Action passes** (``analyze_model``): interval abstract interpretation
+of every (action, choice) pair through the *shipped* kernel code
+(analysis/interval.py), producing:
+
+- ``encoding-overflow`` (HIGH): a possibly-enabled successor writes a
+  field element whose interval escapes the declared [lo, hi] — the
+  packer would truncate it and the checker would explore (and digest,
+  and checkpoint) a state that never existed.  The finding carries the
+  machine-readable counterexample (action, choice, field, computed
+  interval, declared interval).
+- ``frame-violation`` (HIGH): the kernel wrote a field outside the
+  action's declared write set (``Action.writes``, an UPPER bound on the
+  fields whose tensor value may change), or declared a write for a name
+  that is not a spec field at all.
+- ``vacuous-action`` (MEDIUM): every choice of an action is statically
+  disabled under the CONSTANTS-derived bounds — dead spec code, or a
+  mistranscribed guard.
+- ``read-of-unwritten-field`` / ``dead-field`` (LOW): a field no action
+  ever writes is constant forever; if action guards/updates still read
+  it, the likely cause is a forgotten update transcription.
+- ``analysis-skip`` (INFO): the kernel used a construct outside the
+  abstract domain; the action is honestly skipped, never guessed at.
+
+Suppression: ``model.meta["analysis_suppress"]`` is an iterable of
+``{"kind": ..., "target": <substring>, "reason": ...}``; matching
+findings are downgraded to INFO with the justification attached.
+
+Everything here is jax-free.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import Finding
+from .interval import (
+    AnalysisUnsupported,
+    IVal,
+    analyze_action_choice,
+    definitely_disabled,
+)
+
+#: the packed element dtype's representable range (StateSpec._flatten
+#: casts through int32; ops/packing.py)
+INT32_MIN = -(1 << 31)
+INT32_MAX = (1 << 31) - 1
+#: lane width (uint32 lanes; elements never straddle one)
+LANE_BITS = 32
+
+
+class EncodingUnsound(ValueError):
+    """A (config, schema) pair the engine cannot soundly encode.
+
+    Subclasses ValueError so every pre-existing entry point that rejected
+    the AsyncIsr N=5 cliff with a ValueError keeps its error class; the
+    machine-readable findings ride on ``.findings``."""
+
+    def __init__(self, message: str, findings=()):
+        super().__init__(message)
+        self.findings = list(findings)
+
+
+def spec_fits_errors(fields, context: str = "") -> list:
+    """Spec-width findings for a Field table (empty list == sound)."""
+    out = []
+    prefix = f"{context}: " if context else ""
+    for f in fields:
+        span_bits = max(1, int(f.hi - f.lo).bit_length())
+        if f.lo < INT32_MIN or f.hi > INT32_MAX:
+            out.append(Finding(
+                kind="spec-width",
+                severity="HIGH",
+                target=f"field:{f.name}",
+                message=(
+                    f"{prefix}field {f.name!r} declares [{f.lo}, {f.hi}] "
+                    f"but the packed element dtype is int32 "
+                    f"[{INT32_MIN}, {INT32_MAX}]: values would silently "
+                    f"wrap before packing"
+                ),
+                data={"field": f.name, "declared": [f.lo, f.hi],
+                      "dtype_range": [INT32_MIN, INT32_MAX],
+                      "needed_bits": span_bits},
+            ))
+        # the 32-bit LANE bound needs no separate branch: a range inside
+        # the int32 element dtype spans <= 2^32 values = <= LANE_BITS
+        # bits by construction (and StateSpec's own `assert w <= 32`
+        # backstops any future dtype change)
+    return out
+
+
+def check_spec_fields(fields, context: str = "") -> None:
+    """Raise :class:`EncodingUnsound` when the field table cannot be
+    packed soundly — the one spec-level entry point (Model build, the
+    AsyncIsr delegating check, `cli analyze`)."""
+    errs = spec_fits_errors(fields, context)
+    if errs:
+        raise EncodingUnsound(
+            "; ".join(e.message for e in errs), findings=errs
+        )
+
+
+# --------------------------------------------------------------------------
+# interval pass over the actions
+# --------------------------------------------------------------------------
+
+
+def _overflow_elements(nv: IVal, field):
+    """Elements of a written field whose interval escapes the declared
+    range -> (worst_lo, worst_hi, n_bad) or None."""
+    bad = (nv.lo < field.lo) | (nv.hi > field.hi)
+    if not bool(np.any(bad)):
+        return None
+    return int(np.min(nv.lo)), int(np.max(nv.hi)), int(np.sum(bad))
+
+
+def analyze_actions(model) -> list:
+    """The three action passes (overflow / frame / vacuous + dead-field)
+    over one built model.  Returns raw findings (no suppression)."""
+    fields = model.spec.fields
+    by_name = {f.name: f for f in fields}
+    findings: list = []
+    written_any: set = set()
+    read_any: set = set()
+    # a skipped action's writes are UNKNOWN: its declared write set (if
+    # any) still counts as "written somewhere", and with no declaration
+    # the whole dead-field pass would be guessing — honesty rules
+    writes_unknown = False
+
+    for a in model.actions:
+        changed: set = set()
+        n_disabled = 0
+        n_skipped = 0
+        for c in range(a.n_choices):
+            try:
+                r = analyze_action_choice(a.kernel, fields, c)
+            except AnalysisUnsupported as e:
+                n_skipped += 1
+                if n_skipped == 1:  # one skip record per action
+                    findings.append(Finding(
+                        kind="analysis-skip",
+                        severity="INFO",
+                        target=f"action:{a.name}",
+                        message=(
+                            f"action {a.name!r} uses a construct outside "
+                            f"the interval domain ({e}) — not analyzed"
+                        ),
+                        data={"action": a.name, "reason": str(e)},
+                    ))
+                continue
+            enabled = r["enabled"]
+            read_any |= set(enabled.deps)
+            if definitely_disabled(enabled):
+                n_disabled += 1
+                continue  # statically disabled: nothing can commit
+            for f in fields:
+                nv = r["next"].get(f.name)
+                if nv is None or nv is r["base"][f.name]:
+                    continue
+                nv = IVal.coerce(nv)
+                changed.add(f.name)
+                read_any |= set(nv.deps)
+                ovf = _overflow_elements(nv, f)
+                if ovf is not None:
+                    lo, hi, n_bad = ovf
+                    findings.append(Finding(
+                        kind="encoding-overflow",
+                        severity="HIGH",
+                        target=f"action:{a.name}",
+                        message=(
+                            f"action {a.name!r} (choice {c}) writes "
+                            f"field {f.name!r} with interval [{lo}, {hi}]"
+                            f" outside its declared [{f.lo}, {f.hi}] — "
+                            f"the bit packer would silently truncate it"
+                        ),
+                        data={"action": a.name, "choice": c,
+                              "field": f.name, "interval": [lo, hi],
+                              "declared": [f.lo, f.hi],
+                              "bad_elements": n_bad},
+                    ))
+        written_any |= changed
+        if n_skipped:
+            if a.writes is not None:
+                written_any |= set(a.writes)
+            else:
+                writes_unknown = True
+        if a.n_choices and n_disabled == a.n_choices:
+            findings.append(Finding(
+                kind="vacuous-action",
+                severity="MEDIUM",
+                target=f"action:{a.name}",
+                message=(
+                    f"action {a.name!r} is statically disabled for every "
+                    f"choice under the declared bounds — dead spec code "
+                    f"or a mistranscribed guard"
+                ),
+                data={"action": a.name, "choices": a.n_choices},
+            ))
+        writes = getattr(a, "writes", None)
+        if writes is not None:
+            # observed changes from the ANALYZED choices can only
+            # understate violations, so partial skips don't gate this
+            # (and the unknown-name check needs no abstract run at all)
+            extra = sorted(changed - set(writes))
+            if extra:
+                findings.append(Finding(
+                    kind="frame-violation",
+                    severity="HIGH",
+                    target=f"action:{a.name}",
+                    message=(
+                        f"action {a.name!r} writes {extra} outside its "
+                        f"declared write set {sorted(writes)}"
+                    ),
+                    data={"action": a.name, "extra_writes": extra,
+                          "declared_writes": sorted(writes)},
+                ))
+            # note: declared write sets are UPPER bounds — an action may
+            # pass a field through unchanged (ControllerElectLeader
+            # re-publishes the same quorum ISR object), so declared-but-
+            # unchanged is NOT a finding; only changed-but-undeclared is.
+            unknown = sorted(n for n in writes if n not in by_name)
+            if unknown:
+                findings.append(Finding(
+                    kind="frame-violation",
+                    severity="HIGH",
+                    target=f"action:{a.name}",
+                    message=(
+                        f"action {a.name!r} declares writes {unknown} "
+                        f"that are not fields of the spec"
+                    ),
+                    data={"action": a.name, "unknown_writes": unknown},
+                ))
+
+    # dead / read-of-unwritten fields (whole-model facts); with any
+    # skipped action's writes unknown, the pass would be guessing — skip
+    for f in (fields if not writes_unknown else ()):
+        if f.name in written_any:
+            continue
+        if f.name in read_any:
+            findings.append(Finding(
+                kind="read-of-unwritten-field",
+                severity="LOW",
+                target=f"field:{f.name}",
+                message=(
+                    f"field {f.name!r} feeds action guards/updates but "
+                    f"no action ever writes it — it is constant at its "
+                    f"init value (forgotten update transcription?)"
+                ),
+                data={"field": f.name},
+            ))
+        else:
+            findings.append(Finding(
+                kind="dead-field",
+                severity="LOW",
+                target=f"field:{f.name}",
+                message=(
+                    f"field {f.name!r} is neither written nor read by "
+                    f"any action — encoding bits wasted on a constant "
+                    f"(invariants may still read it)"
+                ),
+                data={"field": f.name},
+            ))
+    return findings
+
+
+def apply_suppressions(findings, model) -> list:
+    """Downgrade findings matching ``meta['analysis_suppress']`` entries
+    to INFO, carrying the justification (docs/analysis.md)."""
+    rules = []
+    meta = getattr(model, "meta", None) or {}
+    for r in meta.get("analysis_suppress", ()):
+        rules.append((r.get("kind"), r.get("target", ""),
+                      r.get("reason", "suppressed")))
+    if not rules:
+        return list(findings)
+    out = []
+    for f in findings:
+        for kind, target, reason in rules:
+            if (kind is None or kind == f.kind) and target in f.target:
+                f = Finding(kind=f.kind, severity="INFO", target=f.target,
+                            message=f.message, data=f.data,
+                            suppressed=reason)
+                break
+        out.append(f)
+    return out
+
+
+def analyze_model(model) -> list:
+    """Spec-width + action passes + suppressions for one built model."""
+    findings = spec_fits_errors(model.spec.fields, context=model.name)
+    findings += analyze_actions(model)
+    return apply_suppressions(findings, model)
+
+
+def verify_model_encoding(model) -> list:
+    """The build-time gate's core: raise :class:`EncodingUnsound` on any
+    unsuppressed HIGH encoding finding (spec-width, encoding-overflow,
+    frame-violation); return the full finding list otherwise."""
+    findings = analyze_model(model)
+    fatal = [f for f in findings
+             if f.severity == "HIGH"
+             and f.kind in ("spec-width", "encoding-overflow",
+                            "frame-violation")]
+    if fatal:
+        head = fatal[0]
+        raise EncodingUnsound(
+            f"model {model.name!r} is encoding-unsound "
+            f"({len(fatal)} HIGH finding(s)); first: {head.message}  "
+            f"[refusing to explore: the verdict would be untrustworthy; "
+            f"KSPEC_ANALYZE=0 overrides at your own risk]",
+            findings=fatal,
+        )
+    return findings

@@ -1166,6 +1166,13 @@ def check(
     bit-identical to an uninterrupted run (tests/test_resources.py).
     """
     spec = model.spec
+    # encoding-soundness gate (analysis; KSPEC_ANALYZE=0 disables): an
+    # action that can write outside its declared field ranges would be
+    # silently truncated by the bit packer — refuse to explore instead
+    # of returning a wrong verdict (memoized per model name)
+    from ..analysis import require_encoding_sound
+
+    require_encoding_sound(model)
     if prepared is not None and prepared.model is not model:
         raise ValueError("prepared kernels wrap a different model object")
     step_builder = prepared.step if prepared is not None else _Step(model)

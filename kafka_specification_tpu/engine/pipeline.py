@@ -119,7 +119,7 @@ def key_vcap(key: tuple) -> Optional[int]:
 # --------------------------------------------------------------------------
 
 
-def squeeze_stage(cand, parent, actid, valid, width, K):
+def squeeze_stage(cand, parent, actid, valid, width, K):  # kspec: traced
     """Stage 2: compact enabled candidate rows to the front of a `width`
     buffer; overflow=True iff more than `width` rows are enabled."""
     n_en = jnp.sum(valid, dtype=jnp.int32)
@@ -131,7 +131,7 @@ def squeeze_stage(cand, parent, actid, valid, width, K):
     return out, out_parent, out_act, rowvalid, n_en, n_en > width
 
 
-def fp_stage(cand, valid, spec, use_pallas: bool):
+def fp_stage(cand, valid, spec, use_pallas: bool):  # kspec: traced
     """Stage 3: masked (hi, lo) fingerprints (Pallas opt-in or jnp)."""
     sent = jnp.uint32(dedup.SENT)
     if use_pallas:
@@ -148,7 +148,7 @@ def fp_stage(cand, valid, spec, use_pallas: bool):
     return jnp.where(valid, hi, sent), jnp.where(valid, lo, sent)
 
 
-def invariant_stage(model, states, fvalid, with_invariants: bool):
+def invariant_stage(model, states, fvalid, with_invariants: bool):  # kspec: traced
     """Stage 5: per-invariant (any-violated, first-index) on the frontier
     being expanded (each state checked exactly once, at expansion)."""
     if not (with_invariants and model.invariants):
@@ -166,7 +166,7 @@ def invariant_stage(model, states, fvalid, with_invariants: bool):
     return jnp.stack(viol_any), jnp.stack(viol_idx)
 
 
-def sorted_dedup_stage(cand, parent, actid, valid, hi, lo,
+def sorted_dedup_stage(cand, parent, actid, valid, hi, lo,  # kspec: traced
                        vhi, vlo, vn, vcap, T, K, with_merge: bool):
     """Stage 4 (device backend): minimal-payload lexsort, first-occurrence
     + visited-rank dedup, compaction of the new states to the front, and
@@ -455,7 +455,7 @@ class FusedPipeline:
         n_actions = len(model.actions)
         check_invariants = self.check_invariants
 
-        def guards_one(state):
+        def guards_one(state):  # kspec: traced
             parts = []
             for a in model.actions:
                 choices = jnp.arange(a.n_choices, dtype=jnp.int32)
@@ -465,7 +465,7 @@ class FusedPipeline:
                 parts.append(ok)
             return jnp.concatenate(parts)
 
-        def step(frontier, fvalid):
+        def step(frontier, fvalid):  # kspec: traced
             states = jax.vmap(spec.unpack)(frontier)
             en_pre = jax.vmap(guards_one)(states)  # [B, C] predicate matrix
             ga = en_pre & fvalid[:, None]
@@ -500,11 +500,14 @@ class FusedPipeline:
             ]
         )
 
-        def step(frontier, sidx, chloc, rowvalid, vhi, vlo, vn):
+        def step(frontier, sidx, chloc, rowvalid, vhi, vlo, vn):  # kspec: traced
             states = jax.vmap(spec.unpack)(frontier)
             gstate = jax.tree.map(lambda x: x[sidx], states)
             cand_parts, ok_parts = [], []
             for i, a in enumerate(model.actions):
+                # kspec: allow(host-materialization) offs is the static
+                # trace-time width table (np cumsum of Python ints), not
+                # a traced value
                 sl = slice(int(offs[i]), int(offs[i + 1]))
                 ga = jax.tree.map(lambda x: x[sl], gstate)
                 # guards are NOT re-evaluated: launch 1 proved every
@@ -528,6 +531,7 @@ class FusedPipeline:
                 return cand, ok, hi, lo
             act_en = jnp.stack(
                 [
+                    # kspec: allow(host-materialization) static width table
                     jnp.sum(ok[int(offs[i]): int(offs[i + 1])],
                             dtype=jnp.int32)
                     for i in range(len(model.actions))

@@ -59,18 +59,36 @@ def check_encoding_bounds(cfg: AsyncIsrConfig) -> None:
     """The N <= 4 encoding cliff, checked wherever a config enters
     (engine spec, model, oracle): the request set is encoded as a
     per-version 2^N-bit ISR-subset bitset (`req_bits`) that must fit ONE
-    signed int32 lane — 2^5 = 32 bits already overflows it.  Failing
-    loudly here (VERDICT weak #7) beats the silent packing corruption a
-    wider config would otherwise hit; spreading the bitset over multiple
-    lanes is the documented extension path (TODO.md)."""
-    if cfg.n_replicas > 4:
-        raise ValueError(
+    signed int32 lane — 2^5 = 32 bits already overflows it.
+
+    The DETECTOR is now the general spec-width pass
+    (analysis/encoding.spec_fits_errors — every field of every model is
+    held to the packed int32 element dtype at Model construction); this
+    wrapper keeps the AsyncIsr-specific actionable message, and the
+    oracle keeps calling it because a config the engine cannot encode
+    must not be silently accepted by the cross-check path either.
+    Spreading the bitset over multiple lanes is the documented extension
+    path (TODO.md)."""
+    from ..analysis.encoding import EncodingUnsound, spec_fits_errors
+
+    # bitset width 2^N, with N capped BEFORE the shift so a wild config
+    # (a typo'd N of 10^12) can't make the probe allocate an N-bit
+    # integer — past the cap the bound already exceeds the int32 element
+    # range by construction, which is all the detector needs
+    probe = Field(
+        "req_bits", (cfg.max_version + 1,), 0,
+        (1 << (1 << min(cfg.n, 6))) - 1,
+    )
+    findings = spec_fits_errors([probe], context="AsyncIsr")
+    if findings:
+        raise EncodingUnsound(
             f"AsyncIsr supports at most 4 replicas, got {cfg.n_replicas}: "
             "the request set is encoded as a per-version 2^N-bit subset "
             "bitset (req_bits) that must fit one signed int32 element "
-            f"(2^{cfg.n_replicas} = {1 << cfg.n_replicas} bits > 31); "
+            f"(2^{cfg.n_replicas} bits > 31); "
             "reduce the replica count or extend the encoding to multiple "
-            "lanes"
+            "lanes",
+            findings=findings,
         )
 
 
@@ -138,7 +156,8 @@ def controller_shrink_isr(cfg: AsyncIsrConfig):
             "upd_isr": s["upd_isr"].at[ver].set(isr),
         }
 
-    return Action("ControllerShrinkIsr", cfg.n, kernel)
+    return Action("ControllerShrinkIsr", cfg.n, kernel,
+                  writes=frozenset({"c_isr", "c_ver", "upd_isr"}))
 
 
 def controller_handle_request(cfg: AsyncIsrConfig):
@@ -155,7 +174,8 @@ def controller_handle_request(cfg: AsyncIsrConfig):
             "upd_isr": s["upd_isr"].at[ver].set(subset),
         }
 
-    return Action("ControllerHandleRequest", 1 << cfg.n, kernel)
+    return Action("ControllerHandleRequest", 1 << cfg.n, kernel,
+                  writes=frozenset({"c_isr", "c_ver", "upd_isr"}))
 
 
 def leader_request_shrink_isr(cfg: AsyncIsrConfig):
@@ -173,7 +193,8 @@ def leader_request_shrink_isr(cfg: AsyncIsrConfig):
             "l_pver": s["l_ver"],
         }
 
-    return Action("LeaderRequestShrinkIsr", cfg.n, kernel)
+    return Action("LeaderRequestShrinkIsr", cfg.n, kernel,
+                  writes=frozenset({"req_bits", "l_pend", "l_pver"}))
 
 
 def leader_request_expand_isr(cfg: AsyncIsrConfig):
@@ -190,7 +211,8 @@ def leader_request_expand_isr(cfg: AsyncIsrConfig):
             "l_pver": s["l_ver"],
         }
 
-    return Action("LeaderRequestExpandIsr", cfg.n, kernel)
+    return Action("LeaderRequestExpandIsr", cfg.n, kernel,
+                  writes=frozenset({"req_bits", "l_pend", "l_pver"}))
 
 
 def leader_write(cfg: AsyncIsrConfig):
@@ -205,7 +227,7 @@ def leader_write(cfg: AsyncIsrConfig):
             ),
         }
 
-    return Action("LeaderWrite", 1, kernel)
+    return Action("LeaderWrite", 1, kernel, writes=frozenset({"offs"}))
 
 
 def leader_handle_update(cfg: AsyncIsrConfig):
@@ -220,7 +242,8 @@ def leader_handle_update(cfg: AsyncIsrConfig):
             "l_pver": jnp.int32(NIL),
         }
 
-    return Action("LeaderHandleUpdate", cfg.max_version + 1, kernel)
+    return Action("LeaderHandleUpdate", cfg.max_version + 1, kernel,
+                  writes=frozenset({"l_isr", "l_ver", "l_pend", "l_pver"}))
 
 
 def follower_replicate(cfg: AsyncIsrConfig):
@@ -232,7 +255,8 @@ def follower_replicate(cfg: AsyncIsrConfig):
             "offs": s["offs"].at[r].set(jnp.minimum(s["offs"][r] + 1, cfg.max_offset)),
         }
 
-    return Action("FollowerReplicate", cfg.n, kernel)
+    return Action("FollowerReplicate", cfg.n, kernel,
+                  writes=frozenset({"offs"}))
 
 
 def valid_high_watermark(cfg: AsyncIsrConfig):

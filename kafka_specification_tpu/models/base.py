@@ -33,6 +33,12 @@ class Action:
     name: str
     n_choices: int
     kernel: SuccessorKernel
+    # declared write set (TLA+ frame condition: the variables this
+    # action's disjunct primes).  None = undeclared (emitted models,
+    # ad-hoc test kernels); when declared, the static analyzer's
+    # frame-condition pass proves the kernel writes nothing else
+    # (analysis/encoding.py; docs/analysis.md)
+    writes: Optional[frozenset] = None
 
 
 @dataclass(frozen=True)
@@ -59,6 +65,16 @@ class Model:
     # and StrongIsr share their quantifier core); engines fall back to the
     # per-invariant preds when None (and for single-invariant re-checks).
     invariants_fused: Optional[Callable] = None
+
+    def __post_init__(self):
+        # spec-width soundness at EVERY model construction: each declared
+        # field range must fit the int32 packed-element dtype and a
+        # 32-bit lane (the general form of the AsyncIsr N<=4 cliff; the
+        # interval pass over the action kernels runs at the engine/CLI
+        # gates — analysis/encoding.py, docs/analysis.md).  jax-free.
+        from ..analysis.encoding import check_spec_fields
+
+        check_spec_fields(self.spec.fields, context=self.name)
 
     @property
     def total_fanout(self) -> int:

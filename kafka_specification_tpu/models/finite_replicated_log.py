@@ -107,9 +107,12 @@ def make_model(
         spec=spec,
         init_states=init,
         actions=[
-            Action("Append", N * R, append),
-            Action("TruncateTo", N * L, truncate_to),
-            Action("ReplicateTo", N * (N - 1), replicate_to),
+            Action("Append", N * R, append,
+                   writes=frozenset({"end", "rec"})),
+            Action("TruncateTo", N * L, truncate_to,
+                   writes=frozenset({"end", "rec"})),
+            Action("ReplicateTo", N * (N - 1), replicate_to,
+                   writes=frozenset({"end", "rec"})),
         ],
         invariants=[Invariant("TypeOk", type_ok)],
         decode=decode,

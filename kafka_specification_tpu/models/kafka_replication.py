@@ -129,6 +129,17 @@ def init_state(cfg: Config) -> dict:
 # --------------------------------------------------------------------------
 
 
+# declared write sets (frame conditions) for the analyzer's
+# frame-condition pass (analysis/encoding.py): the variables each
+# action's TLA+ disjunct primes, in tensor-lane terms
+_CTRL_WRITES = frozenset({"nep", "qep", "qldr", "qisr", "req_ldr", "req_isr"})
+_QUORUM_WRITES = frozenset({"qisr", "isr"})
+_BECOME_FOLLOWER_WRITES = frozenset(
+    {"rid", "repoch", "end", "ep", "ldr", "isr", "hw"}
+)
+_REPLICATE_WRITES = frozenset({"rid", "repoch", "end", "hw"})
+
+
 def _bit(r):
     return jnp.int32(1) << r
 
@@ -205,7 +216,8 @@ def controller_shrink_isr(cfg: Config):
         ok, nxt = _ctrl_update_isr(cfg, s, new_leader, new_isr)
         return enabled & ok, nxt
 
-    return Action("ControllerShrinkIsr", cfg.n, kernel)
+    return Action("ControllerShrinkIsr", cfg.n, kernel,
+                  writes=_CTRL_WRITES)
 
 
 def controller_elect_leader(cfg: Config):
@@ -215,7 +227,8 @@ def controller_elect_leader(cfg: Config):
         ok, nxt = _ctrl_update_isr(cfg, s, r, s["qisr"])
         return enabled & ok, nxt
 
-    return Action("ControllerElectLeader", cfg.n, kernel)
+    return Action("ControllerElectLeader", cfg.n, kernel,
+                  writes=_CTRL_WRITES)
 
 
 def become_leader(cfg: Config):
@@ -232,7 +245,8 @@ def become_leader(cfg: Config):
             # hw unchanged — the stale-HW subtlety (:183-185, :191)
         }
 
-    return Action("BecomeLeader", cfg.e + 1, kernel)
+    return Action("BecomeLeader", cfg.e + 1, kernel,
+                  writes=frozenset({"ep", "ldr", "isr"}))
 
 
 def leader_write(cfg: Config):
@@ -251,7 +265,8 @@ def leader_write(cfg: Config):
             "nrid": jnp.minimum(s["nrid"] + 1, cfg.r),
         }
 
-    return Action("LeaderWrite", cfg.n, kernel)
+    return Action("LeaderWrite", cfg.n, kernel,
+                  writes=frozenset({"rid", "repoch", "end", "nrid"}))
 
 
 def _quorum_update(s, l, new_isr):
@@ -274,7 +289,8 @@ def leader_shrink_isr(cfg: Config):
         ok, nxt = _quorum_update(s, l, s["isr"][l] & ~_bit(f))
         return in_isr & lagging & ok, nxt
 
-    return Action("LeaderShrinkIsr", cfg.n * cfg.n, kernel)
+    return Action("LeaderShrinkIsr", cfg.n * cfg.n, kernel,
+                  writes=_QUORUM_WRITES)
 
 
 def leader_expand_isr(cfg: Config):
@@ -286,7 +302,8 @@ def leader_expand_isr(cfg: Config):
         ok, nxt = _quorum_update(s, l, s["isr"][l] | _bit(f))
         return outside & caught & ok, nxt
 
-    return Action("LeaderExpandIsr", cfg.n * cfg.n, kernel)
+    return Action("LeaderExpandIsr", cfg.n * cfg.n, kernel,
+                  writes=_QUORUM_WRITES)
 
 
 def leader_inc_high_watermark(cfg: Config):
@@ -301,7 +318,8 @@ def leader_inc_high_watermark(cfg: Config):
         enabled = presumes & in_offsets & all_isr
         return enabled, {**s, "hw": s["hw"].at[l].set(jnp.minimum(hw + 1, cfg.l))}
 
-    return Action("LeaderIncHighWatermark", cfg.n, kernel)
+    return Action("LeaderIncHighWatermark", cfg.n, kernel,
+                  writes=frozenset({"hw"}))
 
 
 def become_follower_and_truncate_to(cfg: Config, name: str, trunc_offset_fn):
@@ -335,7 +353,8 @@ def become_follower_and_truncate_to(cfg: Config, name: str, trunc_offset_fn):
             "hw": s["hw"].at[r].set(jnp.minimum(toff, s["hw"][r])),  # (:293)
         }
 
-    return Action(name, cfg.n * (cfg.e + 1), kernel)
+    return Action(name, cfg.n * (cfg.e + 1), kernel,
+                  writes=_BECOME_FOLLOWER_WRITES)
 
 
 def follower_replicate(cfg: Config):
@@ -365,7 +384,8 @@ def follower_replicate(cfg: Config):
             "hw": s["hw"].at[f].set(jnp.where(enabled, new_hw, s["hw"][f])),
         }
 
-    return Action("FollowerReplicate", cfg.n * cfg.n, kernel)
+    return Action("FollowerReplicate", cfg.n * cfg.n, kernel,
+                  writes=_REPLICATE_WRITES)
 
 
 # --------------------------------------------------------------------------

@@ -64,7 +64,8 @@ def fenced_follower_fetch(cfg: Config):
             "hw": s["hw"].at[f].set(jnp.where(enabled, new_hw, s["hw"][f])),
         }
 
-    return Action("FencedFollowerFetch", cfg.n * cfg.n, kernel)
+    return Action("FencedFollowerFetch", cfg.n * cfg.n, kernel,
+                  writes=kr._REPLICATE_WRITES)
 
 
 def fenced_leader_inc_high_watermark(cfg: Config):
@@ -80,7 +81,8 @@ def fenced_leader_inc_high_watermark(cfg: Config):
         enabled = has_off & _forall_isr(cfg, s["isr"][l], cond)
         return enabled, {**s, "hw": s["hw"].at[l].set(jnp.minimum(hw + 1, cfg.l))}
 
-    return Action("FencedLeaderIncHighWatermark", cfg.n, kernel)
+    return Action("FencedLeaderIncHighWatermark", cfg.n, kernel,
+                  writes=frozenset({"hw"}))
 
 
 def _following_epoch_vec(cfg, s, l):
@@ -98,7 +100,8 @@ def fenced_leader_shrink_isr(cfg: Config):
         ok, nxt = kr._quorum_update(s, l, s["isr"][l] & ~_bit(f))
         return in_isr & stale & ok, nxt
 
-    return Action("FencedLeaderShrinkIsr", cfg.n * cfg.n, kernel)
+    return Action("FencedLeaderShrinkIsr", cfg.n * cfg.n, kernel,
+                  writes=kr._QUORUM_WRITES)
 
 
 def fenced_leader_expand_isr(cfg: Config):
@@ -119,7 +122,8 @@ def fenced_leader_expand_isr(cfg: Config):
             outside & _following_epoch(s, l, f) & follower_at_hw & hw_at_epoch & ok
         ), nxt
 
-    return Action("FencedLeaderExpandIsr", cfg.n * cfg.n, kernel)
+    return Action("FencedLeaderExpandIsr", cfg.n * cfg.n, kernel,
+                  writes=kr._QUORUM_WRITES)
 
 
 def fenced_become_follower_and_truncate(cfg: Config):
@@ -155,7 +159,8 @@ def fenced_become_follower_and_truncate(cfg: Config):
             "hw": s["hw"].at[r].set(jnp.minimum(toff, s["hw"][r])),  # (:145)
         }
 
-    return Action("FencedBecomeFollowerAndTruncate", cfg.n * (cfg.e + 1), kernel)
+    return Action("FencedBecomeFollowerAndTruncate", cfg.n * (cfg.e + 1),
+                  kernel, writes=kr._BECOME_FOLLOWER_WRITES)
 
 
 # --------------------------------------------------------------------------
@@ -206,7 +211,8 @@ def ft_follower_truncate(cfg: Config):
             "hw": s["hw"].at[f].set(jnp.minimum(toff, s["hw"][f])),  # (:81)
         }
 
-    return Action("FollowerTruncate", cfg.n * cfg.n, kernel)
+    return Action("FollowerTruncate", cfg.n * cfg.n, kernel,
+                  writes=kr._REPLICATE_WRITES)
 
 
 def ft_improved_leader_inc_high_watermark(cfg: Config):
@@ -226,7 +232,8 @@ def ft_improved_leader_inc_high_watermark(cfg: Config):
         enabled = presumes & has_entry & _forall_isr(cfg, s["isr"][l], cond)
         return enabled, {**s, "hw": s["hw"].at[l].set(jnp.minimum(hw + 1, cfg.l))}
 
-    return Action("ImprovedLeaderIncHighWatermark", cfg.n, kernel)
+    return Action("ImprovedLeaderIncHighWatermark", cfg.n, kernel,
+                  writes=frozenset({"hw"}))
 
 
 def ft_follower_fetch(cfg: Config):
@@ -254,7 +261,8 @@ def ft_follower_fetch(cfg: Config):
             "hw": s["hw"].at[f].set(jnp.where(enabled, new_hw, s["hw"][f])),
         }
 
-    return Action("FollowerFetch", cfg.n * cfg.n, kernel)
+    return Action("FollowerFetch", cfg.n * cfg.n, kernel,
+                  writes=kr._REPLICATE_WRITES)
 
 
 def ft_leader_shrink_isr(cfg: Config):
@@ -266,7 +274,8 @@ def ft_leader_shrink_isr(cfg: Config):
         ok, nxt = kr._quorum_update(s, l, s["isr"][l] & ~_bit(f))
         return in_isr & lagging & ok, nxt
 
-    return Action("LeaderShrinkIsrBetterFencing", cfg.n * cfg.n, kernel)
+    return Action("LeaderShrinkIsrBetterFencing", cfg.n * cfg.n, kernel,
+                  writes=kr._QUORUM_WRITES)
 
 
 def ft_leader_expand_isr(cfg: Config):
@@ -284,7 +293,8 @@ def ft_leader_expand_isr(cfg: Config):
         ok, nxt = kr._quorum_update(s, l, s["isr"][l] | _bit(f))
         return outside & caught & hw_at_epoch & ok, nxt
 
-    return Action("LeaderExpandIsrBetterFencing", cfg.n * cfg.n, kernel)
+    return Action("LeaderExpandIsrBetterFencing", cfg.n * cfg.n, kernel,
+                  writes=kr._QUORUM_WRITES)
 
 
 def ft_become_follower(cfg: Config):
@@ -302,7 +312,8 @@ def ft_become_follower(cfg: Config):
             "isr": s["isr"].at[r].set(s["req_isr"][e]),
         }
 
-    return Action("BecomeFollower", cfg.n * (cfg.e + 1), kernel)
+    return Action("BecomeFollower", cfg.n * (cfg.e + 1), kernel,
+                  writes=frozenset({"ep", "ldr", "isr"}))
 
 
 # --------------------------------------------------------------------------

@@ -82,7 +82,14 @@ def product_models(bases, name: str | None = None, meta: dict | None = None) -> 
                 ok, nxt = a.kernel(split(state, p), choice)
                 return ok, embed(state, p, nxt)
 
-            actions.append(Action(f"p{p}.{a.name}", a.n_choices, kernel))
+            writes = (
+                frozenset(f"p{p}.{w}" for w in a.writes)
+                if a.writes is not None else None
+            )
+            actions.append(
+                Action(f"p{p}.{a.name}", a.n_choices, kernel,
+                       writes=writes)
+            )
 
     inv_names = [i.name for i in bases[0].invariants]
     for b in bases[1:]:

@@ -54,6 +54,34 @@ from typing import Callable, Optional
 OVERLAP_ENV = "KSPEC_OVERLAP"
 _OFF = ("0", "off", "false", "no")
 
+#: machine-readable ownership contract (docs/analysis.md; verified by
+#: `cli analyze`'s AST pass and, under KSPEC_TSAN=1, asserted on every
+#: attribute write at runtime).  This is the docs/engine.md § Async
+#: execution prose as data:
+#: - AsyncJob results are written by the worker and published by
+#:   `done.set()`; immutable afterwards (readers join through wait()).
+#: - AsyncWorker queue/accounting state is guarded by `_cv`;
+#:   `blocked_s` belongs to the single submitting (engine) thread.
+THREAD_CONTRACT = {
+    "schema": "kspec-ownership/1",
+    "classes": {
+        "AsyncJob": {
+            "immutable_after_init": ["label", "done"],
+            # result/exc/seconds/fn: worker-written, immutable after
+            # done.set() — writes happen in AsyncWorker._run, so they
+            # are checked under AsyncWorker's worker context
+        },
+        "AsyncWorker": {
+            "lock": "_cv",
+            "shared_locked": ["_q", "_inflight", "_failed", "_closed",
+                              "busy_s", "jobs_done"],
+            "engine_only": ["blocked_s"],
+            "immutable_after_init": ["name", "_cv", "_thread"],
+            "worker_methods": ["_run"],
+        },
+    },
+}
+
 
 def overlap_enabled(flag=None) -> bool:
     """Resolve the overlap knob: explicit arg > $KSPEC_OVERLAP > on."""
@@ -140,6 +168,15 @@ class AsyncWorker:
 
     # --- worker loop ------------------------------------------------------
     def _run(self) -> None:
+        from .analysis import ownership as _own  # jax-free
+
+        _own.register_worker_thread(self._thread)
+        try:
+            self._run_loop()
+        finally:
+            _own.unregister_worker_thread(self._thread)
+
+    def _run_loop(self) -> None:
         while True:
             with self._cv:
                 while not self._q and not self._closed:
@@ -252,3 +289,10 @@ def worker_counters(workers) -> tuple:
             busy += w.busy_s
             blocked += w.blocked_s
     return busy, blocked
+
+
+# KSPEC_TSAN=1 (test-only): assert THREAD_CONTRACT ownership on every
+# attribute write (analysis/ownership.py); zero overhead otherwise
+from .analysis.ownership import bind_contract as _bind_contract  # noqa: E402
+
+_bind_contract(globals(), THREAD_CONTRACT)

@@ -220,7 +220,7 @@ def _make_sharded_step(
     W = dest_w if dest_w is not None else _default_dest_w(T, D)
     R = D * W if exchange == "all_to_all" else D * T  # receive width
 
-    def shard_body(frontier, fvalid, vhi, vlo, vn):
+    def shard_body(frontier, fvalid, vhi, vlo, vn):  # kspec: traced
         # per-shard views: frontier [bucket, K], vhi [1, vcap], vn [1]
         vhi, vlo, vn = vhi[0], vlo[0], vn[0]
         me = jax.lax.axis_index("d")
@@ -238,7 +238,7 @@ def _make_sharded_step(
         # parent as a mesh-global frontier row id (survives the exchange)
         parent_g = me.astype(jnp.int32) * bucket + parent
 
-        def fp_digest(dhi, dlo, mask):
+        def fp_digest(dhi, dlo, mask):  # kspec: traced
             """Exchange framing record: order-invariant (count, xor_hi,
             xor_lo, sum_hi, sum_lo) over a masked fingerprint multiset —
             the payload's integrity stamp.  Computed per shard BEFORE and
@@ -784,6 +784,11 @@ def check_sharded(
     collective, and the fleet supervisor classifies the rc-75 exit as a
     resource verdict instead of restarting into the same full disk.
     """
+    # encoding-soundness gate (analysis; KSPEC_ANALYZE=0 disables) —
+    # same refusal contract as engine.check, memoized per model name
+    from ..analysis import require_encoding_sound
+
+    require_encoding_sound(model)
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("d",))
     D = mesh.devices.size

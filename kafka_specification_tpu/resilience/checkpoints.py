@@ -46,6 +46,25 @@ from .faults import FaultPlan
 
 MANIFEST_KEY = "__manifest__"
 
+#: machine-readable ownership contract (docs/analysis.md;
+#: docs/resilience.md § Async checkpoint writes as data): the writer
+#: thread runs `save()` — which mutates NOTHING on the store (files
+#: only; every array handed to save_async is immutable from snapshot
+#: time) — while the async bookkeeping (_async_job/_async_done and the
+#: attached writer) belongs to the engine thread that polls it.
+THREAD_CONTRACT = {
+    "schema": "kspec-ownership/1",
+    "classes": {
+        "CheckpointStore": {
+            "engine_only": ["_writer", "_async_job", "_async_done"],
+            "immutable_after_init": ["directory", "basename", "ident",
+                                     "ident_aliases", "keep",
+                                     "fault_plan", "validators"],
+            "worker_safe": ["save"],
+        },
+    },
+}
+
 
 class CheckpointCorrupt(Exception):
     """No on-disk checkpoint generation passed verification."""
@@ -651,3 +670,10 @@ def verify_checkpoint_dir(directory: str, spill_dir=None) -> dict:
         s["ok"] for s in report["stores"]
     )
     return report
+
+
+# KSPEC_TSAN=1 (test-only): assert THREAD_CONTRACT ownership on every
+# attribute write (analysis/ownership.py); zero overhead otherwise
+from ..analysis.ownership import bind_contract as _bind_contract  # noqa: E402
+
+_bind_contract(globals(), THREAD_CONTRACT)

@@ -33,6 +33,29 @@ from .runs import SortedRun, merge_runs, write_run
 # addressing load, i.e. ~16 B per live entry
 _BYTES_PER_FP = 16
 
+#: machine-readable ownership contract (docs/analysis.md; docs/storage.md
+#: § Background merges as data): the merge worker writes FILES ONLY — its
+#: job closure captures immutable SortedRun inputs and never touches the
+#: set object, so every attribute is engine-thread-only; adoption of a
+#: finished merge (run-list swap, counter retirement, deletion-barrier
+#: scheduling) happens on the engine thread in poll_merge.
+THREAD_CONTRACT = {
+    "schema": "kspec-ownership/1",
+    "classes": {
+        "DeferredDeleter": {
+            "engine_only": ["pending", "barrier"],
+        },
+        "TieredFpSet": {
+            "engine_only": ["hot", "runs", "disk_n", "seq", "spills",
+                            "merges", "_merge_job", "_retired_probes",
+                            "mem_budget", "deleter"],
+            "immutable_after_init": ["dir", "runs_per_merge",
+                                     "fault_plan", "verify_on_open",
+                                     "merge_worker"],
+        },
+    },
+}
+
 
 class DeferredDeleter:
     """Deletion barrier keyed to checkpoint saves.
@@ -521,3 +544,10 @@ class TieredFpSet:
             self.merge_worker.wait(job)  # consumes THIS job's error only
         except BaseException:  # noqa: BLE001 — discarded with the merge
             pass
+
+
+# KSPEC_TSAN=1 (test-only): assert THREAD_CONTRACT ownership on every
+# attribute write (analysis/ownership.py); zero overhead otherwise
+from ..analysis.ownership import bind_contract as _bind_contract  # noqa: E402
+
+_bind_contract(globals(), THREAD_CONTRACT)

@@ -149,6 +149,7 @@ def build_model(
     oracle: bool = False,
     emitted: bool = False,
     reference=None,
+    analysis_gate: bool = True,
 ):
     """Instantiate the tensor model (or its oracle twin) for a TLA+ module
     name under a parsed TLC config.
@@ -171,6 +172,22 @@ def build_model(
     if emitted and oracle:
         raise ValueError("emitted models have no oracle twin (the oracle IS "
                          "an independent path; use oracle=False)")
+    def _sound(built):
+        # build-time encoding-soundness gate (analysis; KSPEC_ANALYZE=0
+        # disables): an unsound (config, schema) pair refuses to build —
+        # `cli check` then exits 2 with the interval counterexample
+        # instead of exploring to a wrong verdict (docs/analysis.md).
+        # Oracle twins carry no tensor schema and are exempt (their
+        # entry points share the AsyncIsr cliff check directly).
+        # analysis_gate=False is for callers that run the FULL analysis
+        # themselves (`cli analyze` wants the finding list, not the
+        # first-HIGH refusal).
+        if analysis_gate and not oracle:
+            from ..analysis import require_encoding_sound
+
+            require_encoding_sound(built)
+        return built
+
     if cfg.constraints and module != "AsyncIsr":
         raise ValueError(
             f"CONSTRAINT {cfg.constraints} is not supported for module "
@@ -179,23 +196,23 @@ def build_model(
     c = cfg.constants
     if module == "IdSequence":
         if emitted:
-            return _emitted_id_sequence(int(c["MaxId"]), reference)
+            return _sound(_emitted_id_sequence(int(c["MaxId"]), reference))
         from ..models import id_sequence as m
 
-        return (m.make_oracle if oracle else m.make_model)(int(c["MaxId"]))
+        return _sound((m.make_oracle if oracle else m.make_model)(int(c["MaxId"])))
     if module == "FiniteReplicatedLog":
         if emitted:
-            return _emitted_frl(
+            return _sound(_emitted_frl(
                 _setlen(c["Replicas"]),
                 int(c["LogSize"]),
                 _setlen(c["LogRecords"]),
                 reference,
-            )
+            ))
         from ..models import finite_replicated_log as m
 
-        return (m.make_oracle if oracle else m.make_model)(
+        return _sound((m.make_oracle if oracle else m.make_model)(
             _setlen(c["Replicas"]), int(c["LogSize"]), _setlen(c["LogRecords"])
-        )
+        ))
     if module in KAFKA_VARIANTS or module in ("Kip320", "Kip320FirstTry"):
         from ..models.kafka_replication import Config
 
@@ -234,7 +251,7 @@ def build_model(
             from ..models.product import product_model, product_oracle
 
             built = (product_oracle if oracle else product_model)(built, k)
-        return built
+        return _sound(built)
     if module == "AsyncIsr":
         from ..models import async_isr as m
 
@@ -247,13 +264,15 @@ def build_model(
         if emitted:
             from ..models.emitted import make_emitted_async_isr
 
-            return _with_names(
+            return _sound(_with_names(
                 make_emitted_async_isr(
                     acfg, invariants=invs, reference=reference
                 ),
                 c,
-            )
-        return _with_names((m.make_oracle if oracle else m.make_model)(acfg, invs), c)
+            ))
+        return _sound(
+            _with_names((m.make_oracle if oracle else m.make_model)(acfg, invs), c)
+        )
     raise KeyError(f"unknown module {module!r}")
 
 

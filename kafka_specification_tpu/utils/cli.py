@@ -446,6 +446,42 @@ def main(argv=None):
     )
     pf.add_argument("--json", action="store_true")
 
+    pan = sub.add_parser(
+        "analyze",
+        help="static analysis of the specs and the engine (docs/"
+        "analysis.md): encoding-soundness proofs (interval abstract "
+        "interpretation of every action kernel against its packed field "
+        "ranges), action/guard lint (vacuous guards, frame violations, "
+        "dead fields), and the concurrency-ownership + purity checks "
+        "over the engine sources.  NEVER imports jax (the model modules "
+        "load under a stub; kernels run abstractly) — usable on a box "
+        "with no accelerator stack.  Exits non-zero on any HIGH finding; "
+        "--json emits the schema-versioned kspec-analysis/1 record",
+    )
+    pan.add_argument(
+        "cfgs", nargs="*",
+        help="TLC .cfg files to analyze (default: every configs/*.cfg "
+        "— the full shipped-model matrix)",
+    )
+    pan.add_argument(
+        "--module",
+        help="TLA+ module for a single .cfg (default: the cfg stem)",
+    )
+    pan.add_argument(
+        "--no-models", action="store_true",
+        help="skip the per-model encoding/lint passes",
+    )
+    pan.add_argument(
+        "--no-engine", action="store_true",
+        help="skip the engine ownership/purity passes",
+    )
+    pan.add_argument(
+        "--info", action="store_true",
+        help="also print INFO findings (suppressions, skips)",
+    )
+    pan.add_argument("--json", action="store_true",
+                     help="machine-readable kspec-analysis/1 record")
+
     pr = sub.add_parser(
         "report",
         help="render a run directory (manifest + stats + spans + metrics + "
@@ -666,6 +702,13 @@ def main(argv=None):
         print("Examples: crash@level:7   enospc@spill:2   "
               "flip@shard1:exchange:3   corrupt_ckpt@ckpt:4")
         return 0
+
+    if args.cmd == "analyze":
+        # the static-analysis front door: jax-free by contract (the
+        # model modules import under analysis.install_jax_stub and the
+        # kernels execute abstractly) — it must run in CI and on
+        # operator boxes whose accelerator stack is wedged
+        return _run_analyze(args)
 
     if args.cmd == "verify-checkpoint":
         # like `report`, this must run on a box whose accelerator is
@@ -1106,6 +1149,103 @@ def main(argv=None):
     )
     return 0 if res.violation is None else 1
 
+
+
+def _run_analyze(args) -> int:
+    """`cli analyze`: the spec & engine static-analysis driver.
+
+    Exit codes: 0 = no HIGH findings, 1 = HIGH findings, 2 = a target
+    could not even be analyzed (unreadable cfg, unknown module)."""
+    from pathlib import Path
+
+    from ..analysis import (
+        analysis_record,
+        analyze_engine_sources,
+        install_jax_stub,
+        repo_root,
+    )
+
+    install_jax_stub()
+    findings = []
+    targets = []
+    rc_error = 0
+
+    if not args.no_models:
+        from ..analysis.encoding import EncodingUnsound, analyze_model
+
+        cfg_paths = list(args.cfgs)
+        if args.module and len(cfg_paths) != 1:
+            # never silently drop an explicit flag: --module pairs with
+            # exactly one .cfg (the default matrix resolves its own)
+            print(
+                "error: --module requires exactly one .cfg argument "
+                f"(got {len(cfg_paths)})",
+                file=sys.stderr,
+            )
+            return 2
+        if not cfg_paths:
+            cfg_paths = sorted(
+                str(p) for p in Path(repo_root(), "configs").glob("*.cfg")
+            )
+        # stems that are not module names (TLC pairs Model.cfg with
+        # Model.tla; the stretch cfg documents its explicit module)
+        aliases = {"Kip320Stretch": "Kip320"}
+        for path in cfg_paths:
+            stem = Path(path).stem
+            module = args.module or aliases.get(stem, stem)
+            targets.append(f"{module} ({path})")
+            try:
+                tlc_cfg = parse_cfg(path)
+                # analysis_gate=False: the gate raises on the FIRST HIGH
+                # finding; the analyzer wants the full list instead
+                model = build_model(module, tlc_cfg, analysis_gate=False)
+            except EncodingUnsound as e:
+                findings.extend(e.findings)
+                continue
+            except (OSError, ValueError, KeyError) as e:
+                # the record must reflect the failure too: a JSON
+                # consumer keying off `ok` must never read a partially
+                # analyzed matrix as verified clean
+                from ..analysis import Finding
+
+                findings.append(Finding(
+                    kind="analysis-error", severity="HIGH",
+                    target=f"{module} ({path})",
+                    message=f"cannot analyze: {e}",
+                    data={"path": str(path), "module": module},
+                ))
+                print(f"error: cannot analyze {path}: {e}",
+                      file=sys.stderr)
+                rc_error = 2
+                continue
+            findings.extend(analyze_model(model))
+
+    if not args.no_engine:
+        targets.append("engine sources (ownership + purity)")
+        findings.extend(analyze_engine_sources())
+
+    rec = analysis_record(findings, targets=targets)
+    if args.json:
+        print(json.dumps(rec))
+    else:
+        c = rec["counts"]
+        print(
+            f"kspec analyze: {len(targets)} target(s) — "
+            f"{c['HIGH']} high / {c['MEDIUM']} medium / {c['LOW']} low / "
+            f"{c['INFO']} info"
+        )
+        shown = [f for f in findings
+                 if args.info or f.severity != "INFO"]
+        for f in shown:
+            tag = f" [suppressed: {f.suppressed}]" if f.suppressed else ""
+            print(f"  {f.severity:<6} {f.kind:<24} {f.target}{tag}")
+            print(f"         {f.message}")
+        if not shown:
+            print("  clean: encoding sound, frames honored, ownership "
+                  "contracts verified")
+    if rc_error:
+        return rc_error
+    return 0 if rec["ok"] else 1
 
 
 def _service_dir(given) -> str:

@@ -9,8 +9,12 @@
 # Stage 1 (seconds): a static gate — python -m compileall over the
 # package/tests/scripts plus pyflakes when available — so syntax errors
 # and obvious undefined names fail in seconds, not after minutes of XLA
-# compiles.  Stage 2: the ROADMAP "Tier-1 verify" command VERBATIM (keep
-# the quoted block below byte-identical to ROADMAP.md when updating).
+# compiles.  Stage 1.5 (jax-free, ~1s): `cli analyze` — encoding-
+# soundness proofs over the shipped-model matrix, action lint, and the
+# engine ownership/purity contracts (docs/analysis.md); any HIGH
+# finding fails.  Stage 2: the ROADMAP "Tier-1 verify" command VERBATIM
+# (keep the quoted block below byte-identical to ROADMAP.md when
+# updating).
 
 set -u
 cd "$(dirname "$0")/.."
@@ -29,6 +33,23 @@ if python -c "import pyflakes" 2>/dev/null; then
     }
 else
     echo "[tier1] note: pyflakes not installed — skipping (compileall ran)"
+fi
+
+echo "[tier1] stage 1.5: kspec analyze (spec & engine static analysis)"
+# jax-free: encoding-soundness over the shipped-model matrix, action
+# lint, and the engine's concurrency-ownership/purity contracts
+# (docs/analysis.md).  Any HIGH finding fails the gate in ~1s.
+python -m kafka_specification_tpu.utils.cli analyze
+rc_an=$?
+if [ "$rc_an" -ne 0 ]; then
+    # exit-code contract (utils/cli._run_analyze): 1 = HIGH findings,
+    # 2 = a target could not even be analyzed (see stderr above)
+    if [ "$rc_an" -eq 1 ]; then
+        echo "[tier1] FAIL: kspec analyze found HIGH findings" >&2
+    else
+        echo "[tier1] FAIL: kspec analyze could not analyze a target (rc $rc_an)" >&2
+    fi
+    exit 1
 fi
 
 if [ "${1:-}" = "--static" ]; then
