@@ -257,6 +257,75 @@ def _child_main():
         else {"cores": os.cpu_count()},
     }
 
+    # Device-resident level pipeline (PR 12, engine/pipeline.py
+    # DevicePipeline): device vs fused on the SORTED-SET device visited
+    # backend — the workload the device pipeline exists for (the
+    # per-chunk O(capacity) merge is the measured 74% of the
+    # device-backend level step; the device path pays it once per
+    # LEVEL, and a whole level is one dispatched while_loop program).
+    # Best-of-3 alternating, same throttled-venue practice as above.
+    # chunk_size 4096 (= the compact gate) keeps per-chunk device
+    # memory bounded and gives multi-chunk levels — the shape the
+    # chunk-loop collapse targets.
+    dv_kwargs = dict(
+        store_trace=False,
+        min_bucket=4096,
+        chunk_size=4096,
+        visited_backend="device",
+        visited_capacity_hint=800_000,
+        stats_path=os.devnull,
+    )
+    dv_w, df_w = [], []
+    dv_stats = df_stats = None
+    for m_, p_ in ((model, "device"), (model, "fused")):
+        check(m_, pipeline=p_, max_states=60_000, **dv_kwargs)  # warm
+    for _ in range(3):
+        for p_ in ("device", "fused"):
+            r = check(model, pipeline=p_, **dv_kwargs)
+            assert r.ok and r.total == 737_794, (p_, r.total)
+            if p_ == "device":
+                dv_w.append(r.seconds)
+                dv_stats = r.stats
+            else:
+                df_w.append(r.seconds)
+                df_stats = r.stats
+    assert dv_stats["device"]["levels"] > 0, dv_stats["device"]
+
+    def _launch_rec(stats):
+        lv = stats["levels"]
+        return {
+            "per_level_max": max(l["successor_launches"] for l in lv),
+            "per_level_mean": round(
+                sum(l["successor_launches"] for l in lv) / len(lv), 2
+            ),
+        }
+
+    device_rec = {
+        "config": "sorted-set device visited backend, chunk 4096 "
+        "(multi-chunk levels; the per-chunk-merge-bound workload)",
+        "device_sps": round(
+            737_794 / min(dv_w), 1
+        ),
+        "fused_sps": round(737_794 / min(df_w), 1),
+        "device_walls_s": [round(s, 2) for s in dv_w],
+        "fused_walls_s": [round(s, 2) for s in df_w],
+        "device_vs_fused": round(min(df_w) / min(dv_w), 3),
+        "target": 2.0,
+        "launches_per_level": {
+            "device": _launch_rec(dv_stats),
+            "fused": _launch_rec(df_stats),
+        },
+        "device_levels": dv_stats["device"]["levels"],
+        "device_fallback": dv_stats["device"]["fallback"],
+        # venue honesty: on this 1-core CPU container the win is the
+        # per-level (vs per-chunk) visited merge + the removed per-chunk
+        # host round trips; on a real accelerator the removed launch
+        # round trips (2/chunk -> <=2/level) are the additional lever
+        # this venue cannot price.  Same box, same config, alternating
+        # runs — the ratio is the venue-independent signal.
+        "venue": {"cores": os.cpu_count()},
+    }
+
     # Exchange compression on the 8-device CI mesh (ROADMAP item 5's
     # measure): run in a sub-child — the virtual 8-device platform must
     # be configured before jax initializes, which this process already
@@ -329,9 +398,20 @@ def _child_main():
                 "hand_sps": round(hres.states_per_sec, 1),
                 "integrity": integrity_rec,
                 "overlap": overlap_rec,
+                "device_resident": device_rec,
                 "exchange": exchange_rec,
             }
         )
+    )
+    print(
+        f"# device-resident pipeline (sorted-set device backend, "
+        f"chunk 4096): device {device_rec['device_sps']:,.0f} vs fused "
+        f"{device_rec['fused_sps']:,.0f} states/sec = "
+        f"{device_rec['device_vs_fused']}x (target >=2x); launches/"
+        f"level max {device_rec['launches_per_level']['device']['per_level_max']}"
+        f" device vs {device_rec['launches_per_level']['fused']['per_level_max']}"
+        f" fused",
+        file=sys.stderr,
     )
     print(
         f"# overlap (forced-spill + ckpt cadence): on "

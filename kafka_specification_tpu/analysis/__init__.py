@@ -196,7 +196,18 @@ OWNERSHIP_MODULES = (
 PURITY_MODULES = (
     "kafka_specification_tpu/engine/pipeline.py",
     "kafka_specification_tpu/parallel/sharded.py",
+    # the device-resident level pipeline's in-jit helpers: a host-side
+    # np.*/.item() call inside the while_loop body must fail CI
+    "kafka_specification_tpu/ops/devlevel.py",
 )
+
+
+def field_hulls(model, strict: bool = False) -> dict:
+    """Stable per-field reachable-value hull export (lazy import; see
+    analysis/encoding.py:field_hulls for the soundness contract)."""
+    from .encoding import field_hulls as _fh
+
+    return _fh(model, strict=strict)
 
 
 def repo_root() -> str:

@@ -277,6 +277,134 @@ def analyze_actions(model) -> list:
     return findings
 
 
+def field_hulls(model, strict: bool = False) -> dict:
+    """Per-field reachable-value interval hulls: {name: (lo, hi)}.
+
+    The hull of field ``f`` joins (1) the model's concrete init values
+    and (2) every possibly-enabled (action, choice) write interval the
+    encoding pass already computes (analysis/interval.py) — a SOUND
+    over-approximation of every value the checker can ever pack, and the
+    stable export the device-resident pipeline sizes its in-jit pack
+    stage from (docs/engine.md): a hull inside the declared ``[lo, hi]``
+    proves the pack stage cannot truncate even though no host-side
+    validation runs between the while-loop's chunks.
+
+    Honesty contract: a kernel outside the abstract domain (the emitted
+    models' evaluator closures) makes its writes unknowable — with
+    ``strict=True`` that raises :class:`AnalysisUnsupported` (the device
+    pipeline's fallback trigger); otherwise the affected fields widen to
+    their DECLARED ranges (still sound *if* the encoding gate holds,
+    stated as such, never a guessed tight hull).  Hulls are NOT clipped
+    to the declared ranges: with the build gate disabled
+    (KSPEC_ANALYZE=0) a write can escape them, and a consumer comparing
+    hull vs declared is exactly how that escape is caught.
+
+    Memoized on the model object (abstract runs cost milliseconds but
+    engines construct pipelines per check() call) — strict and
+    non-strict results cache separately (a strict failure is cached as
+    the exception to re-raise).
+    """
+    if strict:
+        cached = getattr(model, "_field_hulls_strict", None)
+        if isinstance(cached, AnalysisUnsupported):
+            raise cached
+        if cached is not None:
+            return dict(cached)
+    else:
+        cached = getattr(model, "_field_hulls", None)
+        if cached is not None:
+            return dict(cached)
+    fields = model.spec.fields
+    by_name = {f.name: f for f in fields}
+    hulls: dict = {}
+
+    def widen(name, lo, hi):
+        cur = hulls.get(name)
+        hulls[name] = (
+            (min(cur[0], lo), max(cur[1], hi)) if cur else (lo, hi)
+        )
+
+    # (1) init values: unwritten fields stay at them forever
+    try:
+        inits = model.init_states()
+    except Exception as e:  # noqa: BLE001 — exotic init builders
+        if strict:
+            exc = AnalysisUnsupported(f"init states not enumerable: {e}")
+            try:  # same cached-exception contract as the action path
+                model._field_hulls_strict = exc
+            except AttributeError:
+                pass
+            raise exc
+        inits = None
+    if inits is None:
+        for f in fields:
+            widen(f.name, f.lo, f.hi)
+    else:
+        for s in inits:
+            for f in fields:
+                v = np.asarray(s[f.name])
+                widen(f.name, int(np.min(v)), int(np.max(v)))
+
+    # (2) every possibly-enabled write interval
+    for a in model.actions:
+        skipped = False
+        for c in range(a.n_choices):
+            try:
+                r = analyze_action_choice(a.kernel, fields, c)
+            except AnalysisUnsupported:
+                skipped = True
+                break
+            if definitely_disabled(r["enabled"]):
+                continue
+            for f in fields:
+                nv = r["next"].get(f.name)
+                if nv is None or nv is r["base"][f.name]:
+                    continue
+                nv = IVal.coerce(nv)
+                widen(f.name, int(np.min(nv.lo)), int(np.max(nv.hi)))
+        if skipped:
+            if strict:
+                exc = AnalysisUnsupported(
+                    f"action {a.name!r} outside the interval domain — "
+                    f"no proven hull"
+                )
+                try:
+                    model._field_hulls_strict = exc
+                except AttributeError:
+                    pass
+                raise exc
+            # unknown writes: widen the declared write set (or, with no
+            # declaration, every field) to its declared range
+            names = (
+                a.writes if getattr(a, "writes", None) is not None
+                else by_name
+            )
+            for n in names:
+                f = by_name.get(n)
+                if f is not None:
+                    widen(f.name, f.lo, f.hi)
+    try:
+        if strict:
+            model._field_hulls_strict = dict(hulls)
+        else:
+            model._field_hulls = dict(hulls)
+    except AttributeError:
+        pass
+    return hulls
+
+
+def hull_pack_widths(hulls: dict) -> dict:
+    """{field: bits} a pack stage would need for the hull spans — the
+    quantity tests pin against ``ops/packing.Field.width`` (a sound
+    hull can never need MORE bits than the declared range provides)."""
+    import math
+
+    return {
+        name: max(1, math.ceil(math.log2(hi - lo + 1)))
+        for name, (lo, hi) in hulls.items()
+    }
+
+
 def apply_suppressions(findings, model) -> list:
     """Downgrade findings matching ``meta['analysis_suppress']`` entries
     to INFO, carrying the justification (docs/analysis.md)."""

@@ -51,7 +51,11 @@ from ..resilience.resources import (
     is_disk_full,
 )
 from ..resilience.retry import ChunkRetryHandler
-from .pipeline import key_vcap as _key_vcap, make_pipeline, resolve_pipeline
+from .pipeline import (
+    grow_visited as _grow_visited,
+    make_pipeline,
+    resolve_pipeline,
+)
 
 # insert-or-find on the device hash table; table + claim lattice donated so
 # XLA updates them in place instead of copying O(capacity) per chunk
@@ -1038,19 +1042,33 @@ def check(
     double the width (the step reports overflow; results stay exact).  0
     disables compaction.
 
-    pipeline: level-pipeline implementation (engine/pipeline.py):
+    pipeline: level-pipeline implementation (engine/pipeline.py; the
+    jax-free registry in pipeline_registry.py is the validated name
+    set — unknown names raise, `cli pipelines --list` describes them):
     "fused" (default; $KSPEC_PIPELINE overrides) = successor mega-kernels
     — per chunk, ONE batched guard-predicate-matrix launch over the
     (frontier x choice) lattice, C-speed host compaction into one shared
     data-driven-width buffer, and ONE update-skeleton launch
     (gather -> action update -> CONSTRAINT -> pack -> fingerprint), i.e.
     2 successor launches per chunk instead of one per action;
-    "legacy" = the historical per-action monolithic step.  Both are
+    "device" = the device-resident level pipeline — a bounded
+    lax.while_loop processes EVERY gated chunk of a level inside one
+    dispatched program (expansion, in-jit segmented compaction,
+    fingerprints, dedup against the device-resident visited set,
+    verdicts and the per-level digest folds all on-device; the
+    O(capacity) visited merge runs once per LEVEL instead of once per
+    chunk), i.e. <=2 successor launches per level; requires the
+    sorted-set "device" visited backend and analyzer-proven per-field
+    value hulls (analysis.field_hulls — a hard precondition, not
+    env-disablable like the build gate) and otherwise degrades to the
+    fused per-chunk ladder (stats["device"]["fallback"] records why);
+    "legacy" = the historical per-action monolithic step.  All are
     bit-identical — same level counts, duplicate accounting,
-    first-violation rule and trace values (tests/test_pipeline.py); a
-    fused program that fails to compile degrades the run to legacy
-    (recorded in stats["degradations"] and stats["pipeline_fallback"]).
-    compact_gate: frontier-bucket floor below which both pipelines run
+    first-violation rule, trace values and digest chains
+    (tests/test_pipeline.py, tests/test_integrity.py); a fused program
+    that fails to compile degrades the run to legacy (recorded in
+    stats["degradations"] and stats["pipeline_fallback"]).
+    compact_gate: frontier-bucket floor below which every pipeline runs
     the uncompacted full-lattice path (small levels; default 4096).
 
     checkpoint_dir: when set, the (visited set, frontier, level counters) are
@@ -1809,9 +1827,10 @@ def check(
         chunk = max(chunk_floor, chunk >> 1)
 
     # The level-pipeline: per-chunk expand/squeeze/fingerprint (+ the
-    # device backend's in-jit dedup) behind one interface — the fused
-    # 2-launch mega-kernel path or the legacy per-action path
-    # (engine/pipeline.py; both bit-identical)
+    # device backend's in-jit dedup) behind one interface — the
+    # device-resident whole-level program, the fused 2-launch
+    # mega-kernel path or the legacy per-action path
+    # (engine/pipeline.py; all bit-identical)
     pipe = make_pipeline(
         resolve_pipeline(pipeline),
         step_builder=step_builder,
@@ -1824,7 +1843,18 @@ def check(
         on_degrade_chunk=_degrade_chunk,
         compact_shift=compact_shift,
         compact_gate=compact_gate,
+        check_deadlock=check_deadlock,
     )
+    if getattr(pipe, "name", "") == "device" and shadow_rate > 0 and \
+            pipe.device_fallback is None:
+        # shadow re-execution replays single chunks from their pre-chunk
+        # visited state — a state the whole-level program never
+        # materializes.  The documented ladder: shadowed runs take the
+        # fused per-chunk path (docs/engine.md § Device-resident level
+        # pipeline)
+        pipe.device_fallback = (
+            "integrity shadow re-execution needs per-chunk replay"
+        )
 
     def _shadow_exec(piece, fp_n, bucket, start, pre_v, cvcap,
                      out, out_hi, out_lo, nn, viol_any, dl_any):
@@ -1858,15 +1888,19 @@ def check(
                 f" != emitted {int(main_fps[bad]):#x}",
                 depth=depth,
             )
+        # the device pipeline delegates shadowed runs to its fused
+        # per-chunk ladder, so the cross-exec gate reads the FUSED
+        # implementation either way
+        fp = getattr(pipe, "fused", pipe)
         if (
-            getattr(pipe, "name", "") == "fused"
-            and not getattr(pipe, "fallback", False)
-            and pipe._gate(bucket)
+            getattr(fp, "name", "") == "fused"
+            and not getattr(fp, "fallback", False)
+            and fp._gate(bucket)
         ):
             mode = "legacy-cross"
             (l_out, _lp, _la, l_new, _h1, _h2, _h3, l_viol, _vi,
              l_dl, _di, _ae, l_hi, l_lo, _ag, _launch) = (
-                pipe.legacy.run_chunk(
+                fp.legacy.run_chunk(
                     piece, fp_n, bucket, depth, *pre_v, cvcap
                 )
             )
@@ -2165,6 +2199,66 @@ def check(
 
         return False
 
+    def _commit_device_level(fin, dispatch_s: float, plan) -> bool:
+        """Commit a whole device-resident level (DevicePipeline.run_level):
+        block on the level program's outputs, apply the serial commit
+        loop's verdict rule, then the host bookkeeping — trace
+        accumulation and the digest-chain fold from the DEVICE-computed
+        (count, xor, sum) accumulator (bit-exact with the per-chunk host
+        folds; ops/devlevel.py).  Returns True when a verdict fired (the
+        level's tail chunks are never dispatched — the serial break)."""
+        nonlocal verdict, lvl_new, prof_step, prof_host_s
+        nonlocal lvl_launches, lvl_launches_max, run_launches_max
+        nonlocal lvl_act_en
+        t_wait = time.perf_counter()
+        out = fin()
+        wait_s = time.perf_counter() - t_wait
+        step_s = dispatch_s + wait_s
+        prof_step += step_s
+        launches = out["launches"]
+        lvl_launches += launches
+        lvl_launches_max = max(lvl_launches_max, launches)
+        run_launches_max = max(run_launches_max, launches)
+        # attribution: run_level BLOCKS on the level program (its
+        # overflow-flag read is the one device sync per level), so the
+        # whole blocked wall is device-wait — there is no in-flight
+        # dispatch window like the per-chunk staged contract has
+        obs_.chunk_span(
+            "step", step_s, depth=depth, start=0, rows=plan[2],
+            bucket=plan[0], launches=launches, chunks=plan[1],
+            pipeline="device",
+            dispatch_ms=0.0,
+            wait_ms=round(step_s * 1e3, 2), queued_ms=0.0,
+        )
+        if out["verdict"] is not None:
+            kind, idx, inv_i = out["verdict"]
+            verdict = (
+                kind,
+                idx,
+                model.invariants[inv_i].name
+                if kind == "invariant"
+                else "Deadlock",
+            )
+            return True
+        t_host = time.perf_counter()
+        nn = out["new_n"]
+        if nn:
+            lvl_rows.append(out["rows"])
+            lvl_parent.append(out["parent"])
+            lvl_act.append(out["act"])
+            lvl_new += nn
+            if chain is not None:
+                chain.fold_digest(*out["digest"])
+        host_s = time.perf_counter() - t_host
+        prof_host_s += host_s
+        obs_.chunk_span(
+            "host-assembly", host_s, depth=depth, start=0, new=nn,
+            backend=visited_backend,
+        )
+        if collect_stats:
+            lvl_act_en += out["act_en"]
+        return False
+
     # storage read-side corruption (read-verified CRCs on spill runs /
     # frontier segments / parent-log levels) surfaces as these typed
     # exceptions mid-run — all integrity violations, exit 76
@@ -2277,7 +2371,41 @@ def check(
             # this same code with overlap_on False (dispatch followed by
             # an immediate commit).
             staged = None
+            # Device-resident level path (DevicePipeline, engine/
+            # pipeline.py): ONE dispatched while_loop program runs every
+            # gated chunk of this level — expansion, in-jit compaction,
+            # fingerprints, dedup, verdicts and digest folds all
+            # on-device, the visited merge once per level — <=2
+            # successor launches per LEVEL.  A sub-gate tail chunk (only
+            # ever the last, partial one) falls through to the per-chunk
+            # loop below at its serial offset, preserving the legacy
+            # full-lattice candidate order below the gate
+            # (bit-identity).  A verdict inside the device span, like
+            # the serial break, leaves the tail undispatched.
+            dev_handled = 0
+            dev_plan = (
+                pipe.plan_level(f_total, chunk, min_bucket)
+                if getattr(pipe, "name", "") == "device"
+                and isinstance(frontier_np, np.ndarray)
+                else None
+            )
+            if dev_plan is not None:
+                governor.poll(depth)
+                t_attempt = time.perf_counter()
+                dres = pipe.run_level(
+                    frontier_np, f_total, depth, vhi, vlo, vn, vcap,
+                    dev_plan,
+                )
+                if dres is not None:
+                    vhi, vlo, vn, vcap, dev_fin = dres
+                    dispatch_s = time.perf_counter() - t_attempt
+                    dev_handled = dev_plan[2]
+                    if _commit_device_level(dev_fin, dispatch_s,
+                                            dev_plan):
+                        dev_handled = f_total  # verdict: skip the tail
             for start, piece in _f_chunks(frontier_np, chunk):
+                if start < dev_handled:
+                    continue  # committed by the device-resident span
                 governor.poll(depth)  # deadline watchdog (cheap)
                 fp_n = piece.shape[0]
                 bucket = _next_pow2(max(fp_n, min_bucket))
@@ -2285,19 +2413,14 @@ def check(
                 if visited_backend == "device":
                     need = int(vn) + M
                     if need > vcap:
-                        new_cap = _next_pow2(need)
-                        pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
-                        vhi = jnp.concatenate([vhi, pad])
-                        vlo = jnp.concatenate([vlo, pad])
-                        # growth is monotonic: steps compiled for the outgrown
-                        # capacity are dead weight in the Model-lifetime cache
-                        # (each is a full compiled program) — evict them
-                        for k in [
-                            k for k in step_builder._cache
-                            if _key_vcap(k) == vcap
-                        ]:
-                            del step_builder._cache[k]
-                        vcap = new_cap
+                        # one shared growth policy with the device level
+                        # path (pipeline.grow_visited); growth is
+                        # monotonic, so the outgrown capacity's compiled
+                        # steps are evicted immediately here
+                        vhi, vlo, vcap = _grow_visited(
+                            vhi, vlo, vcap, need,
+                            cache=step_builder._cache,
+                        )
                 elif ht_hi is not None and 2 * hash_n > ht_hi.shape[0]:
                     # keep load factor under ~1/2 so linear probing stays short
                     ht_hi, ht_lo = hashset.rehash_into(
@@ -2435,6 +2558,12 @@ def check(
                         "successor_launches": lvl_launches,
                         "launches_per_chunk_max": lvl_launches_max,
                     }
+                )
+                # launches/level gauge (obs): the device pipeline's
+                # acceptance signal — <=2 steady-state on the
+                # device-resident path, O(chunks)x2 on fused
+                _met.set_gauge(
+                    "kspec_successor_launches_level", lvl_launches
                 )
             if collect_levels is not None and new_n:
                 collect_levels.append(_f_all(next_frontier))
@@ -2578,6 +2707,19 @@ def check(
             # only the observed per-chunk maximum is honest here
             "launches_per_chunk_max": run_launches_max,
             "adaptive_active": adapt.active,
+            # device-resident level pipeline accounting (DevicePipeline):
+            # how many levels ran as single dispatched programs, and why
+            # (if ever) the run left the device path for the fused ladder
+            **(
+                {
+                    "device": {
+                        "levels": pipe.device_levels,
+                        "fallback": pipe.device_fallback,
+                    }
+                }
+                if getattr(pipe, "name", "") == "device"
+                else {}
+            ),
             "adaptive_compile_fallback": bool(
                 getattr(pipe, "legacy", pipe).compile_fallback
             ),

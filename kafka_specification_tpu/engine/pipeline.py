@@ -63,55 +63,105 @@ pipeline-agnostic.  Two implementations ship:
   implementation verbatim, so the whole run stays bit-identical at every
   bucket.  tests/test_pipeline.py pins this across the model matrix.
 
+A third implementation collapses the chunk loop itself into the
+accelerator:
+
+``device`` — the device-resident level pipeline: for the sorted-set
+  device visited backend, a bounded ``lax.while_loop`` processes EVERY
+  gated chunk of a level inside ONE dispatched program — guard-matrix
+  expansion, in-jit segmented compaction (the per-action cumsum/scatter
+  the fused path had moved to the host), fingerprints, dedup against
+  the device-resident visited set, invariant/deadlock verdicts, the
+  PR 9 (count, xor, sum) digest folds (ops/devlevel.py), and next-
+  frontier assembly, with the O(capacity) visited merge deferred to
+  ONE rank-scatter per level instead of one per chunk (novelty inside
+  the level is decided against a separate device-resident level-new
+  sorted set, whose content equals exactly the states the serial path
+  would have merged chunk-by-chunk).  A level costs <=2 successor
+  launches TOTAL — one steady-state, two when a segment-width overflow
+  forces a re-dispatch at exact measured widths — instead of the fused
+  path's 2 per chunk.  Bit-identity with ``legacy`` holds chunk for
+  chunk (same candidate order, same stable-sort winners, same verdict
+  priority, same digest multisets; docs/engine.md § Device-resident
+  level pipeline states the argument), and anything the device program
+  cannot serve — host/hash visited backends, disk tier, sub-gate
+  chunks, shadow re-execution, kernels without analyzer-proven field
+  hulls (analysis.field_hulls), compile failure — degrades to ``fused``
+  via the documented ladder (device -> fused -> legacy).
+
 Plugging a new stage implementation: subclass (or parallel-implement)
 a pipeline with the same ``run_chunk`` contract and register it in
-:data:`PIPELINES`; the stage helpers in this module (``squeeze_stage``,
-``fp_stage``, ``sorted_dedup_stage``, ``invariant_stage``) are the
-building blocks both implementations compose, and docs/engine.md walks
-through the interface.
+:data:`PIPELINES` (kafka_specification_tpu/pipeline_registry.py — the
+jax-free registry the CLI validates against); the stage helpers in this
+module (``squeeze_stage``, ``fp_stage``, ``sorted_dedup_stage``,
+``invariant_stage``) are the building blocks the implementations
+compose, and docs/engine.md walks through the interface.
 """
 
 from __future__ import annotations
 
-import os
-import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import dedup
+from ..ops import dedup, devlevel
 from ..ops.fingerprint import fingerprint_lanes
+from ..pipeline_registry import (  # noqa: F401 — re-exported API
+    PIPELINE_ENV,
+    PIPELINE_REGISTRY,
+    pipeline_names,
+    resolve_pipeline,
+)
 
-PIPELINE_ENV = "KSPEC_PIPELINE"
-#: registered pipeline names (resolve_pipeline validates against this)
-PIPELINES = ("fused", "legacy")
-
-
-def resolve_pipeline(name: Optional[str]) -> str:
-    """CLI/env resolution: explicit arg > $KSPEC_PIPELINE > 'fused'."""
-    n = name or os.environ.get(PIPELINE_ENV) or "fused"
-    if n not in PIPELINES:
-        raise ValueError(
-            f"unknown pipeline {n!r} (expected one of {PIPELINES})"
-        )
-    return n
+#: registered pipeline names (resolve_pipeline validates against the
+#: jax-free registry; kept as a tuple for the pre-registry callers)
+PIPELINES = pipeline_names()
 
 
 def key_vcap(key: tuple) -> Optional[int]:
     """The visited-capacity component of a step-cache key, or None for
     programs that don't embed the visited set (guard kernels).  Key
-    shapes (engine.bfs._Step.get / FusedPipeline):
+    shapes (engine.bfs._Step.get / FusedPipeline / DevicePipeline):
 
       ("step", bucket, vcap, inv_sig, with_merge, compact, sq_full, pallas)
       ("fgd",  bucket, inv_sig)                     — fused launch 1
       ("fsc",  bucket, vcap, widths, with_merge, device_out, pallas)
+      ("dvl",  bucket, vcap, ncp, widths, ln, inv_sig, deadlock, pallas)
     """
     tag = key[0]
-    if tag in ("step", "fsc"):
+    if tag in ("step", "fsc", "dvl"):
         return key[2]
     return None
+
+
+def evict_vcap(cache: dict, vcap: int) -> None:
+    """Drop every step program compiled at an outgrown visited capacity
+    — each is a full compiled program, dead weight in the
+    Model-lifetime cache once growth is monotonic past it."""
+    for k in [k for k in cache if key_vcap(k) == vcap]:
+        del cache[k]
+
+
+def grow_visited(vhi, vlo, vcap: int, need: int, cache: Optional[dict]
+                 = None):
+    """Grow the sorted visited pair set to the next power of two >=
+    `need` (sentinel-padded) — the ONE growth policy for the per-chunk
+    loop (engine/bfs.py) and the device level path.  When `cache` is
+    given the outgrown capacity's programs are evicted immediately;
+    pass None to defer eviction (the device path evicts only after a
+    successful dispatch, so a growth followed by a compile failure
+    leaves the per-chunk fallback's programs warm)."""
+    from .bfs import _next_pow2
+
+    new_cap = _next_pow2(need)
+    pad = jnp.full(new_cap - vcap, 0xFFFFFFFF, jnp.uint32)
+    vhi = jnp.concatenate([vhi, pad])
+    vlo = jnp.concatenate([vlo, pad])
+    if cache is not None:
+        evict_vcap(cache, vcap)
+    return vhi, vlo, new_cap
 
 
 # --------------------------------------------------------------------------
@@ -167,13 +217,24 @@ def invariant_stage(model, states, fvalid, with_invariants: bool):  # kspec: tra
 
 
 def sorted_dedup_stage(cand, parent, actid, valid, hi, lo,  # kspec: traced
-                       vhi, vlo, vn, vcap, T, K, with_merge: bool):
+                       vhi, vlo, vn, vcap, T, K, with_merge: bool,
+                       also_seen_in=None):
     """Stage 4 (device backend): minimal-payload lexsort, first-occurrence
     + visited-rank dedup, compaction of the new states to the front, and
     (with_merge) the rank-scatter merge into the sorted visited set.
     Identical primitive sequence to the legacy in-step version — winners
     are decided by the stable sort over the same candidate order, which
-    is what keeps the two pipelines trace-bit-identical."""
+    is what keeps the pipelines trace-bit-identical; this helper is the
+    ONE source of that winner-selection sequence (the fused update
+    skeleton and the device level program both compose it).
+
+    also_seen_in: optional second sorted pair set (hi, lo, n) that also
+    disqualifies candidates from being new — the device pipeline probes
+    its read-only visited set here while (vhi, vlo, vn) is the
+    device-resident level-new set the compacted rank indexes into.  The
+    trailing out_rank return (insertion ranks of the compacted prefix in
+    the PRIMARY set) lets with_merge=False callers run their own gated
+    merge_ranked."""
     sent = jnp.uint32(dedup.SENT)
     order = jnp.lexsort((lo, hi))
     hi_s, lo_s = hi[order], lo[order]
@@ -181,6 +242,10 @@ def sorted_dedup_stage(cand, parent, actid, valid, hi, lo,  # kspec: traced
     first = dedup.first_occurrence_mask(hi_s, lo_s, invalid_s)
     seen, rank = dedup.rank_sorted(vhi, vlo, vn, hi_s, lo_s)
     is_new = first & ~seen
+    if also_seen_in is not None:
+        a_hi, a_lo, a_n = also_seen_in
+        a_seen, _ar = dedup.rank_sorted(a_hi, a_lo, a_n, hi_s, lo_s)
+        is_new = is_new & ~a_seen
     pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, T)
     out = jnp.zeros((T, K), jnp.uint32).at[pos].set(cand[order])
     out_parent = jnp.full((T,), -1, jnp.int32).at[pos].set(parent[order])
@@ -193,7 +258,8 @@ def sorted_dedup_stage(cand, parent, actid, valid, hi, lo,  # kspec: traced
         vhi, vlo, vn = dedup.merge_ranked(
             vhi, vlo, vn, out_hi, out_lo, out_rank, new_n, vcap
         )
-    return out, out_parent, out_act, new_n, out_hi, out_lo, vhi, vlo, vn
+    return (out, out_parent, out_act, new_n, out_hi, out_lo,
+            vhi, vlo, vn, out_rank)
 
 
 # --------------------------------------------------------------------------
@@ -543,7 +609,7 @@ class FusedPipeline:
             hi, lo = fp_stage(out, rowvalid2, spec, use_pallas)
             if with_merge:
                 (out, out_parent, out_act, new_n, out_hi, out_lo,
-                 vhi, vlo, vn) = sorted_dedup_stage(
+                 vhi, vlo, vn, _rank) = sorted_dedup_stage(
                     out, out_parent, out_act, rowvalid2, hi, lo,
                     vhi, vlo, vn, vcap, W, K, with_merge,
                 )
@@ -742,14 +808,467 @@ class FusedPipeline:
         )
 
 
+# --------------------------------------------------------------------------
+# device pipeline: the whole level as one dispatched program
+# --------------------------------------------------------------------------
+
+
+class DevicePipeline:
+    """Device-resident level pipeline (module docstring): one dispatched
+    ``lax.while_loop`` program runs every gated chunk of a BFS level —
+    <=2 successor launches per LEVEL — with the visited-set merge
+    deferred to one rank-scatter per level.  Requires the sorted-set
+    ``device`` visited backend and analyzer-proven per-field value hulls
+    (analysis.field_hulls: the in-jit pack stage runs with no host-side
+    validation between chunks, so the no-truncation proof is a hard
+    precondition here, independent of the KSPEC_ANALYZE build-gate
+    toggle); everything else — and any compile/dispatch failure —
+    degrades to the ``fused`` per-chunk path, which itself degrades to
+    ``legacy`` (the documented ladder)."""
+
+    name = "device"
+    launches_per_chunk = 2  # nominal figure when delegating per-chunk
+
+    def __init__(self, step_builder, model, adapt, chunk_retry, fault,
+                 check_invariants: bool, visited_backend: str,
+                 on_degrade_chunk, compact_shift: int, compact_gate: int,
+                 check_deadlock: bool = False):
+        self.step = step_builder
+        self.model = model
+        self.spec = model.spec
+        self.chunk_retry = chunk_retry
+        self.fault = fault
+        self.check_invariants = check_invariants
+        self.check_deadlock = check_deadlock
+        self.visited_backend = visited_backend
+        self.fused = FusedPipeline(
+            step_builder, model, adapt, chunk_retry, fault,
+            check_invariants, visited_backend, on_degrade_chunk,
+            compact_shift, compact_gate,
+        )
+        self.pool = PooledWidths(model.actions)
+        self._ln_hw = 0  # per-level new-state high water (LN ladder)
+        #: sticky fallback reason; None while the level path is live
+        self.device_fallback: Optional[str] = None
+        self.device_levels = 0  # levels actually run device-resident
+        if visited_backend != "device":
+            self.device_fallback = (
+                f"visited backend {visited_backend!r} is not the "
+                f"device-resident sorted set"
+            )
+        else:
+            self._check_hulls()
+
+    def _check_hulls(self) -> None:
+        """The field-hull precondition: every field's proven reachable-
+        value hull must sit inside its declared packed range.  This is
+        stricter than the engine's KSPEC_ANALYZE gate on purpose — the
+        gate can be env-disabled, this cannot: a device-resident level
+        has no host visibility between chunks, so the pack stage's
+        no-truncation property must be PROVEN, not assumed."""
+        from ..analysis.interval import AnalysisUnsupported
+
+        try:
+            from ..analysis import field_hulls
+
+            hulls = field_hulls(self.model, strict=True)
+        except AnalysisUnsupported as e:
+            self.device_fallback = f"no proven field hulls ({e})"
+            return
+        except Exception as e:  # noqa: BLE001 — never break checking
+            self.device_fallback = (
+                f"field-hull analysis failed "
+                f"({type(e).__name__}: {e})"[:200]
+            )
+            return
+        bad = [
+            f.name
+            for f in self.spec.fields
+            if hulls[f.name][0] < f.lo or hulls[f.name][1] > f.hi
+        ]
+        if bad:
+            self.device_fallback = (
+                f"field hull escapes the declared packed range for "
+                f"{bad} (encoding-unsound model; KSPEC_ANALYZE=0?)"
+            )
+
+    # --- per-chunk interface: delegate to the fused ladder ----------------
+    @property
+    def fallback(self) -> bool:
+        """fused->legacy degradation flag (stats['pipeline_fallback']
+        keeps its historical meaning; the device->fused step is
+        reported separately via device_fallback)."""
+        return self.fused.fallback
+
+    @property
+    def legacy(self):
+        return self.fused.legacy
+
+    def _gate(self, bucket: int) -> bool:
+        return self.fused._gate(bucket)
+
+    def run_chunk(self, piece, fp_n, bucket, depth, vhi, vlo, vn, vcap):
+        return self.fused.run_chunk(
+            piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
+        )
+
+    def run_chunk_staged(self, piece, fp_n, bucket, depth,
+                         vhi, vlo, vn, vcap):
+        return self.fused.run_chunk_staged(
+            piece, fp_n, bucket, depth, vhi, vlo, vn, vcap
+        )
+
+    # --- the whole-level path ---------------------------------------------
+    def plan_level(self, f_total: int, chunk: int, min_bucket: int):
+        """-> (bucket, n_chunks, rows_handled) when the device program
+        can serve (a prefix of) this level, else None.
+
+        The plan mirrors the serial chunking EXACTLY: full chunks run at
+        bucket == chunk; a trailing partial chunk joins the dispatch iff
+        the serial loop would have taken the compacted (gated) path for
+        it — a sub-gate tail instead runs through the per-chunk ladder
+        after the device dispatch, preserving the legacy full-lattice
+        candidate order the gate exists to protect (bit-identity)."""
+        from .bfs import _next_pow2
+
+        if self.device_fallback is not None or self.fused.fallback:
+            return None
+        if f_total <= 0:
+            return None
+        if f_total <= chunk:
+            B = _next_pow2(max(f_total, min_bucket))
+            return (B, 1, f_total) if self.fused._gate(B) else None
+        if not self.fused._gate(chunk):
+            return None
+        n_full, rem = divmod(f_total, chunk)
+        nc, handled = n_full, n_full * chunk
+        if rem and self.fused._gate(_next_pow2(max(rem, min_bucket))):
+            nc += 1
+            handled = f_total
+        return (chunk, nc, handled)
+
+    def _level_program(self, B: int, NCp: int, vcap: int, widths: tuple,
+                       LN: int):
+        key = ("dvl", B, vcap, NCp, widths, LN,
+               self.step.inv_sig(self.check_invariants),
+               self.check_deadlock, self.step.use_pallas)
+        return self.step.cached(
+            key,
+            lambda: jax.jit(
+                self._build_level(B, NCp, vcap, widths, LN)
+            ),
+            bucket=B, vcap=vcap, chunks=NCp, widths=repr(widths),
+            level_new_cap=LN, program="device-level",
+        )
+
+    def _build_level(self, B: int, NCp: int, vcap: int, widths: tuple,
+                     LN: int):
+        """The whole-level program: while_loop over chunk index.
+
+        Bit-identity argument (vs the serial fused/legacy chunk loop):
+        every chunk runs the SAME compacted expansion (make_expand's
+        per-action in-jit cumsum/scatter — action-major, row-major
+        within an action, the exact candidate order the fused host
+        compaction preserves), the same squeeze/fingerprint/stable-
+        lexsort stages, and novelty against (visited ∪ level-new) ==
+        the serial path's chunk-by-chunk merged visited set; winners of
+        equal fingerprints are decided by the same stable sort over the
+        same candidate order.  Chunks run at the full static bucket
+        with padding rows masked — masked rows enable nothing, so the
+        enabled-pair sequence (and hence every downstream decision) is
+        identical to the serial path's smaller tail bucket.  Verdict
+        priority mirrors the serial commit loop: invariants beat
+        deadlock within a chunk, earlier chunks beat later ones, and a
+        verdict chunk commits nothing.  The visited merge runs ONCE
+        after the loop — set-equal to the serial per-chunk merges
+        because levels are disjoint from the visited set by
+        construction."""
+        model, spec = self.model, self.spec
+        K = spec.num_lanes
+        T = self.step.expand_width(B, widths)
+        # LN: the level-new sorted set's capacity — sized by run_level
+        # from a high-water ladder (a level's TOTAL new states, usually
+        # far below the NCp*T worst case) because the per-chunk merge's
+        # cost is O(LN); an overflow re-dispatches once at the safe
+        # bound.  OC: the output row buffer gets one chunk of headroom
+        # past LN so a full-T append at offset <= LN can never hit the
+        # dynamic_update_slice start-index clamp (which would silently
+        # overwrite earlier rows instead of failing).
+        OC = LN + T
+        expand = self.step.make_expand(B, widths)
+        check_invariants = self.check_invariants
+        check_deadlock = self.check_deadlock
+        use_pallas = self.step.use_pallas
+        n_actions = len(model.actions)
+
+        def level(fbuf, f_total, n_chunks, vhi, vlo, vn):  # kspec: traced
+            sent = jnp.uint32(dedup.SENT)
+
+            def body(carry):  # kspec: traced
+                (i, orows, opar, oact, on, lhi, llo, ln,
+                 vkind, vinv, vidx, act_en, agmax, dig, ovf) = carry
+                start = i * B
+                rows = jax.lax.dynamic_slice(fbuf, (start, 0), (B, K))
+                fvalid = (
+                    start + jnp.arange(B, dtype=jnp.int32)
+                ) < f_total
+                states = jax.vmap(spec.unpack)(rows)
+                (en_pre, cand, valid, parent, actid, a_en, a_guard,
+                 exp_ovf) = expand(states, fvalid)
+                deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
+                viol_any, viol_idx = invariant_stage(
+                    model, states, fvalid, check_invariants
+                )
+                (cand, parent, actid, rowvalid, _n_en,
+                 sq_ovf) = squeeze_stage(cand, parent, actid, valid,
+                                         T, K)
+                hi, lo = fp_stage(cand, rowvalid, spec, use_pallas)
+                # the SHARED winner-selection sequence (one source of
+                # truth with the fused/legacy paths): primary set =
+                # level-new (its ranks drive the gated merge below),
+                # also_seen_in = the read-only visited set
+                (n_out, n_par, n_act, new_n, n_hi, n_lo, _l1, _l2,
+                 _l3, n_rank) = sorted_dedup_stage(
+                    cand, parent, actid, rowvalid, hi, lo,
+                    lhi, llo, ln, LN, T, K, False,
+                    also_seen_in=(vhi, vlo, vn),
+                )
+                # verdicts, serial-commit priority
+                inv_any = jnp.any(viol_any)
+                inv_i = jnp.argmax(viol_any).astype(jnp.int32)
+                dl_any = jnp.bool_(check_deadlock) & jnp.any(deadlocked)
+                kind = jnp.where(
+                    inv_any, jnp.int32(1),
+                    jnp.where(dl_any, jnp.int32(2), jnp.int32(0)),
+                )
+                g_idx = jnp.where(
+                    inv_any, viol_idx[inv_i],
+                    jnp.argmax(deadlocked).astype(jnp.int32),
+                ).astype(jnp.int32) + start
+                take = (vkind == 0) & (kind != 0)
+                commit = kind == 0  # a verdict chunk commits nothing
+                # LN overflow: this level's new states outgrew the
+                # ladder-sized level-new set — dropped merge scatters
+                # would corrupt later chunks' novelty, so stop
+                # committing (commit_ok) and flag for the exact-bound
+                # re-dispatch.  Width/squeeze overflows flag the same
+                # way (the whole level re-runs either way).
+                ln_ovf = commit & ((ln + new_n) > LN)
+                commit_ok = commit & ~ovf & ~ln_ovf
+                app_n = jnp.where(commit_ok, new_n, 0)
+                orows = devlevel.append_rows(orows, n_out, on)
+                opar = devlevel.append_vec(opar, n_par + start, on)
+                oact = devlevel.append_vec(oact, n_act, on)
+                lhi, llo, ln = dedup.merge_ranked(
+                    lhi, llo, ln, n_hi, n_lo, n_rank, app_n, LN
+                )
+                dig = devlevel.combine_digest(
+                    dig,
+                    devlevel.masked_digest(
+                        n_hi, n_lo, jnp.arange(T) < app_n
+                    ),
+                )
+                act_en = act_en + jnp.where(commit_ok, a_en, 0)
+                agmax = jnp.maximum(agmax, a_guard)
+                ovf = ovf | jnp.any(exp_ovf) | sq_ovf | ln_ovf
+                return (i + 1, orows, opar, oact, on + app_n,
+                        lhi, llo, ln,
+                        jnp.where(take, kind, vkind),
+                        jnp.where(take, inv_i, vinv),
+                        jnp.where(take, g_idx, vidx),
+                        act_en, agmax, dig, ovf)
+
+            def cond(carry):  # kspec: traced
+                return (carry[0] < n_chunks) & (carry[8] == 0)
+
+            init = (
+                jnp.int32(0),
+                jnp.zeros((OC, K), jnp.uint32),
+                jnp.zeros((OC,), jnp.int32),
+                jnp.zeros((OC,), jnp.int32),
+                jnp.int32(0),
+                jnp.full((LN,), sent),
+                jnp.full((LN,), sent),
+                jnp.int32(0),
+                jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                jnp.zeros((n_actions,), jnp.int32),
+                jnp.zeros((n_actions,), jnp.int32),
+                devlevel.zero_digest(),
+                jnp.bool_(False),
+            )
+            (_i, orows, opar, oact, on, lhi, llo, _ln, vkind, vinv,
+             vidx, act_en, agmax, dig, ovf) = jax.lax.while_loop(
+                cond, body, init
+            )
+            # ONE O(capacity) merge per level (the serial path pays one
+            # per chunk): every level-new entry is disjoint from the
+            # visited set by construction, so the rank-scatter merge of
+            # the sorted level-new prefix lands the identical sorted
+            # visited array
+            _f, rank_v = dedup.rank_sorted(vhi, vlo, vn, lhi, llo)
+            vhi, vlo, vn = dedup.merge_ranked(
+                vhi, vlo, vn, lhi, llo, rank_v, on, vcap
+            )
+            return (orows, opar, oact, on, vhi, vlo, vn, vkind, vinv,
+                    vidx, act_en, agmax, dig, ovf)
+
+        return level
+
+    def run_level(self, frontier_np, f_total: int, depth: int,
+                  vhi, vlo, vn, vcap: int, plan):
+        """Run the whole-level program (with the <=1 exact-width
+        re-dispatch on segment overflow); -> (vhi, vlo, vn, vcap,
+        finalize) or None to fall back to the per-chunk ladder.
+
+        The overflow-flag read is the one device sync per level, so
+        this call BLOCKS until the level program completes (the
+        overlap layer's checkpoint/merge workers are separate threads
+        and keep draining while it runs); finalize() only performs the
+        host-side output conversions.  The engine accounts the whole
+        blocked wall as device-wait on the level's step span — there is
+        no in-flight dispatch window to attribute separately, unlike
+        the per-chunk staged contract."""
+        from .bfs import _next_pow2, _pad_rows
+
+        B, nc, handled = plan
+        NCp = _next_pow2(nc)
+        self.chunk_retry.reset_chunk()
+        n_actions = len(self.model.actions)
+        widths = self.step.norm_widths(
+            B, self.pool.widths_for(B, np.zeros(n_actions), B)
+        )
+        T = self.step.expand_width(B, widths)
+        # level-new capacity ladder: the per-chunk merge costs O(LN), so
+        # size LN from the run's measured per-level new-state high water
+        # (with headroom), NOT the NCp*T worst case — an overflow costs
+        # exactly one re-dispatch at the safe bound, steady state costs
+        # nothing.  This is where the device pipeline's merge win comes
+        # from: the serial path scatters O(visited capacity) per CHUNK,
+        # this path scatters O(level) per chunk and O(capacity) once.
+        LN = min(
+            _next_pow2(max(T, int(1.35 * self._ln_hw) + 1)),
+            _next_pow2(NCp * T),
+        )
+        exact = False  # True after an overflow re-dispatch (safe bounds)
+        dispatched = 0
+        fbuf = None
+        outgrown: list = []  # vcaps outgrown this level; evicted on success
+        pre_v = (vhi, vlo, vn)  # re-dispatch replays from pre-level state
+        while True:
+            try:
+                injected = self.fault.chunk_error(escalated=True)
+                if injected is not None:
+                    raise injected
+                need = int(vn) + min(NCp * T, LN + T)
+                if need > vcap:
+                    # eviction of the outgrown capacity's programs is
+                    # DEFERRED until this level dispatches successfully:
+                    # a growth followed by a device compile failure must
+                    # leave the per-chunk fallback's programs warm
+                    outgrown.append(vcap)
+                    vhi, vlo, vcap = grow_visited(vhi, vlo, vcap, need)
+                    pre_v = (vhi, vlo, vn)
+                if fbuf is None:
+                    # only the handled prefix rides the device buffer: an
+                    # un-gated tail chunk (handled < f_total) runs through
+                    # the per-chunk ladder afterwards, and NCp*B can be
+                    # smaller than the full frontier in that case
+                    fbuf = jnp.asarray(
+                        _pad_rows(frontier_np[:handled], NCp * B)
+                    )
+                fn = self._level_program(B, NCp, vcap, widths, LN)
+                outs = fn(fbuf, jnp.int32(handled), jnp.int32(nc),
+                          *pre_v)
+                dispatched += 1
+                overflow = bool(outs[13])  # forces the level program
+            except Exception as e:  # noqa: BLE001 — XLA compile/run
+                action = self.chunk_retry.handle(
+                    e, escalated=True, depth=depth
+                )
+                if action == "retry":
+                    continue
+                self._mark_fallback(
+                    f"{type(e).__name__}: {e}"[:200], depth
+                )
+                return None
+            agmax_np = np.asarray(outs[11], np.int64)
+            if overflow and int(outs[7]) == 0 and not exact:
+                # a segment (or the level-new set) overflowed: outputs
+                # are incomplete — discard and re-dispatch ONCE from the
+                # pre-level visited state at widths sized from the
+                # measured exact per-level max counts and the safe
+                # level-new bound (neither can overflow again: <=2
+                # launches per level even on growth levels).  A verdict
+                # overrides: it derives from frontier states only, so
+                # it is exact regardless of successor-buffer overflow.
+                widths = self.step.norm_widths(
+                    B,
+                    self.pool.widths_for(
+                        B, agmax_np.astype(np.float64), B
+                    ),
+                )
+                T = self.step.expand_width(B, widths)
+                LN = _next_pow2(NCp * T)
+                exact = True
+                continue
+            break
+        for oc in outgrown:
+            evict_vcap(self.step._cache, oc)
+        # high waters for the next level's sizing
+        np.maximum(
+            self.pool.hw, agmax_np.astype(np.float64) / max(B, 1),
+            out=self.pool.hw,
+        )
+        self._ln_hw = max(self._ln_hw, int(outs[3]))
+        self.device_levels += 1
+        new_vhi, new_vlo, new_vn = outs[4], outs[5], outs[6]
+
+        def finalize(outs=outs, dispatched=dispatched):
+            on = int(outs[3])
+            vk = int(outs[7])
+            verdict = None
+            if vk:
+                verdict = (
+                    "invariant" if vk == 1 else "deadlock",
+                    int(outs[9]),
+                    int(outs[8]),
+                )
+            return dict(
+                rows=np.asarray(outs[0][:on]),
+                parent=np.asarray(outs[1][:on], np.int64),
+                act=np.asarray(outs[2][:on]),
+                new_n=on,
+                verdict=verdict,
+                act_en=np.asarray(outs[10], np.int64),
+                digest=devlevel.digest_ints(outs[12]),
+                launches=dispatched,
+            )
+
+        return new_vhi, new_vlo, new_vn, vcap, finalize
+
+    def _mark_fallback(self, reason: str, depth: int) -> None:
+        self.device_fallback = reason
+        from ..obs import tracer as _obs
+
+        _obs.event("pipeline-fallback", depth=depth, pipeline="device",
+                   to="fused", error=reason)
+
+
 def make_pipeline(name: str, *, step_builder, model, adapt, chunk_retry,
                   fault, check_invariants, visited_backend,
-                  on_degrade_chunk, compact_shift, compact_gate):
+                  on_degrade_chunk, compact_shift, compact_gate,
+                  check_deadlock: bool = False):
     """Pipeline factory (the one interface check() builds against)."""
     if name == "legacy":
         return LegacyPipeline(
             step_builder, model, adapt, chunk_retry, fault,
             check_invariants, visited_backend, on_degrade_chunk,
+        )
+    if name == "device":
+        return DevicePipeline(
+            step_builder, model, adapt, chunk_retry, fault,
+            check_invariants, visited_backend, on_degrade_chunk,
+            compact_shift, compact_gate, check_deadlock=check_deadlock,
         )
     return FusedPipeline(
         step_builder, model, adapt, chunk_retry, fault,
@@ -786,6 +1305,32 @@ def warm_key(step_builder, model, key: tuple, vcap: int):
         jax.block_until_ready(out)
         return ("step", bucket, vcap, inv_sig, with_merge, compact,
                 sq_full, step_builder.use_pallas)
+    if tag == "dvl":
+        (_t, bucket, _vcap, ncp, widths, ln, inv_sig, dl, _pallas) = key
+        if inv_sig and inv_sig != tuple(
+            i.name for i in model.invariants
+        ):
+            return None  # belongs to a sibling invariant overlay
+        pipe = DevicePipeline(
+            step_builder, model, None, None, None,
+            check_invariants=bool(inv_sig),
+            visited_backend="device",
+            on_degrade_chunk=None, compact_shift=2, compact_gate=4096,
+            check_deadlock=dl,
+        )
+        fn = pipe._level_program(bucket, ncp, vcap, widths, ln)
+        K = model.spec.num_lanes
+        out = fn(
+            jnp.zeros((ncp * bucket, K), jnp.uint32),
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.full(vcap, 0xFFFFFFFF, jnp.uint32),
+            jnp.full(vcap, 0xFFFFFFFF, jnp.uint32),
+            jnp.int32(0),
+        )
+        jax.block_until_ready(out)
+        return ("dvl", bucket, vcap, ncp, widths, ln, inv_sig, dl,
+                step_builder.use_pallas)
     if tag == "fsc":
         (_t, bucket, _vcap, widths, with_merge, device_out, _pallas) = key
         pipe = FusedPipeline(

@@ -338,6 +338,7 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
         "resource": resource,
         "integrity": _integrity(data),
         "overlap": _overlap(data),
+        "launches": _launches(data),
     }
 
 
@@ -356,6 +357,30 @@ def _integrity(data: dict) -> dict:
             if e.get("event") == "integrity-violation"
         ],
     }
+
+
+def _launches(data: dict) -> dict:
+    """Launches-per-level beat: the `kspec_successor_launches_level`
+    gauge history (metrics snapshots) + the per-chunk `step` span
+    launch counts.  <=2/level is the device-resident pipeline's launch
+    contract; the fused path shows 2x chunks, legacy O(actions)x chunks
+    — the emitted stats stream stays record-for-record historical, so
+    this beat reads the gauge/span side channels only."""
+    series = []
+    for snap in data.get("metrics_history") or ():
+        v = (snap.get("gauges") or {}).get(
+            "kspec_successor_launches_level"
+        )
+        if v is not None:
+            series.append(v)
+    last = (data.get("metrics") or {}).get("gauges") or {}
+    out = {
+        "series": series,
+        "last": last.get("kspec_successor_launches_level"),
+        "max": max(series) if series else None,
+    }
+    out["present"] = bool(series) or out["last"] is not None
+    return out
 
 
 def _overlap(data: dict) -> dict:
@@ -666,6 +691,14 @@ def render_report(run_dir: str, now: Optional[float] = None,
                 "spill disk or checkpoint cadence is outrunning the "
                 "per-level compute budget."
             )
+    ln = r.get("launches") or {}
+    if ln.get("present"):
+        # launches/level beat: the device-resident pipeline's contract
+        # is <=2 per level; fused shows 2x chunks, legacy O(actions)x
+        bits = [f"successor launches/level last {ln.get('last')}"]
+        if ln.get("series"):
+            bits.append(f"max {ln['max']} " + _spark(ln["series"]))
+        out.append("  launches: " + "  ".join(bits))
     if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
         out.append(f"  died mid-level: level {r['open_level']} began but "
                    f"never completed")

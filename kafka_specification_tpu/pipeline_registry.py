@@ -1,0 +1,84 @@
+"""Level-pipeline registry — the single jax-free source of truth for
+pipeline names the CLI parser, ``cli pipelines --list`` and the engine's
+``resolve_pipeline`` all validate against (the FAULT_REGISTRY pattern,
+resilience/faults.py: one registry, no silently-diverging copies).
+
+``engine/pipeline.py`` imports :data:`PIPELINE_REGISTRY` and re-exports
+``PIPELINES``/``resolve_pipeline`` for its callers; keep this module
+importable WITHOUT jax (the jax-free CLI subcommands and tests list
+pipelines on boxes with no accelerator stack).
+"""
+
+from __future__ import annotations
+
+import os
+
+PIPELINE_ENV = "KSPEC_PIPELINE"
+
+#: name -> registry entry; insertion order is the display order and the
+#: degradation ladder reads right-to-left (device -> fused -> legacy)
+PIPELINE_REGISTRY = {
+    "device": {
+        "launches": "<=2 successor launches per LEVEL",
+        "description": (
+            "device-resident level pipeline: a bounded lax.while_loop "
+            "processes every gated chunk of a BFS level in ONE dispatched "
+            "program — guard-matrix expansion, in-jit segmented "
+            "compaction, fingerprints, dedup against the device-resident "
+            "visited set, invariant/deadlock verdicts and the per-level "
+            "digest folds all fused on-device; the visited merge runs "
+            "once per level instead of once per chunk.  Requires the "
+            "sorted-set device visited backend and analyzer-proven "
+            "per-field value hulls; anything else degrades to 'fused'"
+        ),
+        "fallback": "fused",
+    },
+    "fused": {
+        "launches": "2 successor launches per chunk",
+        "description": (
+            "successor mega-kernels (the default): one batched "
+            "guard-predicate-matrix launch over the (frontier x choice) "
+            "lattice, C-speed host compaction into a shared data-driven-"
+            "width buffer, one update-skeleton launch.  Compile/alloc "
+            "failure degrades the run to 'legacy'"
+        ),
+        "fallback": "legacy",
+    },
+    "legacy": {
+        "launches": "one successor-kernel pass per action per chunk",
+        "description": (
+            "the historical per-action monolithic step with "
+            "AdaptiveCompact two-phase compaction and the overflow-retry "
+            "escalation ladder — the bit-identity oracle every other "
+            "pipeline is pinned against"
+        ),
+        "fallback": None,
+    },
+}
+
+DEFAULT_PIPELINE = "fused"
+
+
+def pipeline_names() -> tuple:
+    return tuple(PIPELINE_REGISTRY)
+
+
+def resolve_pipeline(name=None) -> str:
+    """CLI/env resolution: explicit arg > $KSPEC_PIPELINE > the default.
+    Unknown names are rejected loudly with the valid set named (typos
+    must never silently fall back to a different implementation)."""
+    n = name or os.environ.get(PIPELINE_ENV) or DEFAULT_PIPELINE
+    if n not in PIPELINE_REGISTRY:
+        raise ValueError(
+            f"unknown pipeline {n!r} (expected one of "
+            f"{pipeline_names()}; `cli pipelines --list` describes them)"
+        )
+    return n
+
+
+def list_pipelines() -> list:
+    """Registry dump for ``cli pipelines --list`` (jax-free)."""
+    return [
+        {"name": name, "default": name == DEFAULT_PIPELINE, **entry}
+        for name, entry in PIPELINE_REGISTRY.items()
+    ]

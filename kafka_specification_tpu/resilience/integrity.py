@@ -246,6 +246,17 @@ class LevelDigestChain:
         self._fold_xor ^= x
         self._fold_sum = (self._fold_sum + s) & 0xFFFFFFFFFFFFFFFF
 
+    def fold_digest(self, count: int, xor: int, total: int) -> None:
+        """Fold a PRE-COMPUTED (count, xor, sum) multiset digest — the
+        device-resident pipeline's per-level accumulator, computed
+        in-jit (ops/devlevel.py) bit-exactly with :func:`digest_fps`
+        over the same fingerprints.  Digests combine by (c+c, x^x, s+s)
+        (see digest_fps), so this is exactly fold() minus the host
+        recomputation."""
+        self._fold_count += int(count)
+        self._fold_xor ^= int(xor)
+        self._fold_sum = (self._fold_sum + int(total)) & 0xFFFFFFFFFFFFFFFF
+
     def seal(self, depth: int, count: int) -> None:
         """Close level `depth` (must be len(entries)): the folded digest
         becomes the level's entry.  A count disagreement between the

@@ -22,6 +22,7 @@ import os
 import sys
 import time
 
+from ..pipeline_registry import pipeline_names as _pipeline_names
 from .cfg import build_model, parse_cfg
 
 # Platform-init watchdog (see _guarded_reexec): a wedged accelerator tunnel
@@ -368,17 +369,24 @@ def main(argv=None):
     )
     pc.add_argument(
         "--pipeline",
-        choices=["fused", "legacy"],
+        choices=list(_pipeline_names()),
         default=None,
         help="single-device level-pipeline implementation "
-        "(engine/pipeline.py): 'fused' (default; $KSPEC_PIPELINE "
-        "overrides) = successor mega-kernels — one guard-predicate-matrix "
-        "launch + one update-skeleton launch per chunk (2 successor "
-        "launches instead of one per action); 'legacy' = the historical "
-        "per-action step.  Bit-identical results either way (counts, "
-        "duplicate accounting, first-violation rule, trace values); "
-        "ignored by --sharded (the sharded engine keeps the per-action "
-        "path)",
+        "(engine/pipeline.py; `cli pipelines --list` describes the "
+        "registry): 'fused' (default; $KSPEC_PIPELINE overrides) = "
+        "successor mega-kernels — one guard-predicate-matrix launch + "
+        "one update-skeleton launch per chunk; 'device' = the "
+        "device-resident level pipeline — a bounded lax.while_loop runs "
+        "every gated chunk of a level in ONE dispatched program (<=2 "
+        "successor launches per LEVEL; needs the sorted-set device "
+        "visited backend + analyzer-proven field hulls, degrades to "
+        "'fused' otherwise); 'legacy' = the historical per-action step "
+        "(the bit-identity oracle).  Bit-identical results in every "
+        "case (counts, duplicate accounting, first-violation rule, "
+        "trace values, digest chains); ignored by --sharded (the "
+        "sharded engine keeps the per-action path).  Unknown names are "
+        "rejected here and by the engine's registry — a typo can never "
+        "silently select a different implementation",
     )
     pc.add_argument(
         "--overlap",
@@ -445,6 +453,19 @@ def main(argv=None):
         help="list the fault registry (the default action)",
     )
     pf.add_argument("--json", action="store_true")
+
+    pp = sub.add_parser(
+        "pipelines",
+        help="enumerate the registered level-pipeline implementations "
+        "(the --pipeline / $KSPEC_PIPELINE registry, "
+        "kafka_specification_tpu/pipeline_registry.py) with their launch "
+        "contracts and degradation ladder — never imports jax",
+    )
+    pp.add_argument(
+        "--list", action="store_true", dest="list_pipelines",
+        help="list the pipeline registry (the default action)",
+    )
+    pp.add_argument("--json", action="store_true")
 
     pan = sub.add_parser(
         "analyze",
@@ -701,6 +722,28 @@ def main(argv=None):
             print(f"      {e['description']}")
         print("Examples: crash@level:7   enospc@spill:2   "
               "flip@shard1:exchange:3   corrupt_ckpt@ckpt:4")
+        return 0
+
+    if args.cmd == "pipelines":
+        # pure registry dump (pipeline_registry.PIPELINE_REGISTRY, the
+        # fault-registry pattern): jax-free, the same source the
+        # --pipeline parser and the engine's resolve_pipeline validate
+        # against — a typo'd name is rejected loudly at parse time, it
+        # can never silently fall back to a different implementation
+        from ..pipeline_registry import list_pipelines
+
+        entries = list_pipelines()
+        if args.json:
+            print(json.dumps(entries))
+            return 0
+        print("Registered level pipelines (--pipeline / $KSPEC_PIPELINE; "
+              "engine/pipeline.py):")
+        for e in entries:
+            tag = " (default)" if e["default"] else ""
+            fb = (f" -> degrades to '{e['fallback']}'"
+                  if e["fallback"] else " (the bit-identity oracle)")
+            print(f"  {e['name']}{tag}: {e['launches']}{fb}")
+            print(f"      {e['description']}")
         return 0
 
     if args.cmd == "analyze":

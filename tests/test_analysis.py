@@ -132,8 +132,93 @@ def test_shipped_models_analyze_clean():
 
 def test_engine_sources_analyze_clean():
     """Self-application: ownership contracts verify and the purity/order
-    lint over engine/pipeline.py + parallel/sharded.py is clean."""
+    lint over engine/pipeline.py + parallel/sharded.py +
+    ops/devlevel.py (the device pipeline's in-jit helpers) is clean."""
     assert analyze_engine_sources() == []
+
+
+def test_purity_lint_covers_device_level_helpers():
+    """The device pipeline's traced helpers are IN the self-application
+    sweep (a host-side np.*/.item() call inside the while_loop body
+    must fail CI, not ship): the module is registered, its traced
+    functions are marked, and a seeded host-materialization mutant of
+    it is detected."""
+    import kafka_specification_tpu.analysis as an
+    from kafka_specification_tpu.analysis.ownership import lint_purity
+
+    rel = "kafka_specification_tpu/ops/devlevel.py"
+    assert rel in an.PURITY_MODULES
+    path = os.path.join(an.repo_root(), rel)
+    src = open(path).read()
+    assert "# kspec: traced" in src
+    # seeded mutant: a .item() materialization inside a traced helper
+    mutated = src.replace(
+        "count = jnp.sum(valid, dtype=jnp.int32)",
+        "count = jnp.sum(valid, dtype=jnp.int32)\n"
+        "    _bad = count.item()",
+    )
+    assert mutated != src
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False
+    ) as fh:
+        fh.write(mutated)
+        tmp = fh.name
+    try:
+        findings = lint_purity(tmp, rel)
+        assert any(f.kind == "host-materialization" for f in findings), \
+            [(f.kind, f.message) for f in findings]
+    finally:
+        os.unlink(tmp)
+
+
+def test_field_hulls_pin_against_packing_widths():
+    """The stable analysis.field_hulls export (the device pipeline's
+    pack-width precondition): on every shipped model the per-field
+    reachable-value hull sits INSIDE the declared packed range, so the
+    hull-derived pack width never exceeds ops/packing.Field.width — the
+    proof that the engine's shipped bit layout is wide enough for
+    everything the kernels can write (the general AsyncIsr N<=4 cliff,
+    now a queryable artifact)."""
+    from kafka_specification_tpu.analysis import field_hulls
+    from kafka_specification_tpu.analysis.encoding import (
+        hull_pack_widths,
+    )
+
+    checked = 0
+    for m in _shipped_models():
+        hulls = field_hulls(m)
+        widths = hull_pack_widths(hulls)
+        for f in m.spec.fields:
+            lo, hi = hulls[f.name]
+            assert f.lo <= lo <= hi <= f.hi, (m.name, f.name, hulls)
+            assert widths[f.name] <= f.width, (m.name, f.name)
+            checked += 1
+    assert checked > 50  # the matrix really swept
+
+
+def test_field_hulls_strict_raises_on_opaque_kernels():
+    """strict=True (the device pipeline's entry ticket) refuses to
+    guess: a kernel outside the interval domain raises
+    AnalysisUnsupported instead of returning a widened hull — while the
+    non-strict form widens honestly to the declared range."""
+    from kafka_specification_tpu.analysis import field_hulls
+    from kafka_specification_tpu.analysis.interval import (
+        AnalysisUnsupported,
+    )
+
+    def opaque(s, c):
+        raise RuntimeError("not abstractly executable")
+
+    m = _mutant_model(
+        "Opaque", [Action("Op", 1, opaque, writes=("x",))]
+    )
+    with pytest.raises(AnalysisUnsupported):
+        field_hulls(m, strict=True)
+    hulls = field_hulls(m)  # non-strict: declared-range widening
+    f = m.spec.fields[0]
+    assert hulls[f.name] == (f.lo, f.hi)
 
 
 # --------------------------------------------------------------------------

@@ -123,6 +123,49 @@ def test_digest_chain_order_invariant_and_roundtrips():
         d.seal(0, 11)
 
 
+def test_device_digest_fold_bit_exact_incl_carry_saturation():
+    """The device pipeline's in-jit (count, xor, sum) fold
+    (ops/devlevel.py) must be bit-exact with digest_fps — including the
+    limb-carry saturation case a full 65536-row block of 0xFFFF limbs
+    produces (regression: the raw uint32 block sum + accumulator +
+    carry could reach exactly 2^32 and silently drop a carry)."""
+    import jax.numpy as jnp
+
+    from kafka_specification_tpu.ops import devlevel as dl
+
+    T = 131072
+    hi = np.zeros(T, np.uint32)
+    lo = np.concatenate([
+        np.full(65536, 0xFFFFFFFF, np.uint32),
+        np.full(65536, 0xFFFF0001, np.uint32),
+    ])
+    cases = [
+        (hi, lo, np.ones(T, bool)),
+        (np.full(300, 0xFFFFFFFF, np.uint32),
+         np.full(300, 0xFFFFFFFF, np.uint32),
+         np.arange(300) < 123),
+    ]
+    rng = np.random.default_rng(11)
+    for _ in range(3):
+        n = int(rng.integers(1, 1 << 17))
+        cases.append((
+            rng.integers(0, 2**32, n, dtype=np.uint32),
+            rng.integers(0, 2**32, n, dtype=np.uint32),
+            rng.random(n) < 0.6,
+        ))
+    for h, l, v in cases:
+        acc = dl.zero_digest()
+        mid = len(h) // 2  # two folds exercise combine_digest too
+        for sl in (slice(0, mid), slice(mid, None)):
+            acc = dl.combine_digest(acc, dl.masked_digest(
+                jnp.asarray(h[sl]), jnp.asarray(l[sl]),
+                jnp.asarray(v[sl]),
+            ))
+        assert dl.digest_ints(acc) == integrity.digest_fps(
+            integrity.pair_u64(h[v], l[v])
+        )
+
+
 def test_chain_validator_flags_tampered_arrays():
     chain = LevelDigestChain()
     for d, n in enumerate((1, 4, 12)):
@@ -465,6 +508,22 @@ def test_shadow_host_oracle_catches_corrupted_fingerprints(monkeypatch):
     assert ei.value.site in ("shadow", "chain", "frontier")
 
 
+def test_shadow_forces_device_pipeline_onto_fused_ladder():
+    """Shadow re-execution replays single chunks from their pre-chunk
+    visited state — a state the whole-level device program never
+    materializes — so --pipeline device with a shadow rate runs the
+    fused per-chunk ladder (documented fallback), bit-identical and
+    with the legacy cross-exec oracle STILL armed."""
+    model = frl.make_model(2, 2, 2)
+    base = check(model, min_bucket=32, pipeline="device", compact_gate=32)
+    assert base.stats["device"]["levels"] > 0
+    shadowed = check(model, min_bucket=32, pipeline="device",
+                     compact_gate=32, integrity_shadow=1.0)
+    assert shadowed.stats["device"]["levels"] == 0
+    assert "shadow" in (shadowed.stats["device"]["fallback"] or "")
+    assert _verdict(shadowed) == _verdict(base)
+
+
 def test_shadow_sampling_is_deterministic():
     assert integrity.sample_chunk(3, 0, 1.0)
     assert not integrity.sample_chunk(3, 0, 0.0)
@@ -493,6 +552,11 @@ def test_chain_identical_across_pipelines_engines_and_layouts(tmp_path):
     for tag, kw in (
         ("fused", dict(pipeline="fused")),
         ("legacy", dict(pipeline="legacy")),
+        # whole-level device programs fold the digest IN-JIT
+        # (ops/devlevel.py) — the accumulator must land bit-identical to
+        # every host-folded chain (compact_gate 32 forces the device
+        # path to actually engage at this model's tiny buckets)
+        ("device", dict(pipeline="device", compact_gate=32)),
         ("host", dict(visited_backend="host")),
     ):
         ck = str(tmp_path / tag)

@@ -68,13 +68,18 @@ def _model(module):
     return _MODELS[module]
 
 
-def _assert_parity(module, **extra_kw):
+def _assert_parity(module, pipeline="fused", **extra_kw):
     kw = {**KW, **extra_kw}
     m = _model(module)
     r_leg = check(m, pipeline="legacy", **kw)
-    r_fus = check(m, pipeline="fused", **kw)
-    assert r_fus.stats["pipeline"] == "fused"
+    r_fus = check(m, pipeline=pipeline, **kw)
+    assert r_fus.stats["pipeline"] == pipeline
     assert r_fus.stats["pipeline_fallback"] is False
+    if pipeline == "device" and kw.get("visited_backend", "device") == \
+            "device":
+        # the device path must actually ENGAGE (a silent fused
+        # delegation would vacuously pass every parity assertion)
+        assert r_fus.stats["device"]["levels"] > 0, r_fus.stats["device"]
     assert r_leg.levels == r_fus.levels
     assert r_leg.total == r_fus.total
     for a, b in zip(r_leg.stats["levels"], r_fus.stats["levels"]):
@@ -108,6 +113,65 @@ def test_fused_vs_legacy_bit_identity_matrix(module):
     """The rest of the model matrix (passing runs, constraint pruning
     on AsyncIsr) — same parity predicate."""
     _assert_parity(module)
+
+
+def test_device_vs_legacy_bit_identity_violating_model():
+    """Tier-1 anchor for the device-resident pipeline: the violating
+    TruncateToHW case (richest assertions: trace VALUES) run as whole-
+    level device programs is bit-identical to the legacy oracle —
+    counts, duplicate accounting, enablement histograms, the first-
+    violation verdict and the trace, with the device path proven
+    engaged."""
+    r_leg, _ = _assert_parity("KafkaTruncateToHighWatermark",
+                              pipeline="device")
+    assert r_leg.violation is not None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("module", ["Kip101", "Kip320", "AsyncIsr"])
+def test_device_vs_legacy_bit_identity_matrix(module):
+    """Device-pipeline parity over the rest of the model matrix
+    (passing runs, constraint pruning on AsyncIsr)."""
+    _assert_parity(module, pipeline="device")
+
+
+def test_device_pipeline_ungated_tail_chunk():
+    """A trailing partial chunk BELOW the compact gate stays on the
+    per-chunk ladder (legacy full-lattice candidate order) while the
+    gated prefix runs device-resident — the split must be bit-identical
+    and must slice the device buffer to the handled prefix (regression:
+    padding the full frontier into a prefix-sized buffer raised).
+    min_bucket 16 < gate 32 makes every level's remainder chunk
+    un-gated."""
+    kw = {**KW, "min_bucket": 16, "chunk_size": 32}
+    m = _model("KafkaTruncateToHighWatermark")
+    r_leg = check(m, pipeline="legacy", **kw)
+    r_dev = check(m, pipeline="device", **kw)
+    assert r_dev.stats["device"]["levels"] > 0
+    assert r_dev.stats["device"]["fallback"] is None
+    assert r_leg.levels == r_dev.levels
+    assert r_leg.total == r_dev.total
+    for a, b in zip(r_leg.stats["levels"], r_dev.stats["levels"]):
+        assert a["duplicates"] == b["duplicates"]
+        assert a["action_enablement"] == b["action_enablement"]
+    t_leg = [(a, repr(s)) for a, s in r_leg.violation.trace]
+    t_dev = [(a, repr(s)) for a, s in r_dev.violation.trace]
+    assert t_leg == t_dev
+
+
+@pytest.mark.parametrize("backend", ["host", "device-hash"])
+def test_device_pipeline_non_device_backend_falls_back(backend):
+    """The degradation ladder's first rung: on a visited backend the
+    whole-level program cannot serve, --pipeline device runs the fused
+    per-chunk path — same results, zero device levels, and the reason
+    recorded (stats['device']['fallback'])."""
+    m = _model("Kip101")
+    r_dev = check(m, pipeline="device", visited_backend=backend, **KW)
+    assert r_dev.stats["device"]["levels"] == 0
+    assert r_dev.stats["device"]["fallback"] is not None
+    r_ref = check(m, pipeline="fused", visited_backend=backend, **KW)
+    assert r_dev.levels == r_ref.levels
+    assert r_dev.total == r_ref.total
 
 
 @pytest.mark.slow
@@ -147,7 +211,8 @@ def test_resume_cross_pipeline(tmp_path):
     what makes the CLI default switch safe for in-flight runs."""
     kw = {**KW, "store_trace": False}
     ref = check(_model("Kip101"), pipeline="fused", **kw)
-    for first, second in (("legacy", "fused"), ("fused", "legacy")):
+    for first, second in (("legacy", "fused"), ("fused", "legacy"),
+                          ("device", "legacy"), ("fused", "device")):
         ckpt = tmp_path / f"{first}-{second}"
         cut = check(
             _model("Kip101"), pipeline=first, checkpoint_dir=str(ckpt),
@@ -197,6 +262,66 @@ def test_fused_two_launches_per_chunk(tmp_path):
                  lambda n: n >= n_actions and n % n_actions == 0)
     # the bit-identity case above already pins fused == legacy results;
     # this test is ONLY the launch-count contract
+
+
+@pytest.mark.perf
+def test_device_two_launches_per_level(tmp_path):
+    """The device pipeline's launch contract, span-tracer-verified: a
+    whole level — including MULTI-CHUNK levels — dispatches at most 2
+    successor programs (one steady-state; two only when a segment-width
+    overflow forces the exact-width re-dispatch).  chunk_size 32 forces
+    several levels of this model through multiple chunks, so the test
+    proves the while_loop really covers the chunk loop (a per-chunk
+    dispatcher would show 2 x chunks here, like fused does)."""
+    m = _model("Kip101")
+    run = RunContext(str(tmp_path / "dev"))
+    kw = {k: v for k, v in KW.items() if k != "stats_path"}
+    kw["chunk_size"] = 32
+    res = check(m, pipeline="device", run=run, **kw)
+    run.deactivate()
+    assert res.stats["device"]["levels"] > 0
+    assert res.stats["device"]["fallback"] is None
+    for lvl in res.stats["levels"]:
+        assert lvl["successor_launches"] <= 2, lvl
+    with open(os.path.join(run.dir, "spans.jsonl")) as fh:
+        spans = [json.loads(line) for line in fh]
+    steps = [s for s in spans
+             if s.get("span") == "step" and s.get("ph") != "B"]
+    dev = [s for s in steps if s.get("pipeline") == "device"]
+    assert dev, "no device-level step spans recorded"
+    assert all(s["launches"] <= 2 for s in dev)
+    # the multi-chunk proof: at least one single-dispatch span covered
+    # more than one serial chunk
+    assert any(s.get("chunks", 1) > 1 for s in dev), \
+        [s.get("chunks") for s in dev]
+    # same run, bit-identical to the oracle (cheap cross-check at this
+    # chunking — the anchor test covers the violating case)
+    r_leg = check(m, pipeline="legacy", **kw)
+    assert r_leg.levels == res.levels
+    assert r_leg.total == res.total
+
+
+@pytest.mark.slow
+def test_device_rewarm_replays_level_keys(tmp_path):
+    """PreparedKernels.rewarm re-compiles DEVICE level-program keys at a
+    new visited-capacity fixed point (the serving post-growth warm
+    contract covers the 'dvl' tag like 'step'/'fsc')."""
+    model = variants.make_model("Kip101", TINY,
+                                invariants=("TypeOk", "WeakIsr"))
+    pk = prepare(model)
+    kw = {**KW, "store_trace": False}
+    r = check(model, pipeline="device", prepared=pk,
+              visited_backend="device", **kw)
+    assert r.stats["device"]["levels"] > 0
+    pk.note_result(r)
+    pk.capacity_hint = int(r.stats["visited_capacity"]) * 2
+    pk._hint_is_capacity = True
+    assert pk.rewarm() > 0
+    from kafka_specification_tpu.engine.pipeline import key_vcap
+
+    caps = {key_vcap(k) for k in model._step_compiled_log
+            if k[0] == "dvl"}
+    assert pk.capacity_hint in caps
 
 
 @pytest.mark.perf
@@ -286,6 +411,24 @@ def test_injected_compile_oom_degrades_fused_to_legacy(monkeypatch):
     assert r_ref.stats["launches_per_chunk_max"] == 2
 
 
+def test_injected_compile_oom_degrades_device_to_fused(monkeypatch):
+    """KSPEC_FAULT=compile_oom rehearses the device failure ladder: the
+    level dispatch is the escalated-shape family, so the injected OOM
+    fires there and the run degrades to the fused per-chunk ladder —
+    same results, stats['device']['fallback'] records why."""
+    monkeypatch.setenv("KSPEC_FAULT", "compile_oom")
+    r_fall = check(_model("KafkaTruncateToHighWatermark"),
+                   pipeline="device", **KW)
+    monkeypatch.delenv("KSPEC_FAULT")
+    r_ref = check(_model("KafkaTruncateToHighWatermark"),
+                  pipeline="device", **KW)
+    assert r_fall.stats["device"]["levels"] == 0
+    assert r_fall.stats["device"]["fallback"] is not None
+    assert r_ref.stats["device"]["levels"] > 0
+    assert r_fall.levels == r_ref.levels  # degraded run, exact results
+    assert r_fall.violation.depth == r_ref.violation.depth
+
+
 def test_pooled_widths_ladder():
     """Unit: pooled segment widths cover the exact counts, stay
     256-aligned (the fingerprint-block invariant), never exceed the
@@ -315,7 +458,52 @@ def test_pooled_widths_ladder():
 def test_resolve_pipeline_env(monkeypatch):
     assert resolve_pipeline(None) == "fused"
     assert resolve_pipeline("legacy") == "legacy"
+    assert resolve_pipeline("device") == "device"
     monkeypatch.setenv("KSPEC_PIPELINE", "legacy")
     assert resolve_pipeline(None) == "legacy"
+    monkeypatch.setenv("KSPEC_PIPELINE", "device")
+    assert resolve_pipeline(None) == "device"
     with pytest.raises(ValueError):
         resolve_pipeline("bogus")
+    # a typo'd ENV value must be rejected just as loudly as a typo'd
+    # arg (the silent-fallback class the registry exists to kill), and
+    # the error must NAME the valid set
+    monkeypatch.setenv("KSPEC_PIPELINE", "fusedd")
+    with pytest.raises(ValueError, match="device.*fused.*legacy"):
+        resolve_pipeline(None)
+
+
+def test_cli_pipelines_list_is_jax_free_registry_dump(capsys):
+    """`cli pipelines --list` mirrors `cli faults --list`: a pure dump
+    of the jax-free registry with the launch contracts and the
+    degradation ladder — and the machine-readable --json twin."""
+    from kafka_specification_tpu.utils.cli import main as cli_main
+
+    assert cli_main(["pipelines", "--json"]) == 0
+    entries = json.loads(capsys.readouterr().out)
+    assert [e["name"] for e in entries] == ["device", "fused", "legacy"]
+    assert all("description" in e and "launches" in e for e in entries)
+    assert cli_main(["pipelines"]) == 0
+    out = capsys.readouterr().out
+    assert "device" in out and "degrades to 'fused'" in out
+    assert "bit-identity oracle" in out
+
+
+def test_pipeline_registry_is_the_single_source():
+    """The jax-free registry (pipeline_registry.py), the engine's
+    PIPELINES tuple, and the factory agree on the name set — the CLI
+    parser builds its choices from the same registry."""
+    from kafka_specification_tpu.pipeline_registry import (
+        PIPELINE_REGISTRY,
+        list_pipelines,
+        pipeline_names,
+    )
+    from kafka_specification_tpu.engine.pipeline import PIPELINES
+
+    assert set(PIPELINES) == set(pipeline_names())
+    assert set(PIPELINE_REGISTRY) == {"device", "fused", "legacy"}
+    entries = {e["name"]: e for e in list_pipelines()}
+    assert entries["fused"]["default"] is True
+    assert entries["device"]["fallback"] == "fused"
+    assert entries["fused"]["fallback"] == "legacy"
+    assert entries["legacy"]["fallback"] is None
