@@ -813,6 +813,41 @@ class FusedPipeline:
 # --------------------------------------------------------------------------
 
 
+def device_hull_fallback(model) -> Optional[str]:
+    """The field-hull HARD precondition shared by every device-resident
+    level path (single-device DevicePipeline and the sharded per-shard
+    variant): every field's proven reachable-value hull must sit inside
+    its declared packed range.  Stricter than the engine's KSPEC_ANALYZE
+    gate on purpose — the gate can be env-disabled, this cannot: a
+    device-resident level has no host visibility between chunks, so the
+    pack stage's no-truncation property must be PROVEN, not assumed.
+    Returns None when proven, else the human-readable fallback reason."""
+    from ..analysis.interval import AnalysisUnsupported
+
+    try:
+        from ..analysis import field_hulls
+
+        hulls = field_hulls(model, strict=True)
+    except AnalysisUnsupported as e:
+        return f"no proven field hulls ({e})"
+    except Exception as e:  # noqa: BLE001 — never break checking
+        return (
+            f"field-hull analysis failed "
+            f"({type(e).__name__}: {e})"[:200]
+        )
+    bad = [
+        f.name
+        for f in model.spec.fields
+        if hulls[f.name][0] < f.lo or hulls[f.name][1] > f.hi
+    ]
+    if bad:
+        return (
+            f"field hull escapes the declared packed range for "
+            f"{bad} (encoding-unsound model; KSPEC_ANALYZE=0?)"
+        )
+    return None
+
+
 class DevicePipeline:
     """Device-resident level pipeline (module docstring): one dispatched
     ``lax.while_loop`` program runs every gated chunk of a BFS level —
@@ -860,37 +895,9 @@ class DevicePipeline:
             self._check_hulls()
 
     def _check_hulls(self) -> None:
-        """The field-hull precondition: every field's proven reachable-
-        value hull must sit inside its declared packed range.  This is
-        stricter than the engine's KSPEC_ANALYZE gate on purpose — the
-        gate can be env-disabled, this cannot: a device-resident level
-        has no host visibility between chunks, so the pack stage's
-        no-truncation property must be PROVEN, not assumed."""
-        from ..analysis.interval import AnalysisUnsupported
-
-        try:
-            from ..analysis import field_hulls
-
-            hulls = field_hulls(self.model, strict=True)
-        except AnalysisUnsupported as e:
-            self.device_fallback = f"no proven field hulls ({e})"
-            return
-        except Exception as e:  # noqa: BLE001 — never break checking
-            self.device_fallback = (
-                f"field-hull analysis failed "
-                f"({type(e).__name__}: {e})"[:200]
-            )
-            return
-        bad = [
-            f.name
-            for f in self.spec.fields
-            if hulls[f.name][0] < f.lo or hulls[f.name][1] > f.hi
-        ]
-        if bad:
-            self.device_fallback = (
-                f"field hull escapes the declared packed range for "
-                f"{bad} (encoding-unsound model; KSPEC_ANALYZE=0?)"
-            )
+        """The field-hull precondition (:func:`device_hull_fallback` —
+        one shared check with the sharded device-resident variant)."""
+        self.device_fallback = device_hull_fallback(self.model)
 
     # --- per-chunk interface: delegate to the fused ladder ----------------
     @property
@@ -1138,17 +1145,16 @@ class DevicePipeline:
             B, self.pool.widths_for(B, np.zeros(n_actions), B)
         )
         T = self.step.expand_width(B, widths)
-        # level-new capacity ladder: the per-chunk merge costs O(LN), so
-        # size LN from the run's measured per-level new-state high water
-        # (with headroom), NOT the NCp*T worst case — an overflow costs
-        # exactly one re-dispatch at the safe bound, steady state costs
-        # nothing.  This is where the device pipeline's merge win comes
-        # from: the serial path scatters O(visited capacity) per CHUNK,
-        # this path scatters O(level) per chunk and O(capacity) once.
-        LN = min(
-            _next_pow2(max(T, int(1.35 * self._ln_hw) + 1)),
-            _next_pow2(NCp * T),
-        )
+        # level-new capacity ladder (ops/devlevel.level_new_capacity —
+        # ONE sizing policy shared with the sharded device-resident
+        # variant): the per-chunk merge costs O(LN), so size LN from the
+        # run's measured per-level new-state high water, NOT the NCp*T
+        # worst case — an overflow costs exactly one re-dispatch at the
+        # safe bound, steady state costs nothing.  This is where the
+        # device pipeline's merge win comes from: the serial path
+        # scatters O(visited capacity) per CHUNK, this path scatters
+        # O(level) per chunk and O(capacity) once.
+        LN = devlevel.level_new_capacity(T, self._ln_hw, NCp * T)
         exact = False  # True after an overflow re-dispatch (safe bounds)
         dispatched = 0
         fbuf = None
@@ -1208,7 +1214,7 @@ class DevicePipeline:
                     ),
                 )
                 T = self.step.expand_width(B, widths)
-                LN = _next_pow2(NCp * T)
+                LN = devlevel.level_new_bound(NCp * T)
                 exact = True
                 continue
             break

@@ -365,21 +365,33 @@ def _launches(data: dict) -> dict:
     launch counts.  <=2/level is the device-resident pipeline's launch
     contract; the fused path shows 2x chunks, legacy O(actions)x chunks
     — the emitted stats stream stays record-for-record historical, so
-    this beat reads the gauge/span side channels only."""
+    this beat reads the gauge/span side channels only.  The sharded
+    twin `kspec_shard_launches_level` counts dispatched collective-
+    bearing programs per level (= launches PER SHARD): O(1)/level under
+    the sharded device pipeline vs O(chunks) per-chunk."""
     series = []
+    shard_series = []
     for snap in data.get("metrics_history") or ():
-        v = (snap.get("gauges") or {}).get(
-            "kspec_successor_launches_level"
-        )
+        g = snap.get("gauges") or {}
+        v = g.get("kspec_successor_launches_level")
         if v is not None:
             series.append(v)
+        sv = g.get("kspec_shard_launches_level")
+        if sv is not None:
+            shard_series.append(sv)
     last = (data.get("metrics") or {}).get("gauges") or {}
     out = {
         "series": series,
         "last": last.get("kspec_successor_launches_level"),
         "max": max(series) if series else None,
+        "shard_series": shard_series,
+        "shard_last": last.get("kspec_shard_launches_level"),
+        "shard_max": max(shard_series) if shard_series else None,
     }
-    out["present"] = bool(series) or out["last"] is not None
+    out["present"] = (
+        bool(series) or out["last"] is not None
+        or bool(shard_series) or out["shard_last"] is not None
+    )
     return out
 
 
@@ -695,9 +707,21 @@ def render_report(run_dir: str, now: Optional[float] = None,
     if ln.get("present"):
         # launches/level beat: the device-resident pipeline's contract
         # is <=2 per level; fused shows 2x chunks, legacy O(actions)x
-        bits = [f"successor launches/level last {ln.get('last')}"]
-        if ln.get("series"):
-            bits.append(f"max {ln['max']} " + _spark(ln["series"]))
+        bits = []
+        if ln.get("last") is not None or ln.get("series"):
+            bits.append(f"successor launches/level last {ln.get('last')}")
+            if ln.get("series"):
+                bits.append(f"max {ln['max']} " + _spark(ln["series"]))
+        if ln.get("shard_last") is not None or ln.get("shard_series"):
+            # sharded twin: dispatched collective-bearing programs per
+            # level = launches PER SHARD (O(1) under --pipeline device)
+            bits.append(
+                f"launches/level/shard last {ln.get('shard_last')}"
+            )
+            if ln.get("shard_series"):
+                bits.append(
+                    f"max {ln['shard_max']} " + _spark(ln["shard_series"])
+                )
         out.append("  launches: " + "  ".join(bits))
     if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
         out.append(f"  died mid-level: level {r['open_level']} began but "

@@ -122,6 +122,58 @@ def digest_ints(acc) -> tuple:
     return int(np.asarray(count)), xor, total & 0xFFFFFFFFFFFFFFFF
 
 
+def _next_pow2(n: int) -> int:
+    """Local twin of engine.bfs._next_pow2 (importing the engine here
+    would cycle: engine/pipeline.py imports this module)."""
+    n = max(1, int(n))
+    return 1 << (n - 1).bit_length()
+
+
+#: headroom multiplier over the measured per-level new-state high water
+#: (matches PooledWidths.HEADROOM — one sizing philosophy everywhere)
+LN_HEADROOM = 1.35
+
+#: below this many lanes the safe (cannot-overflow) bound is taken
+#: outright instead of the high-water ladder: 64Ki u64 pairs = 512KiB —
+#: the per-chunk merge over it is cheap next to a gated chunk's work,
+#: while an overflow re-dispatch always discards a full level's compute.
+#: During a run's growth phase the high water lags the frontier by one
+#: level, so a ladder here would re-dispatch nearly every level; at
+#: production scale `worst` is millions of lanes and the ladder governs.
+LN_SAFE_SMALL = 1 << 16
+
+
+def level_new_capacity(T: int, ln_hw: int, worst: int) -> int:
+    """The level-new sorted set's high-water-LADDER capacity — the ONE
+    sizing policy for every device-resident level path (the single-
+    device DevicePipeline and the sharded per-shard variant; they must
+    not drift on overflow bounds).
+
+    The per-chunk level-new merge costs O(LN), so LN is sized from the
+    run's measured per-level new-state high water `ln_hw` (with
+    LN_HEADROOM), floored at one chunk's emit width `T` (a level can
+    always produce at least one chunk's worth) and capped at the safe
+    bound `worst` (= chunks x emit width — the level can't produce
+    more).  Small levels (`worst` <= LN_SAFE_SMALL) take the safe bound
+    outright — no overflow is possible there and the ladder could only
+    lose re-dispatches.  Otherwise an overflow costs exactly one
+    re-dispatch at :func:`level_new_bound`; steady state costs
+    nothing."""
+    safe = _next_pow2(worst)
+    if safe <= LN_SAFE_SMALL:
+        return safe
+    return min(
+        _next_pow2(max(T, int(LN_HEADROOM * ln_hw) + 1)),
+        safe,
+    )
+
+
+def level_new_bound(worst: int) -> int:
+    """The safe (cannot-overflow) level-new capacity for the exact-bound
+    re-dispatch: `worst` = chunks x per-chunk emit width."""
+    return _next_pow2(worst)
+
+
 def append_rows(buf, seg, offset):  # kspec: traced
     """Write a [T, K] segment into `buf` at row `offset` (traced value).
     The caller advances its live-prefix counter by the segment's valid

@@ -63,6 +63,8 @@ from ..engine.bfs import (
     _Step,
     walk_trace,
 )
+from ..ops import devlevel
+from ..pipeline_registry import resolve_pipeline
 from ..models.base import Model
 from ..obs import metrics as _met
 from ..obs.observer import RunObserver
@@ -144,6 +146,219 @@ def _default_dest_w(T: int, D: int) -> int:
     return max(64, T // D)
 
 
+def mesh_layouts(mesh: Mesh) -> dict:
+    """EXPLICIT mesh-axis layouts for every mesh-resident tensor class
+    (the sharding-rule pattern of SNIPPETS.md [1][3]): one named
+    NamedSharding/PartitionSpec per logical tensor instead of the old
+    implicit ``P('d')``-for-everything.  These are asserted in tests
+    (tests/test_sharded_device.py), so a future real-ICI window inherits
+    correct, named layouts for free:
+
+    - ``frontier``  [D*B, K]  packed state rows: row dim sharded over the
+      mesh axis, the K packed lanes replicated within a shard;
+    - ``fvalid``    [D*B]     per-row validity mask, sharded like rows;
+    - ``fpset``     [D, vcap] per-shard sorted fingerprint lanes (or the
+      device-hash table slots): shard-major dim sharded, each shard's
+      capacity dim local to its device;
+    - ``pershard``  [D]       per-shard scalars (visited counts, pending
+      lengths, chunk counts);
+    - ``exchange``  [D*R(,K)] exchange receive buffers — what the
+      all_to_all/all_gather fills, row dim sharded by OWNER shard.
+    """
+    return {
+        "frontier": NamedSharding(mesh, P("d", None)),
+        "fvalid": NamedSharding(mesh, P("d")),
+        "fpset": NamedSharding(mesh, P("d", None)),
+        "pershard": NamedSharding(mesh, P("d")),
+        "exchange": NamedSharding(mesh, P("d", None)),
+    }
+
+
+def _fp_digest(dhi, dlo, mask):  # kspec: traced
+    """Exchange framing record: order-invariant (count, xor_hi, xor_lo,
+    sum_hi, sum_lo) over a masked fingerprint multiset — the payload's
+    integrity stamp.  Computed per shard BEFORE and AFTER the
+    collective; the host compares the global combines, so any bit the
+    fabric (or a buffer in between) flips in a routed fingerprint
+    desyncs the two (resilience.integrity).  uint32 lanes: TPUs have no
+    64-bit ALU, and wrapping 32-bit sums/xors combine across shards
+    just as commutatively."""
+    z = jnp.uint32(0)
+    mh = jnp.where(mask, dhi, z)
+    ml = jnp.where(mask, dlo, z)
+    return jnp.stack([
+        jnp.sum(mask, dtype=jnp.uint32),
+        jax.lax.reduce(mh, z, jax.lax.bitwise_xor, [0]),
+        jax.lax.reduce(ml, z, jax.lax.bitwise_xor, [0]),
+        jnp.sum(mh, dtype=jnp.uint32),
+        jnp.sum(ml, dtype=jnp.uint32),
+    ])
+
+
+def _acc_digest(acc, dig, enabled):  # kspec: traced
+    """Fold one chunk's [5] framing digest into a running per-level
+    accumulator with the SAME combine rule the host applies across
+    shards: counts and wrapping sums add, xors xor.  `enabled` masks
+    out chunks the serial path would have discarded (overflowed
+    attempts)."""
+    z = jnp.zeros((5,), jnp.uint32)
+    d = jnp.where(enabled, dig, z)
+    return jnp.stack([
+        acc[0] + d[0],
+        acc[1] ^ d[1],
+        acc[2] ^ d[2],
+        acc[3] + d[3],
+        acc[4] + d[4],
+    ])
+
+
+def _combine_digs(dig: np.ndarray) -> tuple:
+    """Host-side global combine of per-shard [D, 5] framing digests
+    (counts sum exactly, xors xor, wrapping-u32 sums wrap) — one shared
+    implementation for the per-chunk and the device-level compares."""
+    s64 = dig.astype(np.uint64)
+    return (
+        int(dig[:, 0].astype(np.int64).sum()),
+        int(np.bitwise_xor.reduce(dig[:, 1])),
+        int(np.bitwise_xor.reduce(dig[:, 2])),
+        int(s64[:, 3].sum() & np.uint64(0xFFFFFFFF)),
+        int(s64[:, 4].sum() & np.uint64(0xFFFFFFFF)),
+    )
+
+
+def _make_exchange(D: int, W: int, R: int, K: int, exchange: str,
+                   compress: bool):
+    """Build the traced per-chunk candidate exchange — ONE source for
+    the per-chunk sharded step and the device-resident level program
+    (the two must not drift on routing, codec or framing semantics).
+
+    Returns fn(hi, lo, cand, parent_g, actid, valid, me) ->
+    (r_hi, r_lo, r_cand, r_parent, r_act, ovf_dest) with the received
+    buffers R rows wide; see _make_sharded_step's docstring for the
+    routing/codec/bit-identity contract."""
+    sent = jnp.uint32(dedup.SENT)
+    a2a = lambda x: jax.lax.all_to_all(  # noqa: E731
+        x, "d", split_axis=0, concat_axis=0, tiled=True
+    )
+    if exchange == "all_to_all" and compress:
+        from ..ops import fpcompress as _fpc
+
+        Wr = max(32, W // 2)  # compact row budget (valid-first rows)
+        NWc = _fpc.default_stream_words(W)
+
+        def route(hi, lo, cand, parent_g, actid, valid, me):  # kspec: traced
+            owner = jnp.where(
+                valid, (lo % jnp.uint32(D)).astype(jnp.int32), D
+            )
+            s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
+            for d in range(D):
+                mask = owner == d
+                cnts.append(jnp.sum(mask, dtype=jnp.int32))
+                cpos = jnp.where(mask, jnp.cumsum(mask) - 1, W)
+                s_hi.append(jnp.full((W,), sent).at[cpos].set(hi))
+                s_lo.append(jnp.full((W,), sent).at[cpos].set(lo))
+                s_cand.append(jnp.zeros((W, K), jnp.uint32).at[cpos].set(cand))
+                s_par.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(parent_g))
+                s_act.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(actid))
+            b_hi = jnp.stack(s_hi)  # [D, W]
+            b_lo = jnp.stack(s_lo)
+            cnts_a = jnp.stack(cnts)  # [D]
+            # STABLE per-bucket fingerprint sort (vmapped: ONE batched
+            # sort program, not D copies — compile-time matters on this
+            # engine's many step shapes): sentinels (max u64) sink last,
+            # ties keep candidate order — the property the bit-identity
+            # argument in _make_sharded_step's docstring rests on
+            perm = jax.vmap(lambda h, l: jnp.lexsort((l, h)))(b_hi, b_lo)
+            b_hi = jnp.take_along_axis(b_hi, perm, axis=1)
+            b_lo = jnp.take_along_axis(b_lo, perm, axis=1)
+            b_cand = jnp.take_along_axis(
+                jnp.stack(s_cand), perm[:, :, None], axis=1
+            )
+            b_par = jnp.take_along_axis(jnp.stack(s_par), perm, axis=1)
+            b_act = jnp.take_along_axis(jnp.stack(s_act), perm, axis=1)
+            s_words, s_hdr, ovf_pack = jax.vmap(
+                lambda h, l, c: _fpc.pack_sorted(h, l, c, NWc)
+            )(b_hi, b_lo, cnts_a)
+            ovf_dest = jnp.any(cnts_a > W) | jnp.any(
+                ovf_pack | (cnts_a > Wr)
+            )
+            r_words = a2a(s_words)  # [D, NWc]
+            r_hdr = a2a(s_hdr)  # [D, HDR + NB]
+            r_cand_c = a2a(b_cand[:, :Wr])  # [D, Wr, K]
+            r_par_c = a2a(b_par[:, :Wr])
+            r_act_c = a2a(b_act[:, :Wr].astype(jnp.uint8))
+            # in-jit decode per source segment; the framing digest the
+            # caller computes runs over THESE decoded lanes, so fabric
+            # integrity covers the packed stream, the header and the
+            # codec
+            dec_hi, dec_lo = jax.vmap(
+                lambda wds, hd: _fpc.unpack_sorted(wds, hd, W)
+            )(r_words, r_hdr)
+            r_hi = dec_hi.reshape(R)
+            r_lo = dec_lo.reshape(R)
+            # compact rows pad back to W slots per source segment; the
+            # live rows are the first cnt of each (valid-first after the
+            # bucket sort), exactly aligned with the decoded lanes
+            r_cand = (
+                jnp.zeros((D, W, K), jnp.uint32)
+                .at[:, :Wr].set(r_cand_c)
+                .reshape(R, K)
+            )
+            r_parent = (
+                jnp.full((D, W), -1, jnp.int32)
+                .at[:, :Wr].set(r_par_c)
+                .reshape(R)
+            )
+            r_act = (
+                jnp.full((D, W), -1, jnp.int32)
+                .at[:, :Wr].set(r_act_c.astype(jnp.int32))
+                .reshape(R)
+            )
+            return r_hi, r_lo, r_cand, r_parent, r_act, ovf_dest
+
+    elif exchange == "all_to_all":
+
+        def route(hi, lo, cand, parent_g, actid, valid, me):  # kspec: traced
+            owner = jnp.where(
+                valid, (lo % jnp.uint32(D)).astype(jnp.int32), D
+            )
+            s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
+            for d in range(D):
+                mask = owner == d
+                cnt = jnp.sum(mask, dtype=jnp.int32)
+                cnts.append(cnt)
+                cpos = jnp.where(mask, jnp.cumsum(mask) - 1, W)
+                s_hi.append(jnp.full((W,), sent).at[cpos].set(hi))
+                s_lo.append(jnp.full((W,), sent).at[cpos].set(lo))
+                s_cand.append(jnp.zeros((W, K), jnp.uint32).at[cpos].set(cand))
+                s_par.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(parent_g))
+                s_act.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(actid))
+            ovf_dest = jnp.any(jnp.stack(cnts) > W)
+            r_hi = a2a(jnp.stack(s_hi)).reshape(R)
+            r_lo = a2a(jnp.stack(s_lo)).reshape(R)
+            r_cand = a2a(jnp.stack(s_cand)).reshape(R, K)
+            r_parent = a2a(jnp.stack(s_par)).reshape(R)
+            r_act = a2a(jnp.stack(s_act)).reshape(R)
+            return r_hi, r_lo, r_cand, r_parent, r_act, ovf_dest
+
+    else:
+
+        def route(hi, lo, cand, parent_g, actid, valid, me):  # kspec: traced
+            ovf_dest = jnp.bool_(False)
+            r_hi = jax.lax.all_gather(hi, "d", tiled=True)  # [D*T]
+            r_lo = jax.lax.all_gather(lo, "d", tiled=True)
+            r_cand = jax.lax.all_gather(cand, "d", tiled=True)  # [D*T, K]
+            r_valid = jax.lax.all_gather(valid, "d", tiled=True)
+            r_parent = jax.lax.all_gather(parent_g, "d", tiled=True)
+            r_act = jax.lax.all_gather(actid, "d", tiled=True)
+            mine = r_valid & ((r_lo % jnp.uint32(D)).astype(jnp.int32) == me)
+            r_hi = jnp.where(mine, r_hi, sent)
+            r_lo = jnp.where(mine, r_lo, sent)
+            return r_hi, r_lo, r_cand, r_parent, r_act, ovf_dest
+
+    return route
+
+
 def _make_sharded_step(
     model: Model,
     mesh: Mesh,
@@ -219,6 +434,7 @@ def _make_sharded_step(
     # uniform spread of the typical ~6%-enabled candidate load
     W = dest_w if dest_w is not None else _default_dest_w(T, D)
     R = D * W if exchange == "all_to_all" else D * T  # receive width
+    route = _make_exchange(D, W, R, K, exchange, compress)
 
     def shard_body(frontier, fvalid, vhi, vlo, vn):  # kspec: traced
         # per-shard views: frontier [bucket, K], vhi [1, vcap], vn [1]
@@ -238,133 +454,11 @@ def _make_sharded_step(
         # parent as a mesh-global frontier row id (survives the exchange)
         parent_g = me.astype(jnp.int32) * bucket + parent
 
-        def fp_digest(dhi, dlo, mask):  # kspec: traced
-            """Exchange framing record: order-invariant (count, xor_hi,
-            xor_lo, sum_hi, sum_lo) over a masked fingerprint multiset —
-            the payload's integrity stamp.  Computed per shard BEFORE and
-            AFTER the collective; the host compares the global combines,
-            so any bit the fabric (or a buffer in between) flips in a
-            routed fingerprint desyncs the two (resilience.integrity).
-            uint32 lanes: TPUs have no 64-bit ALU, and wrapping 32-bit
-            sums/xors combine across shards just as commutatively."""
-            z = jnp.uint32(0)
-            mh = jnp.where(mask, dhi, z)
-            ml = jnp.where(mask, dlo, z)
-            return jnp.stack([
-                jnp.sum(mask, dtype=jnp.uint32),
-                jax.lax.reduce(mh, z, jax.lax.bitwise_xor, [0]),
-                jax.lax.reduce(ml, z, jax.lax.bitwise_xor, [0]),
-                jnp.sum(mh, dtype=jnp.uint32),
-                jnp.sum(ml, dtype=jnp.uint32),
-            ])
+        sent_dig = _fp_digest(hi, lo, valid)
 
-        sent_dig = fp_digest(hi, lo, valid)
-
-        if exchange == "all_to_all" and compress:
-            from ..ops import fpcompress as _fpc
-
-            Wr = max(32, W // 2)  # compact row budget (valid-first rows)
-            NWc = _fpc.default_stream_words(W)
-            owner = jnp.where(valid, (lo % jnp.uint32(D)).astype(jnp.int32), D)
-            s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
-            for d in range(D):
-                mask = owner == d
-                cnts.append(jnp.sum(mask, dtype=jnp.int32))
-                cpos = jnp.where(mask, jnp.cumsum(mask) - 1, W)
-                s_hi.append(jnp.full((W,), sent).at[cpos].set(hi))
-                s_lo.append(jnp.full((W,), sent).at[cpos].set(lo))
-                s_cand.append(jnp.zeros((W, K), jnp.uint32).at[cpos].set(cand))
-                s_par.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(parent_g))
-                s_act.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(actid))
-            b_hi = jnp.stack(s_hi)  # [D, W]
-            b_lo = jnp.stack(s_lo)
-            cnts_a = jnp.stack(cnts)  # [D]
-            # STABLE per-bucket fingerprint sort (vmapped: ONE batched
-            # sort program, not D copies — compile-time matters on this
-            # engine's many step shapes): sentinels (max u64) sink last,
-            # ties keep candidate order — the property the bit-identity
-            # argument in the docstring rests on
-            perm = jax.vmap(lambda h, l: jnp.lexsort((l, h)))(b_hi, b_lo)
-            b_hi = jnp.take_along_axis(b_hi, perm, axis=1)
-            b_lo = jnp.take_along_axis(b_lo, perm, axis=1)
-            b_cand = jnp.take_along_axis(
-                jnp.stack(s_cand), perm[:, :, None], axis=1
-            )
-            b_par = jnp.take_along_axis(jnp.stack(s_par), perm, axis=1)
-            b_act = jnp.take_along_axis(jnp.stack(s_act), perm, axis=1)
-            s_words, s_hdr, ovf_pack = jax.vmap(
-                lambda h, l, c: _fpc.pack_sorted(h, l, c, NWc)
-            )(b_hi, b_lo, cnts_a)
-            ovf_dest = jnp.any(cnts_a > W) | jnp.any(
-                ovf_pack | (cnts_a > Wr)
-            )
-            a2a = lambda x: jax.lax.all_to_all(  # noqa: E731
-                x, "d", split_axis=0, concat_axis=0, tiled=True
-            )
-            r_words = a2a(s_words)  # [D, NWc]
-            r_hdr = a2a(s_hdr)  # [D, HDR + NB]
-            r_cand_c = a2a(b_cand[:, :Wr])  # [D, Wr, K]
-            r_par_c = a2a(b_par[:, :Wr])
-            r_act_c = a2a(b_act[:, :Wr].astype(jnp.uint8))
-            # in-jit decode per source segment; the framing digest below
-            # runs over THESE decoded lanes, so fabric integrity covers
-            # the packed stream, the header and the codec
-            dec_hi, dec_lo = jax.vmap(
-                lambda wds, hd: _fpc.unpack_sorted(wds, hd, W)
-            )(r_words, r_hdr)
-            r_hi = dec_hi.reshape(R)
-            r_lo = dec_lo.reshape(R)
-            # compact rows pad back to W slots per source segment; the
-            # live rows are the first cnt of each (valid-first after the
-            # bucket sort), exactly aligned with the decoded lanes
-            r_cand = (
-                jnp.zeros((D, W, K), jnp.uint32)
-                .at[:, :Wr].set(r_cand_c)
-                .reshape(R, K)
-            )
-            r_parent = (
-                jnp.full((D, W), -1, jnp.int32)
-                .at[:, :Wr].set(r_par_c)
-                .reshape(R)
-            )
-            r_act = (
-                jnp.full((D, W), -1, jnp.int32)
-                .at[:, :Wr].set(r_act_c.astype(jnp.int32))
-                .reshape(R)
-            )
-        elif exchange == "all_to_all":
-            owner = jnp.where(valid, (lo % jnp.uint32(D)).astype(jnp.int32), D)
-            s_hi, s_lo, s_cand, s_par, s_act, cnts = [], [], [], [], [], []
-            for d in range(D):
-                mask = owner == d
-                cnt = jnp.sum(mask, dtype=jnp.int32)
-                cnts.append(cnt)
-                cpos = jnp.where(mask, jnp.cumsum(mask) - 1, W)
-                s_hi.append(jnp.full((W,), sent).at[cpos].set(hi))
-                s_lo.append(jnp.full((W,), sent).at[cpos].set(lo))
-                s_cand.append(jnp.zeros((W, K), jnp.uint32).at[cpos].set(cand))
-                s_par.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(parent_g))
-                s_act.append(jnp.full((W,), -1, jnp.int32).at[cpos].set(actid))
-            ovf_dest = jnp.any(jnp.stack(cnts) > W)
-            a2a = lambda x: jax.lax.all_to_all(  # noqa: E731
-                x, "d", split_axis=0, concat_axis=0, tiled=True
-            )
-            r_hi = a2a(jnp.stack(s_hi)).reshape(R)
-            r_lo = a2a(jnp.stack(s_lo)).reshape(R)
-            r_cand = a2a(jnp.stack(s_cand)).reshape(R, K)
-            r_parent = a2a(jnp.stack(s_par)).reshape(R)
-            r_act = a2a(jnp.stack(s_act)).reshape(R)
-        else:
-            ovf_dest = jnp.bool_(False)
-            r_hi = jax.lax.all_gather(hi, "d", tiled=True)  # [D*T]
-            r_lo = jax.lax.all_gather(lo, "d", tiled=True)
-            r_cand = jax.lax.all_gather(cand, "d", tiled=True)  # [D*T, K]
-            r_valid = jax.lax.all_gather(valid, "d", tiled=True)
-            r_parent = jax.lax.all_gather(parent_g, "d", tiled=True)
-            r_act = jax.lax.all_gather(actid, "d", tiled=True)
-            mine = r_valid & ((r_lo % jnp.uint32(D)).astype(jnp.int32) == me)
-            r_hi = jnp.where(mine, r_hi, sent)
-            r_lo = jnp.where(mine, r_lo, sent)
+        r_hi, r_lo, r_cand, r_parent, r_act, ovf_dest = route(
+            hi, lo, cand, parent_g, actid, valid, me
+        )
 
         # post-exchange framing digest over the received (non-sentinel)
         # candidates: across all shards the received multiset must be
@@ -372,7 +466,7 @@ def _make_sharded_step(
         # candidate to exactly one owner; all_gather + ownership filter
         # partitions the same set) — compared host-side per committed
         # chunk (overflowed attempts are discarded before the compare)
-        recv_dig = fp_digest(
+        recv_dig = _fp_digest(
             r_hi, r_lo, ~((r_hi == sent) & (r_lo == sent))
         )
 
@@ -467,14 +561,469 @@ def _make_sharded_step(
             recv_dig[None],
         )
 
+    # EXPLICIT per-tensor layouts (mesh_layouts): operands and results
+    # name which dim rides the mesh axis instead of the old implicit
+    # P("d")-for-everything (same placement, now spelled out and
+    # asserted in tests so a real-ICI mesh inherits it unchanged)
     sharded = _shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
-        out_specs=tuple([P("d")] * 20),
+        in_specs=(
+            P("d", None),  # frontier rows
+            P("d"),        # fvalid
+            P("d", None),  # visited hi lanes / hash slots
+            P("d", None),  # visited lo lanes / hash slots
+            P("d"),        # per-shard visited counts
+        ),
+        out_specs=(
+            P("d", None),  # compacted new rows [D*R, K]
+            P("d"),        # parents
+            P("d"),        # action ids
+            P("d"),        # per-shard new counts
+            P("d", None),  # updated visited hi
+            P("d", None),  # updated visited lo
+            P("d"),        # updated visited counts
+            P("d", None),  # viol_any [D, n_inv]
+            P("d", None),  # viol_idx [D, n_inv]
+            P("d"),        # deadlock any
+            P("d"),        # deadlock idx
+            P("d", None),  # act_en [D, n_actions]
+            P("d", None),  # ovf_expand [D, n_actions]
+            P("d", None),  # act_guard [D, n_actions]
+            P("d"),        # ovf_dest
+            P("d"),        # ovf_probe
+            P("d"),        # out_hi
+            P("d"),        # out_lo
+            P("d", None),  # sent framing digests [D, 5]
+            P("d", None),  # recv framing digests [D, 5]
+        ),
         **_SHARD_MAP_KW,
     )
     return jax.jit(sharded)
+
+
+def _grow_sorted_shards(dev_vhi, dev_vlo, vcap: int, new_cap: int,
+                        layout):
+    """Grow every shard's sorted visited pair set to `new_cap` slots
+    (sentinel-padded) — the one growth path for the per-chunk loop and
+    the device-resident level driver.  Multi-process takes the host
+    round trip (every process must contribute its shards); single-
+    process grows on device with no host copy."""
+    D = dev_vhi.shape[0]
+    if is_multiprocess():
+        grown_hi = fetch_global(dev_vhi)
+        grown_lo = fetch_global(dev_vlo)
+        pad = np.full(
+            (D, new_cap - grown_hi.shape[1]), 0xFFFFFFFF, np.uint32
+        )
+        dev_vhi = put_global(
+            np.concatenate([grown_hi, pad], axis=1), layout
+        )
+        dev_vlo = put_global(
+            np.concatenate([grown_lo, pad], axis=1), layout
+        )
+    else:
+        pad = jnp.full(
+            (D, new_cap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
+        )
+        dev_vhi = jax.device_put(
+            jnp.concatenate([dev_vhi, pad], axis=1), layout
+        )
+        dev_vlo = jax.device_put(
+            jnp.concatenate([dev_vlo, pad], axis=1), layout
+        )
+    return dev_vhi, dev_vlo, new_cap
+
+
+def _make_sharded_level(
+    model: Model,
+    mesh: Mesh,
+    expander: _Step,
+    B: int,
+    NCp: int,
+    vcap: int,
+    widths: tuple,
+    LN: int,
+    exchange: str,
+    dest_w: int,
+    compress: bool,
+    check_deadlock: bool,
+):
+    """The sharded device-resident LEVEL program: every gated chunk of a
+    BFS level runs inside ONE dispatched ``lax.while_loop`` per shard —
+    the PR 12 single-device level body composed with the per-chunk
+    collective exchange — so a level costs O(1) collective-bearing
+    launches per shard instead of O(chunks).
+
+    Per while_loop iteration (= one serial chunk), each shard:
+    dynamic-slices its chunk from the device-resident frontier buffer
+    [NCp*B, K] -> compacted expansion (make_expand's per-action in-jit
+    cumsum/scatter — the exact action-major candidate order of the
+    per-chunk path) -> fingerprints -> per-destination bucketing + the
+    ``all_to_all`` (or all_gather) exchange, with the PR 10 compression
+    codec in-loop when enabled (_make_exchange: ONE routing source with
+    the per-chunk step) -> DUAL-PROBE dedup of the received candidates
+    (stable lexsort winners vs the READ-ONLY visited shard AND a
+    device-resident per-shard level-new sorted set) -> in-jit
+    (count, xor, sum) digest folds (ops/devlevel) + framing-digest
+    accumulation -> dynamic-offset next-frontier append.  The
+    O(capacity) visited merge runs ONCE per shard after the loop.
+
+    Bit-identity with the per-chunk path holds chunk for chunk: the
+    routing, per-bucket stable sort and receiver lexsort are the same
+    traced code (_make_exchange), novelty against (visited ∪ level-new)
+    equals the per-chunk path's chunk-by-chunk merged visited set
+    (routing sends a fingerprint to the same owner shard every time),
+    and winners of equal fingerprints are decided by the same stable
+    sort over the same candidate order.  Verdict priority mirrors the
+    serial commit loop exactly — invariants beat deadlock within a
+    chunk, the first invariant (in declaration order) violated by ANY
+    shard wins, then the lowest shard — elected REPLICATED via
+    all_gather so the while_loop condition stays uniform across the
+    mesh (a collective inside a loop requires every participant to
+    agree on the trip count).  Overflow flags (expansion segment,
+    destination bucket / codec budget, level-new capacity) combine
+    replicated via pmax: an overflowing level stops committing and the
+    host re-dispatches ONCE from the pre-level visited state at exact
+    measured widths — <=2 launches per level per shard even then.
+
+    Returns the jitted program over global operands
+    (fbuf [D*NCp*B, K], flen [D], ncs [D], vhi/vlo [D, vcap], vn [D])
+    laid out per :func:`mesh_layouts`.
+    """
+    spec = model.spec
+    K = spec.num_lanes
+    D = mesh.devices.size
+    expand = expander.make_expand(B, widths)
+    T = expander.expand_width(B, widths)
+    W = dest_w
+    R = D * W if exchange == "all_to_all" else D * T
+    OC = LN + R  # output buffer: one chunk of append headroom past LN
+    F = NCp * B  # per-shard frontier buffer rows
+    n_actions = len(model.actions)
+    route = _make_exchange(D, W, R, K, exchange, compress)
+    from ..engine.pipeline import sorted_dedup_stage
+
+    def level_body(fbuf, flen, ncs, vhi, vlo, vn):  # kspec: traced
+        flen = flen[0]
+        ncs = ncs[0]
+        vhi, vlo, vn = vhi[0], vlo[0], vn[0]
+        me = jax.lax.axis_index("d")
+        sent = jnp.uint32(dedup.SENT)
+
+        def body(carry):  # kspec: traced
+            (i, orows, opar, oact, on, lhi, llo, ln,
+             vkind, vshard, vinv, vidx,
+             act_en, agmax, dig, s_acc, r_acc, ovf, nclean) = carry
+            start = i * B
+            rows = jax.lax.dynamic_slice(fbuf, (start, 0), (B, K))
+            fvalid = (
+                start + jnp.arange(B, dtype=jnp.int32)
+            ) < flen
+            states = jax.vmap(spec.unpack)(rows)
+            (en_pre, cand, valid, parent, actid, a_en, a_guard,
+             exp_ovf) = expand(states, fvalid)
+            deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
+            hi, lo = fingerprint_lanes(cand, spec.exact64)
+            hi = jnp.where(valid, hi, sent)
+            lo = jnp.where(valid, lo, sent)
+            # parent as a mesh-global LEVEL row id: src shard * F +
+            # (chunk offset + row) — the host decodes src_d = pg // F,
+            # level row = pg % F (chunk offsets are i*B by plan)
+            parent_g = me.astype(jnp.int32) * F + (start + parent)
+            sent_dig = _fp_digest(hi, lo, valid)
+            (r_hi, r_lo, r_cand, r_parent, r_act, ovf_dest) = route(
+                hi, lo, cand, parent_g, actid, valid, me
+            )
+            recv_dig = _fp_digest(
+                r_hi, r_lo, ~((r_hi == sent) & (r_lo == sent))
+            )
+            # the SHARED winner-selection sequence (one source of truth
+            # with the per-chunk paths): primary set = this shard's
+            # level-new sorted set (its ranks drive the gated merge
+            # below), also_seen_in = the read-only visited shard
+            (n_out, n_par, n_act, new_n, n_hi, n_lo, _l1, _l2, _l3,
+             n_rank) = sorted_dedup_stage(
+                r_cand, r_parent, r_act,
+                ~((r_hi == sent) & (r_lo == sent)),
+                r_hi, r_lo, lhi, llo, ln, LN, R, K, False,
+                also_seen_in=(vhi, vlo, vn),
+            )
+            # frontier verdicts, serial priority (the per-inv loop is
+            # the per-chunk step's exact semantics)
+            if model.invariants:
+                v_any, v_idx = [], []
+                for inv in model.invariants:
+                    ok = jax.vmap(inv.pred)(states)
+                    bad = fvalid & ~ok
+                    v_any.append(jnp.any(bad))
+                    v_idx.append(jnp.argmax(bad).astype(jnp.int32))
+                viol_any = jnp.stack(v_any)
+                viol_idx = jnp.stack(v_idx)
+            else:
+                viol_any = jnp.zeros((1,), bool)
+                viol_idx = jnp.zeros((1,), jnp.int32)
+            # REPLICATED verdict election: every shard derives the same
+            # winner from the gathered flags, so the loop condition
+            # stays uniform across the mesh
+            g_viol = jax.lax.all_gather(
+                viol_any[None], "d", tiled=True
+            )  # [D, n_inv]
+            g_vix = jax.lax.all_gather(viol_idx[None], "d", tiled=True)
+            dl_pair = jnp.stack([
+                jnp.any(deadlocked).astype(jnp.int32),
+                jnp.argmax(deadlocked).astype(jnp.int32),
+            ])
+            g_dl = jax.lax.all_gather(dl_pair[None], "d", tiled=True)
+            inv_any = jnp.any(g_viol)
+            inv_i = jnp.argmax(jnp.any(g_viol, axis=0)).astype(jnp.int32)
+            d_inv = jnp.argmax(g_viol[:, inv_i]).astype(jnp.int32)
+            dl_any = jnp.bool_(check_deadlock) & jnp.any(g_dl[:, 0] > 0)
+            d_dl = jnp.argmax(g_dl[:, 0]).astype(jnp.int32)
+            kind = jnp.where(
+                inv_any, jnp.int32(1),
+                jnp.where(dl_any, jnp.int32(2), jnp.int32(0)),
+            )
+            vd = jnp.where(inv_any, d_inv, d_dl)
+            vix_l = jnp.where(
+                inv_any, g_vix[d_inv, inv_i], g_dl[d_dl, 1]
+            ) + start
+            take = (vkind == 0) & (kind != 0)
+            commit = kind == 0  # a verdict chunk commits nothing
+            # REPLICATED overflow flags (pmax): every shard must agree
+            # on commit gating and the host's re-dispatch decision
+            ln_ovf = jax.lax.pmax(
+                (commit & ((ln + new_n) > LN)).astype(jnp.int32), "d"
+            ) > 0
+            this_ovf = jax.lax.pmax(
+                (jnp.any(exp_ovf) | ovf_dest).astype(jnp.int32), "d"
+            ) > 0
+            commit_ok = commit & ~ovf & ~ln_ovf
+            # framing accumulates for every chunk the serial path would
+            # have COMPARED: clean chunks, including a verdict chunk
+            # (the serial commit checks framing before the verdict)
+            clean = ~ovf & ~this_ovf & ~ln_ovf
+            app_n = jnp.where(commit_ok, new_n, 0)
+            orows = devlevel.append_rows(orows, n_out, on)
+            opar = devlevel.append_vec(opar, n_par, on)
+            oact = devlevel.append_vec(oact, n_act, on)
+            lhi, llo, ln = dedup.merge_ranked(
+                lhi, llo, ln, n_hi, n_lo, n_rank, app_n, LN
+            )
+            dig = devlevel.combine_digest(
+                dig,
+                devlevel.masked_digest(
+                    n_hi, n_lo, jnp.arange(R) < app_n
+                ),
+            )
+            s_acc = _acc_digest(s_acc, sent_dig, clean)
+            r_acc = _acc_digest(r_acc, recv_dig, clean)
+            act_en = act_en + jnp.where(commit_ok, a_en, 0)
+            agmax = jnp.maximum(agmax, a_guard)
+            nclean = nclean + jnp.where(clean, 1, 0)
+            ovf = ovf | this_ovf | ln_ovf
+            return (i + 1, orows, opar, oact, on + app_n,
+                    lhi, llo, ln,
+                    jnp.where(take, kind, vkind),
+                    jnp.where(take, vd, vshard),
+                    jnp.where(take, inv_i, vinv),
+                    jnp.where(take, vix_l, vidx),
+                    act_en, agmax, dig, s_acc, r_acc, ovf, nclean)
+
+        def cond(carry):  # kspec: traced
+            return (carry[0] < ncs) & (carry[8] == 0)
+
+        init = (
+            jnp.int32(0),
+            jnp.zeros((OC, K), jnp.uint32),
+            jnp.full((OC,), -1, jnp.int32),
+            jnp.full((OC,), -1, jnp.int32),
+            jnp.int32(0),
+            jnp.full((LN,), sent),
+            jnp.full((LN,), sent),
+            jnp.int32(0),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            jnp.zeros((n_actions,), jnp.int32),
+            jnp.zeros((n_actions,), jnp.int32),
+            devlevel.zero_digest(),
+            jnp.zeros((5,), jnp.uint32),
+            jnp.zeros((5,), jnp.uint32),
+            jnp.bool_(False),
+            jnp.int32(0),
+        )
+        (_i, orows, opar, oact, on, lhi, llo, _ln, vkind, vshard,
+         vinv, vidx, act_en, agmax, dig, s_acc, r_acc, ovf,
+         nclean) = jax.lax.while_loop(cond, body, init)
+        # ONE O(capacity) merge per shard per level (the per-chunk path
+        # pays one per chunk): every level-new entry is disjoint from
+        # the visited shard by construction, so the rank-scatter merge
+        # of the sorted level-new prefix lands the identical sorted
+        # visited array
+        _s, rank_v = dedup.rank_sorted(vhi, vlo, vn, lhi, llo)
+        vhi, vlo, vn = dedup.merge_ranked(
+            vhi, vlo, vn, lhi, llo, rank_v, on, vcap
+        )
+        dc, dxh, dxl, dlimbs = dig
+        return (
+            orows,  # [OC, K] -> [D*OC, K]
+            opar,
+            oact,
+            on[None],
+            vhi[None],
+            vlo[None],
+            vn[None],
+            vkind[None], vshard[None], vinv[None], vidx[None],
+            act_en[None],  # [1, n_actions]
+            agmax[None],
+            dc[None], dxh[None], dxl[None],  # digest accumulator...
+            dlimbs[None],  # ... (count, xors, 16-bit sum limbs)
+            s_acc[None], r_acc[None],  # [1, 5] framing accumulators
+            ovf[None],
+            nclean[None],
+        )
+
+    sharded = _shard_map(
+        level_body,
+        mesh=mesh,
+        in_specs=(
+            P("d", None),  # frontier buffer rows [D*F, K]
+            P("d"),        # per-shard pending lengths
+            P("d"),        # per-shard (replicated-value) chunk counts
+            P("d", None),  # visited hi lanes
+            P("d", None),  # visited lo lanes
+            P("d"),        # per-shard visited counts
+        ),
+        out_specs=(
+            P("d", None),  # next-frontier rows [D*OC, K]
+            P("d"),        # parents (mesh-global level row ids)
+            P("d"),        # action ids
+            P("d"),        # per-shard new counts
+            P("d", None),  # merged visited hi
+            P("d", None),  # merged visited lo
+            P("d"),        # merged visited counts
+            P("d"), P("d"), P("d"), P("d"),  # verdict kind/shard/inv/idx
+            P("d", None),  # act_en [D, n_actions]
+            P("d", None),  # agmax [D, n_actions]
+            P("d"), P("d"), P("d"),  # digest count/xor_hi/xor_lo
+            P("d", None),  # digest sum limbs [D, 4]
+            P("d", None),  # sent framing accumulator [D, 5]
+            P("d", None),  # recv framing accumulator [D, 5]
+            P("d"),        # replicated overflow flag
+            P("d"),        # clean (counted) chunks
+        ),
+        **_SHARD_MAP_KW,
+    )
+    return jax.jit(sharded)
+
+
+class ShardedDeviceLevel:
+    """Policy/state holder for the sharded device-resident level path
+    (`--pipeline device` + visited_backend="device"): the preconditions,
+    the serial-chunking plan, and the width/level-new sizing ladders.
+    The dispatch/commit driver lives in check_sharded (it needs the
+    engine loop's locals); this object is what survives across levels.
+
+    Preconditions mirror the single-device DevicePipeline: the
+    sorted-set device visited backend AND analyzer-proven per-field
+    value hulls (engine.pipeline.device_hull_fallback — a HARD
+    precondition, the in-jit pack stage has no host visibility between
+    chunks).  Any unmet precondition or compile/dispatch failure sets
+    `fallback` (sticky) and the run degrades to the per-chunk sharded
+    ladder — results identical, launches O(chunks)."""
+
+    def __init__(self, model: Model, mesh: Mesh, expander: _Step,
+                 adapt: AdaptiveCompact, visited_backend: str,
+                 check_deadlock: bool):
+        from ..engine.pipeline import PooledWidths, device_hull_fallback
+
+        self.model = model
+        self.mesh = mesh
+        self.expander = expander
+        self.adapt = adapt
+        self.check_deadlock = check_deadlock
+        self.pool = PooledWidths(model.actions)
+        self._ln_hw = 0  # per-level new-state high water (LN ladder)
+        self.levels = 0  # levels actually run device-resident
+        self.launches_last = 0
+        self.fallback: Optional[str] = None
+        if visited_backend != "device":
+            self.fallback = (
+                f"visited backend {visited_backend!r} is not the "
+                f"device-resident sorted set"
+            )
+        else:
+            self.fallback = device_hull_fallback(model)
+
+    def _gated(self, B: int) -> bool:
+        """The serial path must run the compacted (action-major)
+        expansion at this bucket — below the gate it runs the full
+        lattice in state-major order, which only the per-chunk path
+        produces (the same bit-identity guard as the single-device
+        plan_level)."""
+        w = self.adapt.widths_for(B)
+        if w is None:
+            return False
+        if isinstance(w, int):
+            return _norm_shift(B, w) != 0
+        return True
+
+    def plan_level(self, lens, chunk: int, min_bucket: int):
+        """-> (B, n_chunks) when the level program can serve (a prefix
+        of) this level's serial chunks, else None.  The plan mirrors
+        check_sharded's serial chunking EXACTLY: the serial bucket is
+        min(next_pow2(max(rem, min_bucket//D, 32)), chunk) with rem the
+        max remaining rows over shards — the device program covers the
+        prefix of chunks whose serial bucket equals the uniform program
+        bucket; a smaller-bucket tail runs through the per-chunk loop
+        at its serial offsets afterwards (bit-identity)."""
+        if self.fallback is not None:
+            return None
+        D = self.mesh.devices.size
+        rem = max(lens) if lens else 0
+        if rem <= 0:
+            return None
+        mb = max(min_bucket // D, 32)
+        if rem <= chunk:
+            B = min(_next_pow2(max(rem, mb)), chunk)
+            return (B, 1) if self._gated(B) else None
+        if not self._gated(chunk):
+            return None
+        nfull, r = 0, rem
+        while r > 0 and min(_next_pow2(max(r, mb)), chunk) == chunk:
+            nfull += 1
+            r -= chunk
+        return (chunk, nfull) if nfull else None
+
+    def widths(self, B: int):
+        n = len(self.model.actions)
+        return self.expander.norm_widths(
+            B, self.pool.widths_for(B, np.zeros(n), B)
+        )
+
+    def exact_widths(self, B: int, agmax: np.ndarray):
+        return self.expander.norm_widths(
+            B, self.pool.widths_for(B, agmax.astype(np.float64), B)
+        )
+
+    def observe(self, agmax: np.ndarray, B: int, new_total_max: int
+                ) -> None:
+        """Fold one committed level's measured maxima into the sizing
+        ladders (pool widths + the shared LN high-water)."""
+        np.maximum(
+            self.pool.hw, agmax.astype(np.float64) / max(B, 1),
+            out=self.pool.hw,
+        )
+        self._ln_hw = max(self._ln_hw, int(new_total_max))
+        self.levels += 1
+
+    def mark_fallback(self, reason: str, depth: int) -> None:
+        self.fallback = reason
+        from ..obs import tracer as _obs_t
+
+        _obs_t.event(
+            "pipeline-fallback", depth=depth, pipeline="sharded-device",
+            to="per-chunk", error=reason[:200],
+        )
 
 
 def _elastic_reshard(
@@ -671,6 +1220,7 @@ def check_sharded(
     checkpoint_keep: int = 3,
     stats_path: Optional[str] = None,
     compact_shift: int = 2,
+    compact_gate: int = 1024,
     exchange: str = "all_to_all",
     visited_backend: str = "device",
     mem_budget=None,
@@ -680,6 +1230,7 @@ def check_sharded(
     run=None,
     shard_heartbeat_dir: Optional[str] = None,
     overlap: Optional[bool] = None,
+    pipeline: Optional[str] = None,
 ) -> CheckResult:
     """Exhaustive sharded BFS over `mesh` (default: 1-D mesh of all devices).
 
@@ -721,7 +1272,10 @@ def check_sharded(
 
     compact_shift: two-phase expansion (see engine.check) — guards sweep the
     full lattice, update/pack/sort/exchange run at 1/2^shift of it.  0
-    disables.  exchange: "all_to_all" (bucket-by-owner routing, per-shard
+    disables.  compact_gate: the bucket size below which chunks run the
+    full (uncompacted) lattice — this engine's historical 1024; exposed
+    (like engine.check's compact_gate) so tests can force small gated
+    chunks through the compacted and device-resident paths.  exchange: "all_to_all" (bucket-by-owner routing, per-shard
     ICI traffic independent of mesh size) or "all_gather" (every shard sees
     every candidate — D× the bytes, simple fallback).  Both are exact; any
     buffer overflow is detected on device and the chunk re-runs wider.
@@ -783,6 +1337,27 @@ def check_sharded(
     breaching process exits typed, its peers wedge in the next
     collective, and the fleet supervisor classifies the rc-75 exit as a
     resource verdict instead of restarting into the same full disk.
+
+    pipeline: level-pipeline selection (--pipeline / $KSPEC_PIPELINE;
+    `cli pipelines --list` shows the per-ENGINE support matrix).  In
+    this engine "device" selects the SHARDED DEVICE-RESIDENT LEVEL
+    path: with visited_backend="device" and analyzer-proven per-field
+    value hulls (engine.pipeline.device_hull_fallback — the same HARD
+    precondition as the single-device device pipeline), each shard runs
+    an entire level's worth of gated chunks inside ONE dispatched
+    ``lax.while_loop`` program (expansion, the per-chunk collective
+    exchange + compression codec, dual-probe dedup against the
+    read-only visited shard + a per-shard level-new sorted set, in-jit
+    digest folds), so a level costs O(1) collective-bearing launches
+    per shard instead of O(chunks), with the O(capacity) visited merge
+    paid once per level per shard — bit-identical to the per-chunk
+    path (counts, duplicate accounting, first-violation rule, trace
+    values, digest chains).  Unmet preconditions, sub-gate tail chunks
+    and compile/dispatch failures degrade to the per-chunk sharded
+    ladder (sticky, `pipeline-fallback` event, stats["device"]);
+    "legacy" (and "fused", which has no sharded variant) run the
+    per-chunk path — the bit-identity oracle.  Unknown names are
+    rejected loudly (pipeline_registry.resolve_pipeline).
     """
     # encoding-soundness gate (analysis; KSPEC_ANALYZE=0 disables) —
     # same refusal contract as engine.check, memoized per model name
@@ -995,8 +1570,23 @@ def check_sharded(
     # sizes, so every process computes identical widths (replicated-
     # deterministic — the shard_map operands stay in lockstep).  The
     # sharded bucket gate stays at this engine's historical 1024.
-    adapt = AdaptiveCompact(model.actions, compact_shift, bucket_gate=1024)
+    adapt = AdaptiveCompact(model.actions, compact_shift,
+                            bucket_gate=compact_gate)
     adaptive_fallback = False
+
+    # level-pipeline selection (pipeline_registry: loud rejection of
+    # typos — the sharded engine no longer silently ignores --pipeline).
+    # "device" arms the sharded device-resident level path below; every
+    # other registered name runs the per-chunk step (the registry's
+    # per-engine matrix documents which combinations degrade and why)
+    pipe_name = resolve_pipeline(pipeline)
+    sdev = (
+        ShardedDeviceLevel(
+            model, mesh, expander, adapt, visited_backend, check_deadlock
+        )
+        if pipe_name == "device"
+        else None
+    )
 
     def _shard_density(act_guard_np, took):
         """Per-state guard density for the policy: max over shards of
@@ -1369,10 +1959,14 @@ def check_sharded(
         chain.fold(_integ.pair_u64(hi0, lo0))
         chain.seal(0, n0)
 
-    shard1 = NamedSharding(mesh, P("d"))
-    dev_vhi = put_global(vhi, shard1)
-    dev_vlo = put_global(vlo, shard1)
-    dev_vn = put_global(vn, shard1)
+    # explicit per-tensor mesh layouts (mesh_layouts; asserted in
+    # tests/test_sharded_device.py): shard1 keeps its historical name as
+    # the [D, cap] per-shard-table layout for the growth helpers
+    layouts = mesh_layouts(mesh)
+    shard1 = layouts["fpset"]
+    dev_vhi = put_global(vhi, layouts["fpset"])
+    dev_vlo = put_global(vlo, layouts["fpset"])
+    dev_vn = put_global(vn, layouts["pershard"])
 
     # async-checkpoint bookkeeping (KSPEC_OVERLAP; mirrors engine.bfs):
     # `last_ckpt_depth` = submitted, `ckpt_durable_depth` = promoted.
@@ -1825,6 +2419,10 @@ def check_sharded(
             lvl_en_per_shard = np.zeros(D, np.int64)
             lvl_recv_per_shard = np.zeros(D, np.int64)
             lvl_exch_bytes = lvl_exch_raw_bytes = 0
+            # dispatched collective-bearing programs this level — one
+            # launch PER SHARD each (the kspec_shard_launches_level
+            # gauge and the device path's O(1)/level contract)
+            lvl_dispatches = 0
             offs = [0] * D
             # base offset of each shard's rows in this level's shard-major order
             prev_base = np.concatenate([[0], np.cumsum([p.shape[0] for p in pending])])
@@ -1866,6 +2464,7 @@ def check_sharded(
                 results stay exact at every width.  Width retries are
                 CHUNK-LOCAL (learned floors persist)."""
                 nonlocal vcap, dev_vhi, dev_vlo, chunk, adaptive_fallback
+                nonlocal lvl_dispatches
                 if compress is None:
                     compress = compress_on
                 bucket = ctx[0]
@@ -1886,34 +2485,13 @@ def check_sharded(
                             )
                     if visited_backend == "device":
                         # grow per-shard visited capacity for the worst-case merge
+                        # (one shared growth path with the device level driver)
                         need = int(fetch_global(dev_vn).max()) + R
                         if need > vcap:
-                            vcap = _next_pow2(need)
-                            if is_multiprocess():
-                                # host round-trip: every process needs the full
-                                # global array to contribute its shards
-                                grown_hi = fetch_global(dev_vhi)
-                                grown_lo = fetch_global(dev_vlo)
-                                pad = np.full(
-                                    (D, vcap - grown_hi.shape[1]), 0xFFFFFFFF, np.uint32
-                                )
-                                dev_vhi = put_global(
-                                    np.concatenate([grown_hi, pad], axis=1), shard1
-                                )
-                                dev_vlo = put_global(
-                                    np.concatenate([grown_lo, pad], axis=1), shard1
-                                )
-                            else:
-                                # single-process: grow on device, no host copy
-                                pad = jnp.full(
-                                    (D, vcap - dev_vhi.shape[1]), 0xFFFFFFFF, jnp.uint32
-                                )
-                                dev_vhi = jax.device_put(
-                                    jnp.concatenate([dev_vhi, pad], axis=1), shard1
-                                )
-                                dev_vlo = jax.device_put(
-                                    jnp.concatenate([dev_vlo, pad], axis=1), shard1
-                                )
+                            dev_vhi, dev_vlo, vcap = _grow_sorted_shards(
+                                dev_vhi, dev_vlo, vcap, _next_pow2(need),
+                                layouts["fpset"],
+                            )
 
                     key = (bucket, vcap, ca, exchange, W, compress)
                     try:
@@ -1939,13 +2517,18 @@ def check_sharded(
                             )
                         outs = steps[key](
                             put_global(
-                                ctx[1].reshape(D * bucket, K), shard1
+                                ctx[1].reshape(D * bucket, K),
+                                layouts["frontier"],
                             ),
-                            put_global(ctx[4].reshape(D * bucket), shard1),
+                            put_global(
+                                ctx[4].reshape(D * bucket),
+                                layouts["fvalid"],
+                            ),
                             dev_vhi,
                             dev_vlo,
                             dev_vn,
                         )
+                        lvl_dispatches += 1
                     except Exception as e:  # noqa: BLE001 — XLA compile/run
                         # one failure policy for both engines (resilience
                         # .retry.ChunkRetryHandler): transient -> bounded-
@@ -2081,24 +2664,14 @@ def check_sharded(
                     if sp:
                         rd[sp.shard if sp.shard is not None else 0, 1] ^= 0x10
                     _integ.count_check()
-
-                    def _combine(dig):
-                        s64 = dig.astype(np.uint64)
-                        return (
-                            int(dig[:, 0].astype(np.int64).sum()),
-                            int(np.bitwise_xor.reduce(dig[:, 1])),
-                            int(np.bitwise_xor.reduce(dig[:, 2])),
-                            int(s64[:, 3].sum() & np.uint64(0xFFFFFFFF)),
-                            int(s64[:, 4].sum() & np.uint64(0xFFFFFFFF)),
-                        )
-
-                    if _combine(sd) != _combine(rd):
+                    if _combine_digs(sd) != _combine_digs(rd):
                         raise IntegrityError(
                             "exchange",
                             f"exchange payload framing mismatch at level "
-                            f"{depth + 1}: sent digest {_combine(sd)} != "
-                            f"received {_combine(rd)} ({exchange}; a "
-                            f"routed fingerprint was corrupted in flight)",
+                            f"{depth + 1}: sent digest {_combine_digs(sd)} "
+                            f"!= received {_combine_digs(rd)} ({exchange}; "
+                            f"a routed fingerprint was corrupted in "
+                            f"flight)",
                             depth=depth,
                         )
                 # adapt buffer sizing from the committed attempt's guard counts
@@ -2217,6 +2790,264 @@ def check_sharded(
                     lvl_en_per_shard += act_en_np.sum(axis=1)
                 return False
 
+            def _run_device_level():
+                """The sharded device-resident level path (--pipeline
+                device): dispatch ONE _make_sharded_level program
+                covering this level's full-size serial chunks, with the
+                <=1 exact-bound re-dispatch on overflow, then commit its
+                outputs exactly as the per-chunk commits would have —
+                O(1) collective-bearing launches per shard per level.
+                On success `offs` advances past the handled prefix so
+                the per-chunk loop below runs only the (sub-bucket)
+                tail at its serial offsets; on failure it marks the
+                sticky fallback and leaves offs untouched (the
+                per-chunk ladder runs the whole level)."""
+                nonlocal vcap, dev_vhi, dev_vlo, dev_vn, verdict
+                nonlocal lvl_act_en, lvl_new_per_shard, lvl_en_per_shard
+                nonlocal lvl_recv_per_shard, shard_visited
+                nonlocal lvl_exch_bytes, lvl_exch_raw_bytes
+                nonlocal lvl_dispatches
+                lens = [p.shape[0] for p in pending]
+                plan = sdev.plan_level(lens, chunk, min_bucket)
+                if plan is None:
+                    return
+                B, nc = plan
+                NCp = _next_pow2(nc)
+                F = NCp * B
+                chunk_retry.reset_chunk()
+                widths = sdev.widths(B)
+                T = expander.expand_width(B, widths)
+                W = _default_dest_w(T, D)
+                R = D * W if exchange == "all_to_all" else D * T
+                # level-new ladder: ONE sizing policy with the single-
+                # device device pipeline (ops/devlevel)
+                LN = devlevel.level_new_capacity(T, sdev._ln_hw, nc * R)
+                compress = compress_on
+                exact = False
+                dispatched = 0
+                t0l = time.perf_counter()
+                # only the handled prefix rides the device buffer; a
+                # smaller-bucket serial tail runs per-chunk afterwards
+                fbuf = np.zeros((D, F, K), np.uint32)
+                flen = np.zeros(D, np.int32)
+                for d in range(D):
+                    n = min(nc * B, lens[d])
+                    fbuf[d, :n] = pending[d][:n]
+                    flen[d] = n
+                pre_v = (dev_vhi, dev_vlo, dev_vn)
+                while True:
+                    try:
+                        injected = fault.chunk_error(escalated=True)
+                        if injected is not None:
+                            raise injected
+                        need = int(fetch_global(pre_v[2]).max()) + min(
+                            nc * R, LN + R
+                        )
+                        if need > vcap:
+                            g_hi, g_lo, vcap = _grow_sorted_shards(
+                                pre_v[0], pre_v[1], vcap,
+                                _next_pow2(need), layouts["fpset"],
+                            )
+                            pre_v = (g_hi, g_lo, pre_v[2])
+                        key = ("lvl", B, NCp, vcap, widths, LN, W,
+                               exchange, compress)
+                        if key not in steps:
+                            steps[key] = _make_sharded_level(
+                                model, mesh, expander, B, NCp, vcap,
+                                widths, LN, exchange, W, compress,
+                                check_deadlock,
+                            )
+                        outs = steps[key](
+                            put_global(
+                                fbuf.reshape(D * F, K),
+                                layouts["frontier"],
+                            ),
+                            put_global(flen, layouts["pershard"]),
+                            put_global(
+                                np.full(D, nc, np.int32),
+                                layouts["pershard"],
+                            ),
+                            pre_v[0], pre_v[1], pre_v[2],
+                        )
+                        dispatched += 1
+                        lvl_dispatches += 1
+                        # the one device sync per level: the overflow-
+                        # flag read forces the whole level program
+                        overflow = bool(fetch_global(outs[19]).any())
+                    except Exception as e:  # noqa: BLE001 — XLA
+                        action = chunk_retry.handle(
+                            e, escalated=True, depth=depth,
+                            retry_transient=not is_multiprocess(),
+                        )
+                        if action == "retry":
+                            continue
+                        sdev.mark_fallback(
+                            f"{type(e).__name__}: {e}"[:200], depth
+                        )
+                        return
+                    agmax_np = fetch_global(outs[12]).max(axis=0).astype(
+                        np.int64
+                    )
+                    vk = int(fetch_global(outs[7])[0])
+                    if overflow and vk == 0 and not exact:
+                        # a segment / destination bucket / codec budget
+                        # / the level-new set overflowed: outputs are
+                        # incomplete — discard and re-dispatch ONCE from
+                        # the pre-level visited state at exact measured
+                        # widths, full per-destination width (the raw
+                        # wire cannot overflow at W == T) and the safe
+                        # level-new bound: <=2 launches per shard per
+                        # level even on overflow levels.  A verdict
+                        # overrides: it derives from frontier states
+                        # only, so it is exact regardless.
+                        widths = sdev.exact_widths(B, agmax_np)
+                        T = expander.expand_width(B, widths)
+                        W = T
+                        R = D * W if exchange == "all_to_all" else D * T
+                        LN = devlevel.level_new_bound(nc * R)
+                        compress = False  # only codec budgets overflow at W==T
+                        exact = True
+                        continue
+                    break
+                # committed: install the merged visited arrays
+                dev_vhi, dev_vlo, dev_vn = outs[4], outs[5], outs[6]
+                counts = fetch_global(outs[3]).astype(np.int64)  # [D]
+                sdev.observe(agmax_np, B, int(counts.max()))
+                sdev.launches_last = dispatched
+                adapt.observe(agmax_np.astype(np.float64) / max(B, 1))
+                # exchange framing check over the LEVEL-accumulated
+                # digests (count/xor/sum accumulate commutatively, so
+                # one compare per level detects exactly what the
+                # per-chunk compares detect).  A committed overflow
+                # only reaches here under a verdict override; the
+                # accumulators then cover the clean pre-overflow chunk
+                # prefix (the `clean` mask is replicated, so every
+                # shard accumulated the same subset) — compared anyway:
+                # a corruption in those chunks must still alarm, it
+                # must never be laundered by a later verdict
+                if chain is not None:
+                    sd = np.asarray(fetch_global(outs[17]), np.uint32)
+                    rd = np.array(fetch_global(outs[18]), np.uint32)
+                    sp = fault.flip(
+                        "exchange", depth + 1,
+                        ckpt_depth=ckpt_durable_depth,
+                    )
+                    if sp:
+                        rd[sp.shard if sp.shard is not None else 0,
+                           1] ^= 0x10
+                    _integ.count_check()
+                    if _combine_digs(sd) != _combine_digs(rd):
+                        raise IntegrityError(
+                            "exchange",
+                            f"exchange payload framing mismatch across "
+                            f"level {depth + 1}: sent digest "
+                            f"{_combine_digs(sd)} != received "
+                            f"{_combine_digs(rd)} ({exchange}, device "
+                            f"level program; a routed fingerprint was "
+                            f"corrupted in flight)",
+                            depth=depth,
+                        )
+                obs_.chunk_span(
+                    "exchange-level",
+                    time.perf_counter() - t0l,
+                    depth=depth,
+                    bucket=B,
+                    chunks=nc,
+                    launches=dispatched,
+                    exchange=exchange,
+                    compressed=compress,
+                )
+                # wire accounting: nclean counted chunks at the
+                # committed dispatch's widths (same per-chunk formulas
+                # as the per-chunk path)
+                if exchange == "all_to_all":
+                    ncl = int(fetch_global(outs[20])[0])
+                    raw_b = D * D * W * (8 + 4 * K + 4 + 4)
+                    if compress:
+                        from ..ops import fpcompress as _fpc
+
+                        Wr = max(32, W // 2)
+                        sent_b = D * D * (
+                            4 * _fpc.default_stream_words(W)
+                            + 4 * _fpc.header_words(W)
+                            + Wr * (4 * K + 4 + 1)
+                        )
+                    else:
+                        sent_b = raw_b
+                    lvl_exch_bytes += ncl * sent_b
+                    lvl_exch_raw_bytes += ncl * raw_b
+                if vk:
+                    d = int(fetch_global(outs[8])[0])
+                    inv_i = int(fetch_global(outs[9])[0])
+                    lidx = int(fetch_global(outs[10])[0])
+                    gidx = int(prev_base[d] + lidx)
+                    name = (
+                        model.invariants[inv_i].name
+                        if vk == 1
+                        else "Deadlock"
+                    )
+                    verdict = (name, pending[d][lidx], gidx)
+                    for d2 in range(D):
+                        # the serial break: the tail is never dispatched
+                        offs[d2] = lens[d2]
+                    return
+                OC = LN + R
+                cmax = int(counts.max())
+                if cmax:
+                    out3 = fetch_global(
+                        outs[0].reshape(D, OC, K)[:, :cmax]
+                    )
+                    if collect_trace:
+                        par3 = fetch_global(
+                            outs[1].reshape(D, OC)[:, :cmax]
+                        )
+                        act3 = fetch_global(
+                            outs[2].reshape(D, OC)[:, :cmax]
+                        )
+                for d in range(D):
+                    c = int(counts[d])
+                    if not c:
+                        continue
+                    next_pending[d].append(out3[d, :c])
+                    if collect_trace:
+                        pg = par3[d, :c].astype(np.int64)
+                        # mesh-global level row ids -> level-global
+                        # indices in shard-major order (the plan's
+                        # chunk offsets are i*B, already inside pg)
+                        next_parent[d].append(
+                            prev_base[pg // F] + (pg % F)
+                        )
+                        next_act[d].append(act3[d, :c].astype(np.int64))
+                if chain is not None:
+                    # per-shard in-jit chain folds: the device-computed
+                    # (count, xor, sum) accumulators fold bit-exactly
+                    # like the per-chunk host folds over the same rows
+                    _integ.fold_shard_device_digests(
+                        chain,
+                        fetch_global(outs[13]),
+                        fetch_global(outs[14]),
+                        fetch_global(outs[15]),
+                        fetch_global(outs[16]),
+                    )
+                lvl_new_per_shard += counts
+                lvl_recv_per_shard += counts
+                shard_visited += counts
+                if obs_.collect:
+                    act_en_np = fetch_global(outs[11]).astype(np.int64)
+                    lvl_act_en += act_en_np.sum(axis=0)
+                    lvl_en_per_shard += act_en_np.sum(axis=1)
+                for d in range(D):
+                    offs[d] = min(nc * B, lens[d])
+
+            if sdev is not None and sdev.fallback is None:
+                # Device-resident level path: one dispatched while_loop
+                # program per shard covers every full-size gated chunk
+                # of this level; the per-chunk loop below then runs only
+                # the remaining serial tail (or, on fallback, the whole
+                # level) — bit-identical either way.
+                governor.poll(depth)
+                _run_device_level()
+
             # Staged commit (KSPEC_OVERLAP, host backend only — the at-
             # scale configuration; device backends chain each chunk's
             # visited arrays through the step, so their chunks serialize
@@ -2321,11 +3152,18 @@ def check_sharded(
                     **rec,
                     "exch_bytes": int(lvl_exch_bytes),
                     "exch_raw_bytes": int(lvl_exch_raw_bytes),
+                    # dispatched collective-bearing programs this level
+                    # (= launches PER SHARD; in-memory only, like the
+                    # launch counters of the single-device engine)
+                    "shard_launches": int(lvl_dispatches),
                     "io_hidden_ms": round(
                         max(0.0, (busy1 - lvl_io0[0])
                             - (blk1 - lvl_io0[1])) * 1e3, 2),
                     "io_exposed_ms": round((blk1 - lvl_io0[1]) * 1e3, 2),
                 })
+                _met.set_gauge(
+                    "kspec_shard_launches_level", int(lvl_dispatches)
+                )
                 if lvl_exch_raw_bytes:
                     _met.set_gauge(
                         "kspec_exchange_bytes_level", int(lvl_exch_bytes)
@@ -2526,6 +3364,22 @@ def check_sharded(
             "fanout": C,
             "visited_backend": visited_backend,
             "exchange": exchange,
+            "pipeline": pipe_name,
+            # explicit mesh-axis layouts (mesh_layouts): recorded so a
+            # run artifact names the placement every tensor class used
+            "mesh_layouts": {
+                k: str(v.spec) for k, v in layouts.items()
+            },
+            **(
+                {
+                    "device": {
+                        "levels": sdev.levels,
+                        "fallback": sdev.fallback,
+                    }
+                }
+                if sdev is not None
+                else {}
+            ),
             "adaptive_active": adapt.active,
             "adaptive_compile_fallback": adaptive_fallback,
             "transient_retries": chunk_retry.retries_total,

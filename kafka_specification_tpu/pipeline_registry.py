@@ -15,8 +15,17 @@ import os
 
 PIPELINE_ENV = "KSPEC_PIPELINE"
 
+#: the two engines a pipeline selection can land on (`--sharded` picks
+#: the second) — keys of every registry entry's per-engine support matrix
+ENGINES = ("single-device", "sharded")
+
 #: name -> registry entry; insertion order is the display order and the
-#: degradation ladder reads right-to-left (device -> fused -> legacy)
+#: degradation ladder reads right-to-left (device -> fused -> legacy).
+#: Each entry's "engines" matrix states, PER ENGINE, whether the name
+#: selects a distinct implementation there and why/when the combination
+#: degrades — the sharded engine used to silently ignore --pipeline;
+#: now every (pipeline, engine) cell is documented and queryable
+#: (`cli pipelines --list/--json`).
 PIPELINE_REGISTRY = {
     "device": {
         "launches": "<=2 successor launches per LEVEL",
@@ -32,6 +41,35 @@ PIPELINE_REGISTRY = {
             "per-field value hulls; anything else degrades to 'fused'"
         ),
         "fallback": "fused",
+        "engines": {
+            "single-device": {
+                "supported": True,
+                "detail": (
+                    "one lax.while_loop program per level, <=2 successor "
+                    "launches/level; degrades to 'fused' per-chunk on "
+                    "host/device-hash visited backends, disk tier, "
+                    "sub-gate chunks, shadow re-execution, unproven "
+                    "field hulls, or compile failure"
+                ),
+            },
+            "sharded": {
+                "supported": True,
+                "detail": (
+                    "per-shard one-dispatch level programs: each shard "
+                    "runs a whole level's gated chunks — expansion, the "
+                    "per-chunk all_to_all/all_gather exchange (+ the "
+                    "compression codec), dual-probe dedup against a "
+                    "read-only visited shard + a per-shard level-new "
+                    "set, in-jit digest folds — inside ONE dispatched "
+                    "program: O(1) collective-bearing launches per "
+                    "level per shard, the O(capacity) visited merge "
+                    "once per level per shard.  Requires "
+                    "visited_backend=device + proven field hulls; "
+                    "degrades to the per-chunk sharded step otherwise "
+                    "(sharded-device -> per-chunk -> legacy ladder)"
+                ),
+            },
+        },
     },
     "fused": {
         "launches": "2 successor launches per chunk",
@@ -43,6 +81,22 @@ PIPELINE_REGISTRY = {
             "failure degrades the run to 'legacy'"
         ),
         "fallback": "legacy",
+        "engines": {
+            "single-device": {
+                "supported": True,
+                "detail": "the default single-device path",
+            },
+            "sharded": {
+                "supported": False,
+                "detail": (
+                    "runs the per-chunk sharded step: expansion + "
+                    "exchange are already ONE monolithic jitted program "
+                    "per chunk in this engine, so there is no separate "
+                    "fused variant to select — the name degrades to the "
+                    "per-chunk path (identical results)"
+                ),
+            },
+        },
     },
     "legacy": {
         "launches": "one successor-kernel pass per action per chunk",
@@ -53,10 +107,40 @@ PIPELINE_REGISTRY = {
             "pipeline is pinned against"
         ),
         "fallback": None,
+        "engines": {
+            "single-device": {
+                "supported": True,
+                "detail": "the single-device bit-identity oracle",
+            },
+            "sharded": {
+                "supported": True,
+                "detail": (
+                    "the per-chunk monolithic sharded step — this "
+                    "engine's bit-identity oracle path (what the "
+                    "sharded engine always ran before the device "
+                    "variant existed)"
+                ),
+            },
+        },
     },
 }
 
 DEFAULT_PIPELINE = "fused"
+
+
+def engine_support(name: str, engine: str) -> dict:
+    """The (pipeline, engine) support cell: {"supported": bool,
+    "detail": str}.  `engine` must be one of :data:`ENGINES`."""
+    if engine not in ENGINES:
+        raise ValueError(
+            f"unknown engine {engine!r} (expected one of {ENGINES})"
+        )
+    if name not in PIPELINE_REGISTRY:
+        raise ValueError(
+            f"unknown pipeline {name!r} (expected one of "
+            f"{pipeline_names()})"
+        )
+    return PIPELINE_REGISTRY[name]["engines"][engine]
 
 
 def pipeline_names() -> tuple:

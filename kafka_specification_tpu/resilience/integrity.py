@@ -532,3 +532,24 @@ def count_check(n: int = 1) -> None:
     from ..obs import metrics as _met
 
     _met.inc("kspec_integrity_checks_total", n)
+
+
+def fold_shard_device_digests(chain: "LevelDigestChain", counts,
+                              xors_hi, xors_lo, limbs) -> None:
+    """Fold per-SHARD device-computed level digests into a chain — the
+    sharded device-resident level path's twin of the single-device
+    fold_digest call.  `counts`/`xors_hi`/`xors_lo` are the fetched [D]
+    accumulator lanes and `limbs` the [D, 4] 16-bit wrapping-sum limbs
+    (ops/devlevel.masked_digest's accumulator, one per shard).  Digests
+    combine commutatively, so folding shard by shard lands the exact
+    value the per-chunk path's per-shard host folds produce over the
+    same rows — chains stay comparable across pipelines, engines and
+    elastic reshards."""
+    from ..ops import devlevel
+
+    for d in range(len(counts)):
+        chain.fold_digest(
+            *devlevel.digest_ints(
+                (counts[d], xors_hi[d], xors_lo[d], limbs[d])
+            )
+        )

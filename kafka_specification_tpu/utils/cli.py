@@ -371,22 +371,25 @@ def main(argv=None):
         "--pipeline",
         choices=list(_pipeline_names()),
         default=None,
-        help="single-device level-pipeline implementation "
-        "(engine/pipeline.py; `cli pipelines --list` describes the "
-        "registry): 'fused' (default; $KSPEC_PIPELINE overrides) = "
-        "successor mega-kernels — one guard-predicate-matrix launch + "
-        "one update-skeleton launch per chunk; 'device' = the "
+        help="level-pipeline implementation (engine/pipeline.py; "
+        "`cli pipelines --list` shows the registry incl. the per-ENGINE "
+        "support matrix): 'fused' (default; $KSPEC_PIPELINE overrides) "
+        "= successor mega-kernels — one guard-predicate-matrix launch "
+        "+ one update-skeleton launch per chunk; 'device' = the "
         "device-resident level pipeline — a bounded lax.while_loop runs "
         "every gated chunk of a level in ONE dispatched program (<=2 "
-        "successor launches per LEVEL; needs the sorted-set device "
-        "visited backend + analyzer-proven field hulls, degrades to "
-        "'fused' otherwise); 'legacy' = the historical per-action step "
-        "(the bit-identity oracle).  Bit-identical results in every "
-        "case (counts, duplicate accounting, first-violation rule, "
-        "trace values, digest chains); ignored by --sharded (the "
-        "sharded engine keeps the per-action path).  Unknown names are "
-        "rejected here and by the engine's registry — a typo can never "
-        "silently select a different implementation",
+        "successor launches per LEVEL single-device; with --sharded, "
+        "per-SHARD one-dispatch level programs with the exchange inside "
+        "the loop — O(1) collective-bearing launches per level per "
+        "shard; needs the sorted-set device visited backend + "
+        "analyzer-proven field hulls, degrades per-chunk otherwise); "
+        "'legacy' = the historical per-action step (the bit-identity "
+        "oracle; with --sharded, the per-chunk sharded step).  "
+        "Bit-identical results in every case (counts, duplicate "
+        "accounting, first-violation rule, trace values, digest "
+        "chains).  Unknown names are rejected here and by the engine's "
+        "registry — a typo can never silently select a different "
+        "implementation",
     )
     pc.add_argument(
         "--overlap",
@@ -744,6 +747,13 @@ def main(argv=None):
                   if e["fallback"] else " (the bit-identity oracle)")
             print(f"  {e['name']}{tag}: {e['launches']}{fb}")
             print(f"      {e['description']}")
+            # per-engine support matrix: which engine (plain vs
+            # --sharded) serves this name, and why a combination
+            # degrades — the sharded engine used to silently ignore
+            # --pipeline; every cell is now stated
+            for eng, cell in e.get("engines", {}).items():
+                mark = "supported" if cell["supported"] else "degrades"
+                print(f"      [{eng}] {mark}: {cell['detail']}")
         return 0
 
     if args.cmd == "analyze":
@@ -1619,6 +1629,7 @@ def _run_engine(args, model, tlc_cfg, progress, chunk_kw, run=None):
             checkpoint_keep=args.checkpoint_keep,
             stats_path=args.stats,
             visited_backend=args.visited_backend,
+            pipeline=getattr(args, "pipeline", None),
             **store_kw,
             **chunk_kw,
         )

@@ -173,6 +173,45 @@ def test_purity_lint_covers_device_level_helpers():
         os.unlink(tmp)
 
 
+def test_purity_lint_covers_sharded_level_body():
+    """The SHARDED device-resident level program's while-loop body is in
+    the self-application sweep (parallel/sharded.py is a registered
+    PURITY_MODULE, the level helpers are `# kspec: traced`-marked), and
+    a seeded host-materialization mutant INSIDE the loop body is
+    detected — a .item() between collectives would deadlock a real mesh,
+    so it must fail CI, not ship."""
+    import kafka_specification_tpu.analysis as an
+    from kafka_specification_tpu.analysis.ownership import lint_purity
+
+    rel = "kafka_specification_tpu/parallel/sharded.py"
+    assert rel in an.PURITY_MODULES
+    path = os.path.join(an.repo_root(), rel)
+    src = open(path).read()
+    # the level body and its cond are traced-marked
+    assert src.count("def level_body(fbuf, flen, ncs, vhi, vlo, vn):  "
+                     "# kspec: traced") == 1
+    # seeded mutant: a .item() materialization inside the while-loop body
+    needle = "            ovf = ovf | this_ovf | ln_ovf\n"
+    assert src.count(needle) == 1
+    mutated = src.replace(
+        needle, needle + "            _bad = int(ovf.item())\n"
+    )
+    assert mutated != src
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False
+    ) as fh:
+        fh.write(mutated)
+        tmp = fh.name
+    try:
+        findings = lint_purity(tmp, rel)
+        assert any(f.kind == "host-materialization" for f in findings), \
+            [(f.kind, f.message) for f in findings]
+    finally:
+        os.unlink(tmp)
+
+
 def test_field_hulls_pin_against_packing_widths():
     """The stable analysis.field_hulls export (the device pipeline's
     pack-width precondition): on every shipped model the per-field

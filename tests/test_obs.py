@@ -253,7 +253,7 @@ def test_sharded_per_shard_breakdowns_and_imbalance(tmp_path):
     assert [
         {k: v for k, v in r.items()
          if k not in ("exch_bytes", "exch_raw_bytes", "io_hidden_ms",
-                      "io_exposed_ms")}
+                      "io_exposed_ms", "shard_launches")}
         for r in res.stats["levels"]
     ] == recs
     prom = open(run.metrics_prom).read()
