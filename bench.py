@@ -786,6 +786,239 @@ def _serve_bench():
     print(json.dumps(rec))
 
 
+def _fleet_bench():
+    """`bench.py --fleet`: the serving-fleet + state-space-cache bench
+    (ROADMAP item 3 acceptance; banked as the `fleet` section of
+    BENCH_r14.json).
+
+    Phase A — 100+ concurrent jobs across a 2-daemon fleet with the
+    state cache OFF (the honest engine-serving measurement: with the
+    cache on, a burst of identical configs is mostly O(verify) hits).
+    Phase B — cache economics on a fresh fleet with the cache ON: cold
+    submit->verdict latency vs repeat-check (chain-verified hit)
+    latency for the same config, plus a config-delta (boundary-seeded)
+    check.  The parent never imports jax.
+
+    VENUE-HONEST: this container exposes ONE schedulable core, so two
+    daemons time-share it — burst p50/p95 measures queueing + batching
+    economics, not hardware parallelism; the venue-independent signals
+    are exactly-once verdicts under the fleet and the cold/hit latency
+    ratio."""
+    import tempfile
+    import threading
+
+    from kafka_specification_tpu.service.fleet import (
+        FleetManager,
+        FleetServeConfig,
+    )
+    from kafka_specification_tpu.service.queue import JobQueue
+    from kafka_specification_tpu.utils.platform_guard import cpu_env
+
+    shapes = {
+        "IdSequence": (
+            "IdSequence",
+            "SPECIFICATION Spec\nCONSTANTS\n    MaxId = 10\n"
+            "INVARIANTS TypeOk\nCHECK_DEADLOCK FALSE\n",
+        ),
+        "FiniteReplicatedLog": (
+            "FiniteReplicatedLog",
+            "SPECIFICATION Spec\nCONSTANTS\n    Replicas = {r1, r2}\n"
+            "    LogSize = 2\n    LogRecords = {a, b}\n    Nil = Nil\n"
+            "INVARIANTS TypeOk\nCHECK_DEADLOCK FALSE\n",
+        ),
+        "TruncateTiny": (
+            "KafkaTruncateToHighWatermark",
+            "SPECIFICATION Spec\nCONSTANTS\n    Replicas = {b1, b2}\n"
+            "    LogSize = 2\n    MaxRecords = 1\n    MaxLeaderEpoch = 1\n"
+            "INVARIANTS TypeOk WeakIsr\nCHECK_DEADLOCK FALSE\n",
+        ),
+    }
+    jobs_per_shape = int(os.environ.get("KSPEC_FLEET_BENCH_JOBS", "36"))
+    n_daemons = int(os.environ.get("KSPEC_FLEET_BENCH_DAEMONS", "2"))
+
+    def start_fleet(svc, extra_serve_args=()):
+        cfg = FleetServeConfig(
+            service_dir=svc,
+            daemons=n_daemons,
+            min_daemons=n_daemons,
+            max_daemons=n_daemons,
+            poll_s=0.2,
+            stall_timeout=300.0,  # a cold compile must not read as a wedge
+            serve_args=("--min-bucket", "32", "--visited-backend", "host")
+            + tuple(extra_serve_args),
+            env=cpu_env(),
+        )
+        mgr = FleetManager(cfg)
+        t = threading.Thread(target=mgr.run, daemon=True)
+        t.start()
+        return mgr, t
+
+    def wait_verdict(q, mgr, jid, timeout=900.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            rec = q.result(jid)
+            if rec is not None:
+                return rec
+            if all(s.state == "halted" for s in mgr.slots):
+                raise SystemExit(
+                    f"fleet bench: every daemon halted before {jid}; "
+                    f"see {mgr.events_path} and {mgr.log_dir}"
+                )
+            time.sleep(0.05)
+        raise SystemExit(f"fleet bench: no verdict for {jid}")
+
+    # ---- phase A: 100+ concurrent, state cache OFF -----------------------
+    svc_a = tempfile.mkdtemp(prefix="kspec-fleet-bench-")
+    qa = JobQueue(svc_a)
+    mgr_a, t_a = start_fleet(svc_a, ("--no-state-cache",))
+    try:
+        warm = [
+            qa.submit(text, module, tenant="bench", kernel_source="hand")
+            for module, text in shapes.values()
+        ]
+        for spec in list(warm):
+            wait_verdict(qa, mgr_a, spec["job_id"])
+        warm += [
+            qa.submit(text, module, tenant="bench", kernel_source="hand")
+            for module, text in shapes.values()
+            for _ in range(2)
+        ]
+        for spec in warm:
+            rec = wait_verdict(qa, mgr_a, spec["job_id"])
+            if rec["exit_code"] not in (0, 1):
+                raise SystemExit(f"fleet bench: warmup failed: {rec}")
+
+        ids = []
+        submit_errors = []
+        lock = threading.Lock()
+
+        def submit(module, text):
+            # a failed submit must FAIL the bench, not silently shrink
+            # the measured set (percentiles over fewer jobs would still
+            # "pass")
+            try:
+                spec = qa.submit(text, module, tenant="bench",
+                                 kernel_source="hand")
+            except Exception as e:  # noqa: BLE001 — re-raised after join
+                with lock:
+                    submit_errors.append(e)
+                return
+            with lock:
+                ids.append(spec["job_id"])
+
+        threads = [
+            threading.Thread(target=submit, args=shapes[name])
+            for name in shapes
+            for _ in range(jobs_per_shape)
+        ]
+        t_burst = time.time()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if submit_errors:
+            raise SystemExit(
+                f"fleet bench: {len(submit_errors)} submits failed "
+                f"(first: {submit_errors[0]!r})"
+            )
+        lat = []
+        for jid in ids:
+            rec = wait_verdict(qa, mgr_a, jid)
+            if rec["exit_code"] not in (0, 1):
+                raise SystemExit(f"fleet bench: job failed: {rec}")
+            lat.append(rec["timing"]["latency_s"])
+        burst_s = time.time() - t_burst
+        # exactly-once visibility across the fleet
+        ov = qa.overview()
+        if ov["counts"]["pending"] or ov["counts"]["claimed"]:
+            raise SystemExit(f"fleet bench: jobs left behind: {ov}")
+    finally:
+        mgr_a.request_stop()
+        t_a.join(timeout=30)
+
+    # ---- phase B: cache economics (cold vs chain-verified hit) -----------
+    svc_b = tempfile.mkdtemp(prefix="kspec-fleet-bench-cache-")
+    qb = JobQueue(svc_b)
+    mgr_b, t_b = start_fleet(svc_b)
+    module, text = shapes["TruncateTiny"]
+    repeats = 10
+    try:
+        # cold (includes the shape's compile; measured as a tenant sees it)
+        t0 = time.time()
+        spec = qb.submit(text, module, tenant="bench", kernel_source="hand")
+        wait_verdict(qb, mgr_b, spec["job_id"])
+        cold_s = time.time() - t0
+        # warm-engine cold-cache reference: second shape submit would hit
+        # the cache, so measure repeat checks (hits) directly
+        hits = []
+        for _ in range(repeats):
+            t0 = time.time()
+            spec = qb.submit(text, module, tenant="bench",
+                             kernel_source="hand")
+            rec = wait_verdict(qb, mgr_b, spec["job_id"])
+            hits.append(time.time() - t0)
+            if (rec.get("cache") or {}).get("state_cache") != "hit":
+                raise SystemExit(f"fleet bench: expected cache hit: {rec}")
+        # config-delta: bounded first, then the unbounded check seeds
+        bounded = text  # same schema, depth-bounded
+        spec = qb.submit(bounded, module, tenant="bench",
+                         kernel_source="hand", max_depth=4)
+        wait_verdict(qb, mgr_b, spec["job_id"])
+        t0 = time.time()
+        spec = qb.submit(bounded, module, tenant="bench",
+                         kernel_source="hand", max_depth=6)
+        rec = wait_verdict(qb, mgr_b, spec["job_id"])
+        delta_s = time.time() - t0
+        delta_seeded = (rec.get("cache") or {}).get("state_cache") == "seed"
+    finally:
+        mgr_b.request_stop()
+        t_b.join(timeout=30)
+
+    lat.sort()
+    hits.sort()
+
+    def pct(vals, p):
+        return round(vals[min(len(vals) - 1, int(p * len(vals)))], 3)
+
+    n = len(lat)
+    hit_p50 = pct(hits, 0.50)
+    rec = {
+        "bench": "fleet",
+        "platform": "cpu",
+        "daemons": n_daemons,
+        "concurrent_jobs": n,
+        "burst_wall_s": round(burst_s, 3),
+        "p50_s": pct(lat, 0.50),
+        "p95_s": pct(lat, 0.95),
+        "max_s": round(lat[-1], 3),
+        "jobs_per_sec": round(n / max(burst_s, 1e-9), 2),
+        "state_cache": {
+            "cold_s": round(cold_s, 3),
+            "hit_p50_s": hit_p50,
+            "hit_p95_s": pct(hits, 0.95),
+            "repeats": repeats,
+            "cold_over_hit": round(cold_s / max(hit_p50, 1e-9), 1),
+            "delta_seeded": delta_seeded,
+            "delta_s": round(delta_s, 3),
+        },
+        "venue": {
+            "cores": 1,
+            "caveat": (
+                "1-core CPU-share-throttled container: the daemons "
+                "time-share one core, so burst p50/p95 measures queueing "
+                "+ batching economics, not hardware parallelism (the PR "
+                "10/13 venue-honesty precedent).  Venue-independent "
+                "signals: exactly-once verdicts across the fleet and the "
+                "cold/hit latency ratio"
+            ),
+        },
+        "target": {"p50_s": 2.0, "concurrent_jobs": 100, "daemons": 2},
+        "pass": bool(pct(lat, 0.50) < 2.0 and n >= 100
+                     and n_daemons >= 2),
+    }
+    print(json.dumps(rec))
+
+
 def _exchange_child_main():
     """8-device CI-mesh exchange measurement (ROADMAP item 5): the same
     sharded workload with the compressed exchange on vs off — verdicts
@@ -1090,6 +1323,9 @@ def _sharded_device_child_main():
 def main():
     if "--serve" in sys.argv[1:]:
         _serve_bench()
+        return
+    if "--fleet" in sys.argv[1:]:
+        _fleet_bench()
         return
     if os.environ.get("KSPEC_BENCH_EXCHANGE"):
         _exchange_child_main()

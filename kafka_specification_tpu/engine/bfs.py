@@ -990,6 +990,7 @@ def check(
     governor: Optional[ResourceGovernor] = None,
     integrity_shadow: Optional[float] = None,
     overlap: Optional[bool] = None,
+    seed: Optional[dict] = None,
 ) -> CheckResult:
     """Breadth-first exhaustive check of `model`. Stops at first violation.
 
@@ -1151,6 +1152,22 @@ def check(
     stamped ``integrity-violation`` (resilience.integrity,
     docs/resilience.md).  KSPEC_INTEGRITY=0 disables the whole layer.
 
+    seed: resume-shaped warm start from a VERIFIED prior exploration of
+    the same model (the service's persistent state-space cache,
+    service/state_cache.py): a dict of ``visited_fps`` (uint64 multiset
+    of every visited fingerprint), ``frontier`` (the boundary level's
+    packed uint32 rows), ``levels``, ``total``, ``depth`` and
+    ``digest_chain`` (the [L, 4] chain array).  The run then starts by
+    expanding the boundary at ``depth`` instead of Init — exactly the
+    checkpoint-resume semantics, including the limitation: parent
+    pointers below the seed do not exist, so ``store_trace`` is forced
+    off and a violation found past the seed reports its state with an
+    empty trace.  The level-boundary chain verify re-proves the seeded
+    frontier against the seeded chain before anything is expanded.
+    Counts, levels, verdicts are bit-identical to a cold run of the
+    larger bound (tests/test_fleet.py).  Mutually exclusive with
+    ``checkpoint_dir`` and the disk tier.
+
     overlap: async level-pipelined execution ($KSPEC_OVERLAP is the env
     twin; default ON, ``off``/False = the historical serial behavior and
     the bit-identity oracle).  Three overlaps (docs/engine.md § Async
@@ -1249,6 +1266,17 @@ def check(
         store_trace = False
         last_ckpt_depth = 0
         checkpoint_every = max(1, int(checkpoint_every))
+    if seed is not None:
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "seed= and checkpoint_dir are mutually exclusive (a seed "
+                "IS a resume; layering the two would race their chains)"
+            )
+        if use_disk:
+            raise ValueError("seed= requires the in-RAM store")
+        # same limitation as checkpoint resume: parent pointers below the
+        # seed do not exist, so traces cannot be reconstructed
+        store_trace = False
 
     inits = [
         {k: np.asarray(v, np.int32) for k, v in s.items()} for s in model.init_states()
@@ -1543,6 +1571,59 @@ def check(
             # (a supervised restart must converge, not crash-loop)
             fault.set_start_depth(depth)
 
+    seeded = False
+    if seed is not None:
+        # warm start from a verified cached exploration (state_cache):
+        # structurally identical to the checkpoint-resume path above,
+        # sourced from the portable artifact instead of a generation.
+        # The visited set is reconstructed from the u64 fingerprint
+        # multiset — every backend's visited state is a pure function of
+        # it — and the boundary frontier is expanded next, so the level
+        # loop continues exactly where the cached run's bound cut it.
+        seeded = True
+        seed_fps = np.sort(
+            np.ascontiguousarray(np.asarray(seed["visited_fps"], np.uint64))
+        )
+        s_hi = (seed_fps >> np.uint64(32)).astype(np.uint32)
+        s_lo = (seed_fps & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        frontier_np = np.ascontiguousarray(
+            np.asarray(seed["frontier"], np.uint32)
+        ).reshape(-1, K)
+        n_seed = int(seed_fps.shape[0])
+        if visited_backend == "host":
+            from ..native import FpSet
+
+            host_set = FpSet(initial_capacity=max(64, 2 * n_seed))
+            host_set.insert(seed_fps)
+        elif visited_backend == "device-hash":
+            ht_hi, ht_lo = hashset.table_from_pairs(
+                s_hi, s_lo, min_cap=_HASH_MIN_CAP
+            )
+            ht_claim = None
+            hash_n = n_seed
+        else:
+            seed_chunk = _next_pow2(max(min_bucket, chunk_size))
+            vcap = _next_pow2(
+                max(
+                    n_seed + seed_chunk * C,
+                    min_bucket * C,
+                    2,
+                    visited_capacity_exact or 0,
+                )
+            )
+            pad = np.full(vcap - n_seed, 0xFFFFFFFF, np.uint32)
+            # u64 sort order == (hi, lo) lexsort order: the split lanes
+            # land exactly as the sorted-set backend stores them
+            vhi = jnp.asarray(np.concatenate([s_hi, pad]))
+            vlo = jnp.asarray(np.concatenate([s_lo, pad]))
+            vn = jnp.int32(n_seed)
+        levels = [int(v) for v in seed["levels"]]
+        total = int(seed["total"])
+        depth = int(seed["depth"])
+        # crash faults at or below the seed level count as fired, the
+        # same convergence rule as a checkpoint resume
+        fault.set_start_depth(depth)
+
     if disk is not None and not resumed:
         # fresh out-of-core run: the spill directory namespace belongs to
         # this run (stale runs must not pre-seed the visited set)
@@ -1550,7 +1631,17 @@ def check(
         frontier_np = disk.pending()
 
     if chain is not None:
-        if resumed:
+        if seeded:
+            # the cached chain IS the continuation proof, exactly like a
+            # resumed checkpoint's: the level-boundary verify below must
+            # prove the seeded frontier against its sealed entry before
+            # anything is expanded
+            chain = (
+                _integ.LevelDigestChain.from_array(seed["digest_chain"])
+                if seed.get("digest_chain") is not None
+                else _integ.LevelDigestChain.from_levels(levels)
+            )
+        elif resumed:
             # the chain IS the continuation proof: a resumed run extends
             # the stamped chain, and the frontier verify below checks the
             # loaded frontier against its sealed entry.  Pre-integrity
@@ -2707,6 +2798,9 @@ def check(
             # only the observed per-chunk maximum is honest here
             "launches_per_chunk_max": run_launches_max,
             "adaptive_active": adapt.active,
+            # state-space-cache seeding (service/state_cache.py): the
+            # depth this run's frontier was seeded at instead of Init
+            **({"seeded_from_depth": int(seed["depth"])} if seeded else {}),
             # device-resident level pipeline accounting (DevicePipeline):
             # how many levels ran as single dispatched programs, and why
             # (if ever) the run left the device path for the fused ladder

@@ -614,7 +614,23 @@ def render_report(run_dir: str, now: Optional[float] = None,
                 if svc.get("leader_run_id")
                 else ""
             )
+            + (
+                "  state-cache SEED"
+                if svc.get("state_cache_seed")
+                else ""
+            )
         )
+        if svc.get("takeover"):
+            # lease takeover: this run serves a job a DIFFERENT daemon
+            # claimed first and abandoned (died or wedged); the janitor
+            # attribution rides the job spec into the run manifest
+            t = svc["takeover"]
+            out.append(
+                "  takeover: requeued from pid "
+                + str(t.get("from_pid", "?"))
+                + f" ({t.get('reason', '?')})"
+                + f" by janitor pid {t.get('by_pid', '?')}"
+            )
     bits = [
         f"module={cfg.get('module') or cfg.get('model') or '?'}",
         f"engine={cfg.get('engine', '?')}",

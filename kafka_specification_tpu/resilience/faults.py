@@ -153,9 +153,16 @@ class InjectedCrash(InjectedFault):
 #: added without becoming enumerable and parse-checked at the same time.
 #: kind -> (valid sites (None = bare fault), grammar form, description)
 FAULT_REGISTRY = (
-    ("crash", ("level", "ckpt", "merge"), "crash@level|ckpt|merge:N",
+    ("crash", ("level", "ckpt", "merge", "daemon"),
+     "crash@level|ckpt|merge:N | crash@daemon<i>:N",
      "raise InjectedCrash at the level-N boundary / mid-checkpoint-write "
-     "(tmp written, pre-promote) / mid-way through the Nth disk-run merge"),
+     "(tmp written, pre-promote) / mid-way through the Nth disk-run merge; "
+     "the daemon<i> form kills serving-daemon instance i while it handles "
+     "its Nth job (claims stay leased; a sibling's janitor requeues them "
+     "and the verdict still publishes exactly once — service/fleet.py).  "
+     "Daemon-scoped faults fire once per SERVICE DIR (durable "
+     "fired-marker), so a fleet-restarted daemon converges instead of "
+     "crash-looping — the crash@level checkpoint-deferral rule's twin"),
     ("corrupt_ckpt", ("ckpt",), "corrupt_ckpt[@ckpt:N]",
      "corrupt the newest checkpoint right after its write (checksum-"
      "fallback rehearsal); bytes flipped AFTER the CRC manifest, so the "
@@ -166,18 +173,28 @@ FAULT_REGISTRY = (
     ("transient_device_err", None, "transient_device_err:N",
      "the next N chunk/exchange steps raise a transient-classified "
      "backend error (bounded-backoff retry rehearsal)"),
-    ("enospc", ("spill", "ckpt", "merge", "plog"),
-     "enospc@spill|ckpt|merge|plog:N",
+    ("enospc", ("spill", "ckpt", "merge", "plog", "cache"),
+     "enospc@spill|ckpt|merge|plog|cache:N",
      "OSError(ENOSPC) at the writer's pre-promote point (typed "
-     "RESOURCE_EXHAUSTED exit 75; state stays verifiable)"),
-    ("stall", ("level",), "stall@level:N",
+     "RESOURCE_EXHAUSTED exit 75; state stays verifiable).  The cache "
+     "site is the Nth state-space-cache publish of this process "
+     "(service/state_cache.py): publication aborts cleanly with a "
+     "cache-fallback event, the job's verdict is untouched"),
+    ("stall", ("level", "daemon"), "stall@level:N | stall@daemon<i>",
      "the per-level deadline watchdog reports level N stalled (typed "
-     "exit 75)"),
-    ("flip", ("frontier", "fpset", "exchange", "spill", "ckpt"),
-     "flip@frontier|fpset|exchange|spill|ckpt:N",
+     "exit 75); the daemon<i> form wedges serving-daemon instance i "
+     "after its next claim sweep — heartbeat and lease renewal freeze, "
+     "so the fleet supervisor stall-kills it and a sibling's janitor "
+     "takes its leased claims over at lease expiry"),
+    ("flip", ("frontier", "fpset", "exchange", "spill", "ckpt", "cache"),
+     "flip@frontier|fpset|exchange|spill|ckpt|cache:N",
      "silent bit-flip at the named state surface (typed "
      "INTEGRITY_VIOLATION exit 76; detected by the digest-chain / "
-     "framing / read-side-CRC layer — resilience.integrity)"),
+     "framing / read-side-CRC layer — resilience.integrity).  The cache "
+     "site flips bytes in the Nth published state-space-cache artifact "
+     "of this process AFTER its promote: the next lookup's chain/CRC "
+     "verification rejects it with a cache-fallback event and the check "
+     "degrades to a cold run — never a wrong verdict"),
 )
 
 _SITES_BY_KIND = {k: sites for k, sites, _g, _d in FAULT_REGISTRY}
@@ -200,6 +217,7 @@ class _Spec:
     arg: Optional[int]  # level number (crash/corrupt) — None = first
     budget: int  # remaining firings
     shard: Optional[int] = None  # fire only on this shard's host process
+    instance: Optional[int] = None  # fire only on this daemon instance
 
 
 def _split_shard(rest: str, tok: str):
@@ -238,6 +256,37 @@ def _parse_token(tok: str) -> _Spec:
         if name == "compile_oom" and shard is not None and not rest:
             return _Spec("compile_oom", None, None, 1, shard)
         point, _, arg = rest.partition(":")
+        if point.startswith("daemon") and name in ("crash", "stall"):
+            # serving-daemon instance scope (service/fleet.py): the
+            # instance index is part of the site token, like shard<d>
+            try:
+                inst = int(point[len("daemon"):])
+            except ValueError:
+                raise ValueError(
+                    f"fault {tok!r}: daemon scope must be 'daemon<index>', "
+                    f"got {point!r}"
+                )
+            if inst < 0:
+                raise ValueError(
+                    f"fault {tok!r}: daemon index must be >= 0"
+                )
+            if name == "stall":
+                if arg:
+                    raise ValueError(
+                        f"fault {tok!r}: stall@daemon<i> takes no ':N' "
+                        "(the daemon wedges at its next claim sweep)"
+                    )
+                return _Spec("stall", "daemon", None, 1, instance=inst)
+            try:
+                nth = int(arg)
+            except ValueError:
+                raise ValueError(
+                    f"fault {tok!r}: crash@daemon<i>:N needs an integer "
+                    "job ordinal N"
+                )
+            if nth < 1:
+                raise ValueError(f"fault {tok!r}: job ordinal must be >= 1")
+            return _Spec("crash", "daemon", nth, 1, instance=inst)
         if not arg:
             raise ValueError(f"fault {tok!r}: '@{point}' needs ':<level>'")
         try:
@@ -297,6 +346,11 @@ class FaultPlan:
         # None = no topology wired: every shard scope counts as local
         # (single-process runs, and the single-device engine)
         self.local_shards: Optional[frozenset] = None
+        # which serving-daemon instance this process is (set_instance,
+        # wired by service/daemon.py from KSPEC_DAEMON_INSTANCE); daemon-
+        # scoped faults fire only on an exact match — None never fires,
+        # so engine-side plans carrying daemon faults are inert there
+        self.instance: Optional[int] = None
         self.specs = [
             _parse_token(t.strip())
             for t in self.spec.split(",")
@@ -314,6 +368,57 @@ class FaultPlan:
         """Record the depth a resumed run starts from: crash faults at or
         below it are considered already-fired (restart convergence)."""
         self.start_depth = int(depth)
+
+    def set_instance(self, instance: int) -> None:
+        """Record which serving-daemon instance this process is
+        (service/fleet.py launches each `cli serve` child with
+        KSPEC_DAEMON_INSTANCE=i).  `crash@daemon<i>:N` / `stall@daemon<i>`
+        then fire only in the targeted instance's process — its fleet
+        siblings sail past, which is exactly the one-daemon-died /
+        one-daemon-wedged failure the fleet supervisor exists to catch."""
+        self.instance = int(instance)
+
+    def _instance_match(self, s: _Spec) -> bool:
+        return (
+            s.instance is not None
+            and self.instance is not None
+            and s.instance == self.instance
+        )
+
+    def daemon_crash(self, lo: int, hi: Optional[int] = None) -> None:
+        """Raise InjectedCrash if a `crash@daemon<i>:N` fault targets this
+        daemon instance and job ordinal N falls in [lo, hi] (the 1-based
+        ordinals of the group the daemon is about to run).  Fires BEFORE
+        any verdict is derived: the claims stay leased, the lease expires
+        or the pid reads dead, and a sibling's janitor requeues them —
+        the verdict still publishes exactly once."""
+        hi = lo if hi is None else hi
+        for s in self.specs:
+            if s.kind != "crash" or s.point != "daemon" or s.budget <= 0:
+                continue
+            if not self._instance_match(s):
+                continue
+            if not (lo <= s.arg <= hi):
+                continue
+            s.budget -= 1
+            raise InjectedCrash(
+                f"injected daemon crash on instance {s.instance} at job "
+                f"ordinal {s.arg} (KSPEC_FAULT)"
+            )
+
+    def daemon_stalled(self) -> bool:
+        """True once per `stall@daemon<i>` fault targeting this instance:
+        the daemon then wedges (stops heartbeating, stops renewing
+        leases, stops claiming) so the fleet supervisor's stall detector
+        kills it and a sibling takes over its claims at lease expiry."""
+        for s in self.specs:
+            if s.kind != "stall" or s.point != "daemon" or s.budget <= 0:
+                continue
+            if not self._instance_match(s):
+                continue
+            s.budget -= 1
+            return True
+        return False
 
     def set_local_shards(self, shards) -> None:
         """Record which shards this process hosts (the sharded engine's
@@ -381,7 +486,7 @@ class FaultPlan:
         """Raise an injected OSError(ENOSPC) if an `enospc@<point>:N`
         fault matches.  `n` is the BFS level for ckpt/plog (resume-depth
         relief applies, like crash@level) and a per-process ordinal for
-        spill/merge (in-process test use, like crash@merge).  Raised at
+        spill/merge/cache (in-process test use, like crash@merge).  Raised at
         each writer's pre-promote point, so the on-disk state it leaves
         is exactly what a real full disk leaves: old files intact, tmp
         cleaned up, every promoted generation verifiable."""
@@ -409,6 +514,9 @@ class FaultPlan:
         for s in self.specs:
             if s.kind != "stall" or s.budget <= 0 or not self._is_local(s):
                 continue
+            if s.point == "daemon":
+                continue  # daemon wedges fire via daemon_stalled(), never
+                # at an engine level boundary (their arg is no level)
             if self.start_depth >= s.arg:
                 continue
             if depth >= s.arg:
@@ -453,7 +561,10 @@ class FaultPlan:
                 continue
             if not self._is_local(s):
                 continue
-            if site == "spill":
+            if site in ("spill", "cache"):
+                # per-process ordinals (in-process test use, like
+                # crash@merge): cache = the Nth state-space-cache
+                # artifact published by this process
                 if n != s.arg:
                     continue
             else:

@@ -86,6 +86,32 @@ class SupervisorConfig:
 STALL_RC = -97  # synthetic rc recorded for a stall-killed attempt
 
 
+def classify_exit(rc: Optional[int]) -> str:
+    """THE supervisor taxonomy for a supervised child's exit, shared by
+    the single-child supervisor, the sharded fleet and the serving-daemon
+    fleet (service/fleet.py) so the policy table cannot drift:
+
+      'ok'        rc 0 — clean exit
+      'resource'  rc 75 — typed RESOURCE_EXHAUSTED; restarting into the
+                  same full disk would hot-loop: halt with a verdict (at
+                  most one reclaim-retry)
+      'integrity' rc 76 — typed INTEGRITY_VIOLATION; restartable (the
+                  resume path skips chain-failed state), budget-bounded
+      'stall'     the synthetic STALL_RC a watchdog stamped on a wedged
+                  child it killed; restartable, budget-bounded
+      'crash'     anything else — restartable, budget-bounded
+    """
+    if rc == 0:
+        return "ok"
+    if rc == EXIT_RESOURCE_EXHAUSTED:
+        return "resource"
+    if rc == EXIT_INTEGRITY:
+        return "integrity"
+    if rc == STALL_RC:
+        return "stall"
+    return "crash"
+
+
 def _hb_size(path: Optional[str]) -> int:
     if not path:
         return 0

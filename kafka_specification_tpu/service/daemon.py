@@ -41,7 +41,7 @@ from typing import Optional
 from ..engine.bfs import check
 from ..obs import RunContext
 from ..obs.metrics import MetricsRegistry
-from ..resilience.faults import FaultPlan
+from ..resilience.faults import FaultPlan, InjectedCrash
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
 from ..resilience.integrity import EXIT_INTEGRITY, IntegrityError
 from ..resilience.resources import ResourceExhausted
@@ -90,6 +90,20 @@ class ServeConfig:
     visited_backend: str = "device"
     cache_entries: int = 32
     batching: bool = True
+    # fleet identity (service/fleet.py): instance i writes its OWN
+    # heartbeat/metrics files (heartbeat-<i>.jsonl) so the fleet
+    # supervisor can watch each daemon separately, answers to the
+    # drain marker service/drain/<i>, and is the target of
+    # crash@daemon<i>/stall@daemon<i> faults.  None (a solo `cli
+    # serve`) keeps the historical shared paths.  KSPEC_DAEMON_INSTANCE
+    # is the env twin the fleet launcher sets.
+    instance: Optional[int] = None
+    # persistent state-space cache (service/state_cache.py): repeat
+    # checks of an unchanged config become chain-verified cache hits,
+    # config-delta checks seed from the cached boundary.  Trust-but-
+    # verify: any artifact problem degrades to a cold run with a
+    # cache-fallback event — it can never produce a wrong verdict.
+    state_cache: bool = True
 
 
 class Daemon:
@@ -99,12 +113,46 @@ class Daemon:
         self.policy = TenantPolicy(self.queue.tenants_path)
         self.cache = KernelCache(max_entries=cfg.cache_entries)
         os.makedirs(self.queue.service_dir, exist_ok=True)
+        # fleet identity: instance i gets its own heartbeat/metrics files
+        # (the fleet supervisor watches per-daemon liveness), a drain
+        # marker path, and the daemon-scoped fault sites armed
+        if cfg.instance is None and os.environ.get("KSPEC_DAEMON_INSTANCE"):
+            cfg.instance = int(os.environ["KSPEC_DAEMON_INSTANCE"])
+        self.instance = cfg.instance
+        sfx = "" if self.instance is None else f"-{self.instance}"
         self.heartbeat_path = os.path.join(
-            self.queue.service_dir, "heartbeat.jsonl"
+            self.queue.service_dir, f"heartbeat{sfx}.jsonl"
         )
+        self.metrics_suffix = sfx
         self.events_path = os.path.join(
             self.queue.service_dir, "events.jsonl"
         )
+        self.drain_marker = (
+            None
+            if self.instance is None
+            else os.path.join(
+                self.queue.service_dir, "drain", str(self.instance)
+            )
+        )
+        # daemon-level fault plan (crash@daemon<i>:N / stall@daemon<i> /
+        # flip@cache:N / enospc@cache:N): parsed once from the daemon's
+        # OWN environment — per-job --fault plans ride the job governor
+        # and never reach these hooks
+        self.fault = FaultPlan.from_env()
+        self.fault.set_instance(self.instance if self.instance is not None
+                                else 0)
+        self.state_cache = None
+        if cfg.state_cache:
+            from .state_cache import StateSpaceCache
+
+            self.state_cache = StateSpaceCache(
+                os.path.join(self.queue.dir, "state-cache"),
+                fault_plan=self.fault,
+                event=self._event,
+            )
+        self._seeds: dict = {}  # job_id -> engine seed dict (cache delta)
+        self._trace_buf: list = []  # solo runs' trace store (publication)
+        self._janitor_last = 0.0
         self.metrics = MetricsRegistry(run_id="service")
         self.jobs_done = 0
         self.groups_run = 0
@@ -150,6 +198,13 @@ class Daemon:
         hb_thread.start()
         try:
             while not self._stop:
+                if self._drain_requested():
+                    # graceful drain (fleet scale-down): every claimed
+                    # job of the previous sweep is finished — take no new
+                    # work, exit 0; the fleet reaps the slot
+                    self._event("daemon-drain-exit", jobs=self.jobs_done)
+                    break
+                self._periodic_janitor()
                 n = self.drain_once()
                 self._tick(worked=bool(n))
                 if n:
@@ -185,6 +240,11 @@ class Daemon:
         if claimed and self.cfg.linger_s:
             time.sleep(self.cfg.linger_s)  # let an in-flight burst land
             claimed += self.queue.claim_pending()
+        # stall@daemon<i> wedges HERE — after the claim sweep, before any
+        # lease renewal starts — so the injected failure is exactly the
+        # one the fleet exists to survive: a wedged daemon sitting on
+        # freshly leased claims (never returns when armed)
+        self._maybe_wedge()
         if not claimed:
             return 0
         jobs = []
@@ -212,6 +272,9 @@ class Daemon:
                 emitted = resolve_kernel_source(
                     spec.get("kernel_source", "auto"), spec["module"]
                 )
+                if self._consult_state_cache(spec, cfg, emitted):
+                    done += 1  # chain-verified cache hit: verdict
+                    continue  # published, nothing to run
                 jobs.append((spec, cfg, emitted))
             except Exception as e:  # noqa: BLE001 — tenant input
                 done += self._fail_jobs([spec], f"cannot parse job cfg: {e}")
@@ -241,6 +304,22 @@ class Daemon:
         specs = [spec for spec, _c, _e in group]
         leader_spec, leader_cfg, emitted = group[0]
         tenant = leader_spec.get("tenant", "default")
+        # crash@daemon<i>:N (resilience.faults): the injected daemon
+        # death fires BEFORE any verdict work for the Nth job, so the
+        # group's claims stay leased and a sibling's janitor requeues
+        # them — the exactly-once-visible-verdict drill for the fleet.
+        # InjectedCrash is deliberately NOT caught by any handler below:
+        # the process must die like the real crash it rehearses.  The
+        # fired-marker makes the drill once-per-service-dir, so the
+        # fleet's restarted daemon converges instead of crash-looping.
+        if self._daemon_fault_armed("crash"):
+            try:
+                self.fault.daemon_crash(
+                    self.jobs_done + 1, self.jobs_done + len(group)
+                )
+            except InjectedCrash:
+                self._mark_daemon_fault("crash")
+                raise
         # the busy-heartbeat window opens BEFORE the kernel-cache lookup:
         # a cold miss runs build_model + prepare for minutes, and without
         # a moving heartbeat --supervised would stall-kill the daemon
@@ -295,6 +374,16 @@ class Daemon:
                     "group_size": len(group),
                     "group_jobs": [s["job_id"] for s in specs],
                     "cache_hit": entry["hit"],
+                    **(
+                        {"takeover": leader_spec["takeovers"][-1]}
+                        if leader_spec.get("takeovers")
+                        else {}
+                    ),
+                    **(
+                        {"state_cache_seed": True}
+                        if leader_spec.get("_state_cache_seed")
+                        else {}
+                    ),
                 },
             )
             # a tenant-budgeted governor replaces the engine's env-derived
@@ -316,23 +405,64 @@ class Daemon:
         old_fault = os.environ.get("KSPEC_FAULT")
         if fault:
             os.environ["KSPEC_FAULT"] = fault
+        seed = None
+        seed_depth = None
         try:
             if solo:
                 shared = None
-                solo_res = check(
-                    entry["model"],
-                    max_depth=leader_spec.get("max_depth"),
-                    max_states=leader_spec.get("max_states"),
-                    store_trace=True,
-                    min_bucket=self.cfg.min_bucket,
-                    check_deadlock=leader_cfg.check_deadlock,
-                    chunk_size=self.cfg.chunk_size,
-                    visited_backend=self.cfg.visited_backend,
-                    prepared=entry["prepared"],
-                    run=leader_ctx,
-                    governor=governor,
-                    visited_capacity_exact=entry["prepared"].capacity_hint,
-                )
+                seed = self._seeds.pop(leader_spec["job_id"], None)
+
+                def _run_solo(seed_arg):
+                    # publication needs the per-level packed rows: alias
+                    # the engine's trace store (zero extra memory) on
+                    # COLD cacheable runs; seeded runs force
+                    # store_trace off, so they neither collect nor
+                    # publish (docs/service.md § State-space cache)
+                    collect = (
+                        self._trace_buf
+                        if seed_arg is None
+                        and self.state_cache is not None
+                        and not fault
+                        else None
+                    )
+                    return check(
+                        entry["model"],
+                        max_depth=leader_spec.get("max_depth"),
+                        max_states=leader_spec.get("max_states"),
+                        store_trace=True,
+                        min_bucket=self.cfg.min_bucket,
+                        check_deadlock=leader_cfg.check_deadlock,
+                        chunk_size=self.cfg.chunk_size,
+                        visited_backend=self.cfg.visited_backend,
+                        prepared=entry["prepared"],
+                        run=leader_ctx,
+                        governor=governor,
+                        visited_capacity_exact=(
+                            entry["prepared"].capacity_hint
+                        ),
+                        seed=seed_arg,
+                        collect_trace=collect,
+                    )
+
+                try:
+                    solo_res = _run_solo(seed)
+                    seed_depth = seed["depth"] if seed else None
+                except InjectedCrash:
+                    raise  # the process is expected to die
+                except Exception as e:  # noqa: BLE001 — trust-but-verify:
+                    # a seeded run that fails for ANY reason degrades to
+                    # the cold run it replaced (typed cache-fallback);
+                    # only an unseeded failure is the job's real error
+                    if seed is None:
+                        raise
+                    self._event(
+                        "cache-fallback",
+                        reason=f"seed-error: {str(e)[:200]}",
+                        jobs=[leader_spec["job_id"]],
+                    )
+                    self.metrics.inc("kspec_svc_state_cache_fallbacks_total")
+                    seed = None
+                    solo_res = _run_solo(None)
                 entry["prepared"].note_result(solo_res)
             else:
                 shared = explore_shared(
@@ -424,7 +554,25 @@ class Daemon:
         n = self._publish_group(
             group, members, specs, leader_spec, leader_ctx,
             solo, solo_res if solo else None, shared, t0,
+            seed_depth=seed_depth,
         )
+        if solo and self.state_cache is not None and not fault:
+            # completed solo run: publish it as a state-space-cache entry
+            # (files-first + atomic entry promote; every failure is a
+            # cache-fallback event, never a job failure).  Cold runs
+            # publish the full seedable artifact from their trace rows;
+            # seeded runs publish a verdict-only entry (their trace
+            # store has no below-seed levels), which still turns the
+            # NEXT repeat check into an O(verify) hit
+            rows = (
+                [t[0] for t in self._trace_buf]
+                if seed is None and solo_res.violation is None
+                else None
+            )
+            self._publish_state_cache(
+                leader_spec, leader_cfg, emitted, entry, solo_res,
+                level_rows=rows,
+            )
         # a run that GREW the device visited set evicted the small-bucket
         # steps the next run of this shape will need at the new capacity
         # fixed point: re-compile them now — verdicts are already
@@ -440,7 +588,8 @@ class Daemon:
         return n
 
     def _publish_group(self, group, members, specs, leader_spec,
-                       leader_ctx, solo, solo_res, shared, t0) -> int:
+                       leader_ctx, solo, solo_res, shared, t0,
+                       seed_depth=None) -> int:
         """Derive + publish every member's verdict.  Runs with
         ``_busy_jobs`` still set (cleared by drain_once): derive_member
         jit-compiles per-(invariant, level-bucket) predicates and walks
@@ -468,6 +617,13 @@ class Daemon:
                     status="violation" if res.violation else "complete",
                     wall_s=wall_s,
                 )
+                if seed_depth is not None:
+                    # config-delta run: the frontier was seeded from the
+                    # cached boundary instead of Init (state_cache)
+                    rec["cache"] = {
+                        "state_cache": "seed",
+                        "from_depth": int(seed_depth),
+                    }
                 if len(group) > 1:
                     rec["batch"] = {
                         "group_size": len(group),
@@ -510,6 +666,93 @@ class Daemon:
                     pass
         return len(specs)
 
+    # --- state-space cache (service/state_cache.py) -----------------------
+    def _consult_state_cache(self, spec: dict, cfg, emitted: bool) -> bool:
+        """Repeat-check short circuit: True when a chain-verified cache
+        hit published this job's verdict (nothing to run).  A config-
+        delta hit registers an engine seed for the solo path and returns
+        False (the job still runs, just not from Init).  Every cache
+        problem is a typed cache-fallback (inside lookup) + False."""
+        if self.state_cache is None or spec.get("fault"):
+            return False
+        from .state_cache import CacheHit, CacheSeed, key_for_job
+        from .verdict import VERDICT_SCHEMA
+
+        try:
+            key = key_for_job(
+                spec, cfg, emitted,
+                job_invariants(spec["module"], cfg),
+            )
+            found = self.state_cache.lookup(key)
+        except Exception as e:  # noqa: BLE001 — the cache may never fail
+            # a job: an unexpected lookup error is just a cold run
+            self._event(
+                "cache-fallback", reason=f"lookup-error: {str(e)[:200]}",
+                jobs=[spec["job_id"]],
+            )
+            self.metrics.inc("kspec_svc_state_cache_fallbacks_total")
+            return False
+        if isinstance(found, CacheHit):
+            rec = dict(found.verdict)
+            rec["schema"] = VERDICT_SCHEMA
+            rec.setdefault("run_id", None)
+            rec = self._stamp(
+                spec, rec,
+                status="violation" if rec.get("violation") else "complete",
+            )
+            rec["cache"] = {
+                "state_cache": "hit",
+                "reason": found.reason,
+                "published_unix": found.entry.get("created_unix"),
+            }
+            self._finish_job(spec, rec)
+            self.metrics.inc("kspec_svc_state_cache_hits_total")
+            return True
+        if isinstance(found, CacheSeed):
+            self._seeds[spec["job_id"]] = found.seed
+            # seeded jobs must run REAL solo semantics (the engine seed
+            # plugs into check(), not the batched runner)
+            spec["_state_cache_seed"] = True
+            self.metrics.inc("kspec_svc_state_cache_seeds_total")
+            return False
+        self.metrics.inc("kspec_svc_state_cache_misses_total")
+        return False
+
+    def _publish_state_cache(self, spec, cfg, emitted, entry, res,
+                             level_rows=None) -> None:
+        from .state_cache import key_for_job
+
+        try:
+            key = key_for_job(
+                spec, cfg, emitted, job_invariants(spec["module"], cfg)
+            )
+            rows = level_rows
+            if rows is not None:
+                # an exhausted run's trace store carries one trailing
+                # EMPTY level (the final zero-new iteration) beyond the
+                # levels list — trim it; any other length mismatch
+                # (violation early-exit) means no artifact
+                rows = list(rows)
+                while len(rows) > len(res.levels) and not len(rows[-1]):
+                    rows.pop()
+                if len(rows) != len(res.levels):
+                    rows = None
+            if self.state_cache.publish(
+                key,
+                verdict_from_result(res),
+                exact64=bool(entry["model"].spec.exact64),
+                lanes=int(entry["model"].spec.num_lanes),
+                level_rows=rows,
+                diameter=res.diameter,
+            ):
+                self.metrics.inc("kspec_svc_state_cache_publish_total")
+        except Exception as e:  # noqa: BLE001 — publication is an
+            # optimization: its failure must never fail the job
+            self._event(
+                "cache-fallback", reason=f"publish-error: {str(e)[:200]}",
+            )
+            self.metrics.inc("kspec_svc_state_cache_fallbacks_total")
+
     # --- helpers ----------------------------------------------------------
     def _stamp(self, spec: dict, rec: dict, status: str,
                wall_s: Optional[float] = None) -> dict:
@@ -517,6 +760,13 @@ class Daemon:
         rec["job_id"] = spec["job_id"]
         rec["tenant"] = spec.get("tenant", "default")
         rec["status"] = status
+        if spec.get("takeovers"):
+            # the job reached this daemon via a janitor takeover from a
+            # dead/wedged claimer: attribute it in the verdict (and `cli
+            # report` renders it from the run manifest's service block)
+            last = dict(spec["takeovers"][-1])
+            last["count"] = len(spec["takeovers"])
+            rec["takeover"] = last
         sub = spec.get("submitted_unix")
         claim = spec.get("claimed_unix")
         rec["timing"] = {
@@ -593,6 +843,8 @@ class Daemon:
             )
 
     def _event(self, kind: str, **fields) -> None:
+        if self.instance is not None:
+            fields.setdefault("instance", self.instance)
         try:
             append_jsonl(
                 self.events_path,
@@ -600,6 +852,76 @@ class Daemon:
             )
         except OSError:
             pass  # telemetry on a full disk must never take the daemon down
+
+    def _drain_requested(self) -> bool:
+        """True once the fleet has marked this instance for graceful
+        retirement (service/drain/<i>): finish what is claimed, take no
+        new jobs, exit 0."""
+        return self.drain_marker is not None and os.path.exists(
+            self.drain_marker
+        )
+
+    def _periodic_janitor(self) -> None:
+        """requeue_orphans is not only a STARTUP janitor: a live daemon
+        sweeping it periodically is what lets a healthy sibling take
+        over a wedged daemon's claims at lease expiry without anyone
+        restarting anything (the fleet's takeover primitive).  Cadence
+        tracks the lease TTL so a short-TTL test observes takeover in
+        seconds while a production daemon sweeps at most every 30s."""
+        import time as _t
+
+        ttl = float(os.environ.get("KSPEC_CLAIM_LEASE_TTL", 900.0))
+        interval = min(30.0, max(0.5, ttl / 3.0))
+        now = _t.monotonic()
+        if now - self._janitor_last < interval:
+            return
+        self._janitor_last = now
+        try:
+            moved = self.queue.requeue_orphans()
+        except OSError:
+            return
+        if moved:
+            self._event("lease-takeover", jobs=sorted(moved))
+            self.metrics.inc("kspec_svc_takeovers_total", len(moved))
+
+    def _daemon_fault_marker(self, kind: str) -> str:
+        return os.path.join(
+            self.queue.service_dir, "faults-fired",
+            f"{kind}-daemon{self.instance if self.instance is not None else 0}",
+        )
+
+    def _daemon_fault_armed(self, kind: str) -> bool:
+        """Daemon-scoped faults fire ONCE PER SERVICE DIR, not once per
+        process: a restarted daemon re-reads KSPEC_FAULT, and without
+        this durable fired-marker a crash@daemon<i> drill would re-kill
+        every restart into a crash loop.  Same convergence rule as
+        crash@level's checkpoint deferral — a supervised restart must
+        converge, never re-rehearse."""
+        return not os.path.exists(self._daemon_fault_marker(kind))
+
+    def _mark_daemon_fault(self, kind: str) -> None:
+        try:
+            path = self._daemon_fault_marker(kind)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w"):
+                pass
+        except OSError:
+            pass  # worst case the drill re-fires; never block the fault
+
+    def _maybe_wedge(self) -> None:
+        """stall@daemon<i> (resilience.faults): deterministically wedge
+        THIS daemon after a claim sweep — claims held, leases never
+        renewed again, heartbeat frozen.  The fleet supervisor's stall
+        detector kills the process; a sibling's janitor takes the claims
+        over at lease expiry.  The sleep loop never returns."""
+        if not self._daemon_fault_armed("stall"):
+            return
+        if not self.fault.daemon_stalled():
+            return
+        self._mark_daemon_fault("stall")
+        self._event("daemon-wedge-injected", pid=os.getpid())
+        while True:  # pragma: no cover — killed externally
+            time.sleep(3600.0)
 
     def _tick(self, worked: bool = False) -> None:
         now = time.monotonic()
@@ -682,10 +1004,14 @@ class Daemon:
 
     def _export_metrics(self, jsonl: bool = False) -> None:
         svc = self.queue.service_dir
+        sfx = self.metrics_suffix  # per-instance files in a fleet: two
+        # daemons must not alternate-overwrite one prom textfile
         try:
             if jsonl:
-                self.metrics.write_jsonl(os.path.join(svc, "metrics.jsonl"))
-            self.metrics.write_prom(os.path.join(svc, "metrics.prom"))
+                self.metrics.write_jsonl(
+                    os.path.join(svc, f"metrics{sfx}.jsonl")
+                )
+            self.metrics.write_prom(os.path.join(svc, f"metrics{sfx}.prom"))
         except OSError:
             pass  # metrics export must never take the daemon down
 

@@ -56,8 +56,14 @@ def group_key(spec: dict, cfg, emitted: bool) -> tuple:
 
 
 def solo_only(spec: dict, cfg) -> bool:
-    """True when this job must run alone (see module docstring)."""
-    return bool(cfg.check_deadlock) or bool(spec.get("fault"))
+    """True when this job must run alone (see module docstring).  A
+    state-cache-seeded job (daemon._consult_state_cache) also runs solo:
+    the engine seed plugs into check(), not the batched runner."""
+    return (
+        bool(cfg.check_deadlock)
+        or bool(spec.get("fault"))
+        or bool(spec.get("_state_cache_seed"))
+    )
 
 
 def plan_groups(jobs: list) -> list:

@@ -594,8 +594,74 @@ def main(argv=None):
         "--max-restarts", type=int, default=8,
         help="[--supervised] restart budget (default 8)",
     )
+    pserve.add_argument(
+        "--no-state-cache", action="store_true",
+        help="disable the persistent state-space cache (default on: "
+        "repeat checks of an unchanged config become chain-verified "
+        "cache hits, config-delta checks seed from the cached boundary; "
+        "every artifact problem degrades to a cold run with a typed "
+        "cache-fallback event — docs/service.md § State-space cache)",
+    )
     pserve.add_argument("--cpu", action="store_true",
                         help="force the CPU platform")
+
+    pfleet = sub.add_parser(
+        "serve-fleet",
+        help="run an N-daemon serving fleet over one service directory: "
+        "per-daemon heartbeat supervision (death/wedge/rc-75/rc-76 "
+        "taxonomy, bounded jittered restarts), queue-depth autoscaling "
+        "between --min/--max with graceful drain, lease-based takeover "
+        "of a dead or wedged daemon's claims (docs/service.md § Fleet "
+        "lifecycle).  The parent never imports jax",
+    )
+    pfleet.add_argument("service_dir", nargs="?", help=svc_help)
+    pfleet.add_argument(
+        "--daemons", type=int, default=2,
+        help="initial fleet size (default 2)",
+    )
+    pfleet.add_argument(
+        "--min", type=int, default=None, dest="min_daemons",
+        help="autoscale floor (default: --daemons)",
+    )
+    pfleet.add_argument(
+        "--max", type=int, default=None, dest="max_daemons",
+        help="autoscale ceiling (default: --daemons)",
+    )
+    pfleet.add_argument("--poll", type=float, default=0.5)
+    pfleet.add_argument(
+        "--stall-timeout", type=float, default=120.0,
+        help="kill + restart a daemon whose own heartbeat file freezes "
+        "for this long (an idle daemon still ticks every few seconds, "
+        "so frozen means wedged; default 120)",
+    )
+    pfleet.add_argument(
+        "--max-restarts", type=int, default=8,
+        help="per-daemon restart budget (default 8)",
+    )
+    pfleet.add_argument("--backoff-base", type=float, default=1.0)
+    pfleet.add_argument(
+        "--scale-up-pending", type=int, default=4,
+        help="pending jobs per live daemon that triggers a scale-up "
+        "(default 4)",
+    )
+    pfleet.add_argument("--scale-interval", type=float, default=5.0)
+    pfleet.add_argument(
+        "--scale-down-idle", type=float, default=60.0,
+        help="seconds of empty queue before one daemon is gracefully "
+        "drained (finishes claimed jobs, takes no new ones, exits 0; "
+        "default 60)",
+    )
+    pfleet.add_argument("--min-bucket", type=int, default=256)
+    pfleet.add_argument("--chunk-size", type=int, default=32768)
+    pfleet.add_argument(
+        "--visited-backend", choices=["device", "device-hash", "host"],
+        default="device",
+    )
+    pfleet.add_argument("--no-batching", action="store_true")
+    pfleet.add_argument("--cache-entries", type=int, default=32)
+    pfleet.add_argument("--no-state-cache", action="store_true")
+    pfleet.add_argument("--cpu", action="store_true",
+                        help="force the CPU platform in every daemon")
 
     psub = sub.add_parser(
         "submit",
@@ -812,6 +878,44 @@ def main(argv=None):
         # never pay the cold start (tests pin this with a poisoned jax)
         return _run_service_client(args)
 
+    if args.cmd == "serve-fleet":
+        # the fleet parent is jax-free (children are full `cli serve`
+        # processes with their own platform hygiene)
+        from ..service.fleet import FleetServeConfig, serve_fleet_daemons
+
+        serve_args = [
+            "--min-bucket", str(args.min_bucket),
+            "--chunk-size", str(args.chunk_size),
+            "--visited-backend", args.visited_backend,
+            "--cache-entries", str(args.cache_entries),
+        ]
+        if args.no_batching:
+            serve_args.append("--no-batching")
+        if args.no_state_cache:
+            serve_args.append("--no-state-cache")
+        if args.cpu:
+            serve_args.append("--cpu")
+        daemons = max(1, args.daemons)
+        return serve_fleet_daemons(
+            FleetServeConfig(
+                service_dir=_service_dir(args.service_dir),
+                daemons=daemons,
+                min_daemons=(
+                    daemons if args.min_daemons is None
+                    else max(1, args.min_daemons)
+                ),
+                max_daemons=args.max_daemons,
+                poll_s=args.poll,
+                stall_timeout=args.stall_timeout,
+                max_restarts=args.max_restarts,
+                backoff_base=args.backoff_base,
+                scale_interval_s=args.scale_interval,
+                scale_up_pending=args.scale_up_pending,
+                scale_down_idle_s=args.scale_down_idle,
+                serve_args=tuple(serve_args),
+            )
+        )
+
     if args.cmd == "serve" and args.supervised:
         # daemon supervision: same watchdog as engine runs, pointed at the
         # daemon's own heartbeat (it ticks every poll even when idle)
@@ -867,6 +971,7 @@ def main(argv=None):
                 visited_backend=args.visited_backend,
                 cache_entries=args.cache_entries,
                 batching=not args.no_batching,
+                state_cache=not args.no_state_cache,
             )
         )
 
@@ -1375,16 +1480,28 @@ def _run_service_client(args) -> int:
         kernel_source = (
             "emitted" if args.emitted else "hand" if args.hand else "auto"
         )
-        spec = q.submit(
-            cfg_text,
-            module,
-            tenant=args.tenant,
-            cfg_path=args.cfg,
-            kernel_source=kernel_source,
-            max_depth=args.max_depth,
-            max_states=args.max_states,
-            fault=args.fault,
-        )
+        try:
+            # the submit-side router retries transient queue-dir errors
+            # (EAGAIN/EIO/ESTALE — network filesystems) with bounded
+            # backoff inside JobQueue.submit; only a PERSISTENT failure
+            # reaches here, rendered cleanly instead of as a traceback
+            spec = q.submit(
+                cfg_text,
+                module,
+                tenant=args.tenant,
+                cfg_path=args.cfg,
+                kernel_source=kernel_source,
+                max_depth=args.max_depth,
+                max_states=args.max_states,
+                fault=args.fault,
+            )
+        except OSError as e:
+            print(
+                f"error: cannot publish job to {q.dir!r} after retries: "
+                f"{e}",
+                file=sys.stderr,
+            )
+            return 2
         if args.json and not args.wait:
             print(json.dumps({"job_id": spec["job_id"],
                               "service_dir": q.dir}))

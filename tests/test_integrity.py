@@ -239,7 +239,7 @@ def test_fault_registry_enumerates_every_kind():
     }
     flip = next(e for e in entries if e["kind"] == "flip")
     assert set(flip["sites"]) == {
-        "frontier", "fpset", "exchange", "spill", "ckpt"
+        "frontier", "fpset", "exchange", "spill", "ckpt", "cache"
     }
 
 
@@ -251,7 +251,7 @@ def test_cli_faults_list_is_jax_free_registry_dump(capsys):
     assert {e["kind"] for e in entries} >= {"flip", "crash", "enospc"}
     assert cli_main(["faults"]) == 0
     out = capsys.readouterr().out
-    assert "flip@frontier|fpset|exchange|spill|ckpt:N" in out
+    assert "flip@frontier|fpset|exchange|spill|ckpt|cache:N" in out
 
 
 def test_flip_deferral_and_resume_relief():

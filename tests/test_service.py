@@ -56,6 +56,10 @@ TTW_CFG_WEAK = TTW_CFG_TYPEOK.replace(
 def _daemon(svc_dir, **kw) -> Daemon:
     kw.setdefault("linger_s", 0.0)
     kw.setdefault("min_bucket", 32)
+    # this suite pins the KERNEL-cache / batching layer: the persistent
+    # state-space cache (PR 14) would short-circuit repeat jobs before
+    # they ever reach it (its own suite is tests/test_fleet.py)
+    kw.setdefault("state_cache", False)
     return Daemon(ServeConfig(service_dir=str(svc_dir), **kw))
 
 
