@@ -326,6 +326,121 @@ def _child_main():
         "venue": {"cores": os.cpu_count()},
     }
 
+    # Device-resident levels for the HOST-FpSet backend (PR 15,
+    # deferred once-per-level batched host dedup): device vs fused on
+    # the backend every production-scale run to date actually used (the
+    # 195.5M and 463.8M runs ride the host FpSet / disk tier — the
+    # device backend needs the whole fingerprint set in HBM).  The
+    # fused path pays one host sync + one FpSet insert per CHUNK; the
+    # device path runs the level as one dispatched while_loop with
+    # intra-level dedup on device and probes the host set ONCE per
+    # level.  Best-of-3 alternating; chunk 4096 (= the compact gate)
+    # gives multi-chunk levels — the O(chunks)-host-sync shape the
+    # deferred probe collapses.
+    dh_kwargs = dict(
+        store_trace=False,
+        min_bucket=4096,
+        chunk_size=4096,
+        visited_backend="host",
+        stats_path=os.devnull,
+    )
+    dh_w, fh_w = [], []
+    dh_stats = fh_stats = None
+    for p_ in ("device", "fused"):
+        check(model, pipeline=p_, max_states=60_000, **dh_kwargs)  # warm
+    for _ in range(3):
+        for p_ in ("device", "fused"):
+            r = check(model, pipeline=p_, **dh_kwargs)
+            assert r.ok and r.total == 737_794, (p_, r.total)
+            if p_ == "device":
+                dh_w.append(r.seconds)
+                dh_stats = r.stats
+            else:
+                fh_w.append(r.seconds)
+                fh_stats = r.stats
+    assert dh_stats["device"]["levels"] > 0, dh_stats["device"]
+    # only levels that actually ran the deferred probe carry the key —
+    # averaging the others in as 0.0 would dilute the per-probe figure
+    probe_ms = [
+        l["host_probe_ms"] for l in dh_stats["levels"]
+        if "host_probe_ms" in l
+    ]
+    # forced-spill disk tier, single alternating pass (the tier rides
+    # the same deferred probe; the signal here is that the batched
+    # sorted run probe keeps the disk tier AT LEAST at parity — full
+    # best-of-3 would double the bench wall for a secondary signal)
+    dsk = {}
+    for p_ in ("device", "fused"):
+        sd = tempfile.mkdtemp(prefix="kspec-bench-dh-")
+        try:
+            r = check(
+                model,
+                pipeline=p_,
+                store="disk",
+                mem_budget=1 << 20,
+                spill_dir=os.path.join(sd, "spill"),
+                **{k: v for k, v in dh_kwargs.items()
+                   if k != "visited_backend"},
+            )
+        finally:
+            shutil.rmtree(sd, ignore_errors=True)
+        assert r.ok and r.total == 737_794, (p_, r.total)
+        dsk[p_] = r
+    assert dsk["device"].stats["device"]["levels"] > 0
+    device_host_rec = {
+        "config": "host-FpSet backend (C arena), chunk 4096 "
+        "(multi-chunk levels; the O(chunks)-host-sync workload)",
+        "device_sps": round(737_794 / min(dh_w), 1),
+        "fused_sps": round(737_794 / min(fh_w), 1),
+        "device_walls_s": [round(s, 2) for s in dh_w],
+        "fused_walls_s": [round(s, 2) for s in fh_w],
+        "device_vs_fused": round(min(fh_w) / min(dh_w), 3),
+        "target": 1.5,
+        "launches_per_level": {
+            "device": _launch_rec(dh_stats),
+            "fused": _launch_rec(fh_stats),
+        },
+        "host_probe_ms_mean": round(
+            sum(probe_ms) / max(len(probe_ms), 1), 2
+        ),
+        "device_levels": dh_stats["device"]["levels"],
+        "device_fallback": dh_stats["device"]["fallback"],
+        "disk_tier": {
+            "config": "forced-spill disk tier (mem_budget 1M), chunk "
+            "4096, single alternating pass",
+            "device_s": round(dsk["device"].seconds, 2),
+            "fused_s": round(dsk["fused"].seconds, 2),
+            "device_vs_fused": round(
+                dsk["fused"].seconds / dsk["device"].seconds, 3
+            ),
+            "spills": dsk["device"].stats["spill"]["spills"],
+        },
+        # venue honesty (the PR 10 Amdahl-note / PR 13 multiprocess
+        # precedent): on this 1-core CPU container the ratio INVERTS —
+        # the deferred path's in-jit per-chunk lexsort + level-new
+        # merge compete for the SAME core that runs the C hash insert
+        # they replace, and a C open-addressing insert is far cheaper
+        # than an XLA:CPU sort, so the fused per-chunk path (no device
+        # dedup at all on this backend) wins the wall here.  What this
+        # venue CANNOT price is the lever the path exists for: host
+        # syncs 1/level vs O(chunks) and successor launches <=2/level
+        # vs 2/chunk, each a device->host round trip on a real
+        # accelerator (~1.2s/level dispatch through the TPU tunnel,
+        # TPU_PROFILE.jsonl).  The venue-independent signals banked
+        # here: launches/level max 2 vs 42, ONE batched probe per
+        # level at ~4ms (the engine's measured host_ms drops ~4x), and
+        # bit-identity across the whole matrix.  The >=1.5x wall
+        # target needs an accelerator venue where device compute and
+        # host FpSet run on different silicon.
+        "venue": {
+            "cores": os.cpu_count(),
+            "note": "1-core CPU venue: the in-jit sort/dedup and the "
+            "C FpSet share one core, so removing host syncs cannot "
+            "pay; ratio meaningful only on a real accelerator "
+            "(see launches/probe structural signals)",
+        },
+    }
+
     # Exchange compression on the 8-device CI mesh (ROADMAP item 5's
     # measure): run in a sub-child — the virtual 8-device platform must
     # be configured before jax initializes, which this process already
@@ -435,6 +550,7 @@ def _child_main():
                 "integrity": integrity_rec,
                 "overlap": overlap_rec,
                 "device_resident": device_rec,
+                "device_host_backend": device_host_rec,
                 "exchange": exchange_rec,
                 "sharded_device": sharded_device_rec,
             }
@@ -448,6 +564,19 @@ def _child_main():
         f"level max {device_rec['launches_per_level']['device']['per_level_max']}"
         f" device vs {device_rec['launches_per_level']['fused']['per_level_max']}"
         f" fused",
+        file=sys.stderr,
+    )
+    dh = device_host_rec
+    print(
+        f"# device-resident HOST backend (C-arena FpSet, chunk 4096): "
+        f"device {dh['device_sps']:,.0f} vs fused "
+        f"{dh['fused_sps']:,.0f} states/sec = {dh['device_vs_fused']}x "
+        f"(target >=1.5x); launches/level max "
+        f"{dh['launches_per_level']['device']['per_level_max']} device "
+        f"vs {dh['launches_per_level']['fused']['per_level_max']} "
+        f"fused; batched probe {dh['host_probe_ms_mean']}ms/level; "
+        f"disk tier {dh['disk_tier']['device_vs_fused']}x "
+        f"({dh['disk_tier']['spills']} spills)",
         file=sys.stderr,
     )
     print(

@@ -1055,14 +1055,18 @@ def check(
     "device" = the device-resident level pipeline — a bounded
     lax.while_loop processes EVERY gated chunk of a level inside one
     dispatched program (expansion, in-jit segmented compaction,
-    fingerprints, dedup against the device-resident visited set,
-    verdicts and the per-level digest folds all on-device; the
-    O(capacity) visited merge runs once per LEVEL instead of once per
-    chunk), i.e. <=2 successor launches per level; requires the
-    sorted-set "device" visited backend and analyzer-proven per-field
-    value hulls (analysis.field_hulls — a hard precondition, not
-    env-disablable like the build gate) and otherwise degrades to the
-    fused per-chunk ladder (stats["device"]["fallback"] records why);
+    fingerprints, intra-level dedup, verdicts all on-device), i.e. <=2
+    successor launches per level.  On the sorted-set "device" backend
+    the visited probe + digest folds run in-jit and the O(capacity)
+    visited merge runs once per LEVEL instead of once per chunk; on
+    the "host" backend (incl. the disk tier) the visited probe is
+    DEFERRED to ONE batched host FpSet/tiered-run call per level
+    (host syncs O(1)/level instead of O(chunks), serial winner rule
+    preserved).  Requires analyzer-proven per-field value hulls
+    (analysis.field_hulls — a hard precondition, not env-disablable
+    like the build gate); the "device-hash" backend and any other
+    unmet precondition degrade to the fused per-chunk ladder
+    (stats["device"]["fallback"] records why, naming the backend);
     "legacy" = the historical per-action monolithic step.  All are
     bit-identical — same level counts, duplicate accounting,
     first-violation rule, trace values and digest chains
@@ -2028,6 +2032,25 @@ def check(
         )
 
 
+    def _grow_arena(nn: int) -> None:
+        """Ensure the level arena holds >= nn more rows past a_w (the
+        all-novel worst case insert_compact writes unchecked) — ONE
+        growth policy for the per-chunk and device-level commits.
+        Growth copies only the filled prefix (amortized O(level))."""
+        nonlocal a_rows, a_parent, a_act, a_cap
+        if a_w + nn <= a_cap:
+            return
+        a_cap = max(2 * a_cap, a_w + nn)
+        na = np.empty((a_cap, K), np.uint32)
+        na[:a_w] = a_rows[:a_w]
+        a_rows = na
+        npar = np.empty(a_cap, np.int64)
+        npar[:a_w] = a_parent[:a_w]
+        a_parent = npar
+        nact = np.empty(a_cap, np.int32)
+        nact[:a_w] = a_act[:a_w]
+        a_act = nact
+
     def _commit_chunk(st) -> bool:
         """Commit one staged chunk: block on its device outputs
         (finalize), run the verdict checks and shadow oracle, then the
@@ -2038,7 +2061,7 @@ def check(
         discarded uncommitted)."""
         nonlocal vhi, vlo, vn, verdict, lvl_new, prof_step, prof_host_s
         nonlocal lvl_launches, lvl_launches_max, run_launches_max
-        nonlocal lvl_act_en, a_rows, a_parent, a_act, a_w, a_cap
+        nonlocal lvl_act_en, a_w  # arena buffers grow via _grow_arena
         nonlocal ht_hi, ht_lo, ht_claim, hash_n, pallas_vmem_noted
         (start, fp_n, bucket, finalize, pre_v, shadow, dispatch_s,
          t_staged, piece, pre_vcap) = st
@@ -2104,17 +2127,7 @@ def check(
         t_host = time.perf_counter()
         if host_set is not None and nn:
             if use_arena:
-                if a_w + nn > a_cap:
-                    a_cap = max(2 * a_cap, a_w + nn)
-                    na = np.empty((a_cap, K), np.uint32)
-                    na[:a_w] = a_rows[:a_w]
-                    a_rows = na
-                    npar = np.empty(a_cap, np.int64)
-                    npar[:a_w] = a_parent[:a_w]
-                    a_parent = npar
-                    nact = np.empty(a_cap, np.int32)
-                    nact[:a_w] = a_act[:a_w]
-                    a_act = nact
+                _grow_arena(nn)
                 w = host_set.insert_compact(
                     np.ascontiguousarray(out_hi[:nn], np.uint32),
                     np.ascontiguousarray(out_lo[:nn], np.uint32),
@@ -2293,14 +2306,30 @@ def check(
     def _commit_device_level(fin, dispatch_s: float, plan) -> bool:
         """Commit a whole device-resident level (DevicePipeline.run_level):
         block on the level program's outputs, apply the serial commit
-        loop's verdict rule, then the host bookkeeping — trace
-        accumulation and the digest-chain fold from the DEVICE-computed
-        (count, xor, sum) accumulator (bit-exact with the per-chunk host
-        folds; ops/devlevel.py).  Returns True when a verdict fired (the
-        level's tail chunks are never dispatched — the serial break)."""
+        loop's verdict rule, then the host bookkeeping.
+
+        Device backend: trace accumulation and the digest-chain fold
+        from the DEVICE-computed (count, xor, sum) accumulator
+        (bit-exact with the per-chunk host folds; ops/devlevel.py).
+
+        Host backend (deferred-probe mode): the level's novel
+        candidates — unique within the level, chunk-major candidate
+        order — are probed/inserted against the host FpSet / disk tier
+        in ONE batched call (the tentpole: host syncs O(1) per level).
+        The serial winner rule is preserved because intra-level
+        duplicates were already resolved on device with the earlier
+        chunk winning, and the batch replays in exactly the order the
+        serial per-chunk commits would have inserted; the digest chain
+        folds the probe SURVIVORS, the same multiset the serial commits
+        fold.  Verdicts derive from the frontier states being expanded
+        (already probed/committed by the previous level), so the
+        deferred probe cannot change them — nothing needs re-deriving.
+
+        Returns True when a verdict fired (the level's tail chunks are
+        never dispatched — the serial break)."""
         nonlocal verdict, lvl_new, prof_step, prof_host_s
         nonlocal lvl_launches, lvl_launches_max, run_launches_max
-        nonlocal lvl_act_en
+        nonlocal lvl_act_en, lvl_probe_ms, a_w
         t_wait = time.perf_counter()
         out = fin()
         wait_s = time.perf_counter() - t_wait
@@ -2333,7 +2362,65 @@ def check(
             return True
         t_host = time.perf_counter()
         nn = out["new_n"]
-        if nn:
+        if host_set is not None:
+            # the deferred batched probe — ONE host call for the level
+            t_probe = time.perf_counter()
+            committed = 0
+            if nn:
+                if use_arena:
+                    _grow_arena(nn)
+                    # parents are already level-global (the device
+                    # program added each chunk's offset), so base 0
+                    committed = host_set.insert_compact(
+                        out["hi"],
+                        out["lo"],
+                        np.ascontiguousarray(out["rows"], np.uint32),
+                        np.ascontiguousarray(out["parent"], np.int32),
+                        0,
+                        np.ascontiguousarray(out["act"], np.int32),
+                        a_rows[a_w:],
+                        a_parent[a_w:],
+                        a_act[a_w:],
+                    )
+                    if chain is not None and committed:
+                        chain.fold(
+                            _integ.fingerprint_rows(
+                                a_rows[a_w: a_w + committed],
+                                spec.exact64,
+                            )
+                        )
+                    a_w += committed
+                else:  # tiered disk store, or no native toolchain
+                    fps_u64 = _u64(out["hi"], out["lo"])
+                    # the disk tier's level-batched form probes every
+                    # spilled run ONCE for the whole (sorted) level
+                    # batch; plain FpSets take the ordinary batch insert
+                    mask = (
+                        host_set.insert_level(fps_u64)
+                        if hasattr(host_set, "insert_level")
+                        else host_set.insert(fps_u64)
+                    ).astype(bool)
+                    rows = out["rows"][mask]
+                    par = out["parent"].astype(np.int64)[mask]
+                    acts = out["act"][mask]
+                    if disk is not None:
+                        disk.append(rows, par, acts)
+                    else:
+                        lvl_rows.append(rows)
+                        lvl_parent.append(par)
+                        lvl_act.append(acts)
+                    committed = int(mask.sum())
+                    if chain is not None:
+                        chain.fold(fps_u64[mask])
+                lvl_new += committed
+            probe_s = time.perf_counter() - t_probe
+            lvl_probe_ms += probe_s * 1e3
+            obs_.chunk_span(
+                "host-probe", probe_s, depth=depth, rows=nn,
+                new=committed, backend=visited_backend,
+                batched="level",
+            )
+        elif nn:
             lvl_rows.append(out["rows"])
             lvl_parent.append(out["parent"])
             lvl_act.append(out["act"])
@@ -2433,6 +2520,7 @@ def check(
             lvl_act_en = np.zeros(len(model.actions), np.int64)
             lvl_launches = 0  # successor-kernel launches this level
             lvl_launches_max = 0  # ... and the per-chunk maximum
+            lvl_probe_ms = 0.0  # deferred batched host-probe wall
             verdict = None  # (kind, global_frontier_idx, inv_name)
             # Host-native backend: assemble the next level in a preallocated
             # arena via the fused C pass (native.FpSet.insert_compact) — one
@@ -2477,14 +2565,53 @@ def check(
             dev_plan = (
                 pipe.plan_level(f_total, chunk, min_bucket)
                 if getattr(pipe, "name", "") == "device"
-                and isinstance(frontier_np, np.ndarray)
                 else None
             )
             if dev_plan is not None:
                 governor.poll(depth)
+                # disk tier: the spilled frontier's handled prefix is
+                # materialized for the device span — it must be staged
+                # into the device buffer anyway, so this is one host
+                # copy of what the per-chunk loop would read piecewise.
+                # A level too large to materialize degrades to the
+                # per-chunk ladder, which streams chunks from disk —
+                # the same sticky-fallback contract as a compile
+                # failure, never a crashed run.  Two layers: a PRE-SIZE
+                # gate (Linux overcommit means a doomed allocation can
+                # OOM-kill the process during the copy rather than
+                # raise, so waiting for MemoryError is not enough) and
+                # the MemoryError catch for allocators that do raise.
+                mat_bytes = f_total * K * 4
+                mat_budget = int(os.environ.get(
+                    "KSPEC_DEVLEVEL_MAT_BUDGET", str(1 << 31)
+                ))
+                if (not isinstance(frontier_np, np.ndarray)
+                        and mat_bytes > mat_budget):
+                    pipe._mark_fallback(
+                        f"spilled frontier too large to materialize "
+                        f"for the device span ({mat_bytes} B > "
+                        f"KSPEC_DEVLEVEL_MAT_BUDGET {mat_budget} B)",
+                        depth,
+                    )
+                    dev_plan = None
+                else:
+                    try:
+                        dev_rows = (
+                            frontier_np
+                            if isinstance(frontier_np, np.ndarray)
+                            else _f_all(frontier_np)
+                        )
+                    except MemoryError as e:
+                        pipe._mark_fallback(
+                            f"frontier materialization failed "
+                            f"({f_total} rows): {e}"[:200],
+                            depth,
+                        )
+                        dev_plan = None
+            if dev_plan is not None:
                 t_attempt = time.perf_counter()
                 dres = pipe.run_level(
-                    frontier_np, f_total, depth, vhi, vlo, vn, vcap,
+                    dev_rows, f_total, depth, vhi, vlo, vn, vcap,
                     dev_plan,
                 )
                 if dres is not None:
@@ -2494,7 +2621,23 @@ def check(
                     if _commit_device_level(dev_fin, dispatch_s,
                                             dev_plan):
                         dev_handled = f_total  # verdict: skip the tail
-            for start, piece in _f_chunks(frontier_np, chunk):
+            # Tail iteration after a device-resident span: a fully-
+            # handled level skips it entirely, and a disk-tier tail
+            # slices the ALREADY-materialized rows at the same serial
+            # chunk boundaries (dev_handled is a chunk multiple by
+            # plan) — the spilled frontier's iter_chunks performs real
+            # segment reads even for skipped chunks, so neither case
+            # may re-read the device-handled prefix from disk.
+            if dev_handled >= f_total:
+                tail_chunks = ()
+            elif dev_handled and not isinstance(frontier_np, np.ndarray):
+                tail_chunks = (
+                    (s, dev_rows[s: s + chunk])
+                    for s in range(dev_handled, f_total, chunk)
+                )
+            else:
+                tail_chunks = _f_chunks(frontier_np, chunk)
+            for start, piece in tail_chunks:
                 if start < dev_handled:
                     continue  # committed by the device-resident span
                 governor.poll(depth)  # deadline watchdog (cheap)
@@ -2648,6 +2791,16 @@ def check(
                         **rec,
                         "successor_launches": lvl_launches,
                         "launches_per_chunk_max": lvl_launches_max,
+                        # deferred batched host-probe attribution (the
+                        # host-backend device path): in-memory records
+                        # + the gauge/span side channels only — the
+                        # emitted stats stream stays record-for-record
+                        # historical (PR 7/10/13 precedent)
+                        **(
+                            {"host_probe_ms": round(lvl_probe_ms, 2)}
+                            if lvl_probe_ms
+                            else {}
+                        ),
                     }
                 )
                 # launches/level gauge (obs): the device pipeline's
@@ -2656,6 +2809,12 @@ def check(
                 _met.set_gauge(
                     "kspec_successor_launches_level", lvl_launches
                 )
+                if lvl_probe_ms:
+                    # probe-ms/level gauge: the deferred-probe beat
+                    # `cli report` renders next to launches/level
+                    _met.set_gauge(
+                        "kspec_host_probe_ms", round(lvl_probe_ms, 2)
+                    )
             if collect_levels is not None and new_n:
                 collect_levels.append(_f_all(next_frontier))
             if store_trace:

@@ -66,28 +66,39 @@ pipeline-agnostic.  Two implementations ship:
 A third implementation collapses the chunk loop itself into the
 accelerator:
 
-``device`` — the device-resident level pipeline: for the sorted-set
-  device visited backend, a bounded ``lax.while_loop`` processes EVERY
-  gated chunk of a level inside ONE dispatched program — guard-matrix
-  expansion, in-jit segmented compaction (the per-action cumsum/scatter
-  the fused path had moved to the host), fingerprints, dedup against
-  the device-resident visited set, invariant/deadlock verdicts, the
-  PR 9 (count, xor, sum) digest folds (ops/devlevel.py), and next-
-  frontier assembly, with the O(capacity) visited merge deferred to
-  ONE rank-scatter per level instead of one per chunk (novelty inside
-  the level is decided against a separate device-resident level-new
-  sorted set, whose content equals exactly the states the serial path
-  would have merged chunk-by-chunk).  A level costs <=2 successor
-  launches TOTAL — one steady-state, two when a segment-width overflow
-  forces a re-dispatch at exact measured widths — instead of the fused
-  path's 2 per chunk.  Bit-identity with ``legacy`` holds chunk for
-  chunk (same candidate order, same stable-sort winners, same verdict
-  priority, same digest multisets; docs/engine.md § Device-resident
-  level pipeline states the argument), and anything the device program
-  cannot serve — host/hash visited backends, disk tier, sub-gate
-  chunks, shadow re-execution, kernels without analyzer-proven field
-  hulls (analysis.field_hulls), compile failure — degrades to ``fused``
-  via the documented ladder (device -> fused -> legacy).
+``device`` — the device-resident level pipeline: a bounded
+  ``lax.while_loop`` processes EVERY gated chunk of a level inside ONE
+  dispatched program — guard-matrix expansion, in-jit segmented
+  compaction (the per-action cumsum/scatter the fused path had moved
+  to the host), fingerprints, intra-level dedup against a
+  device-resident level-new sorted set, invariant/deadlock verdicts,
+  and next-frontier assembly.  On the sorted-set device visited
+  backend the program additionally probes the (read-only) visited set
+  in-jit, folds the PR 9 (count, xor, sum) digests on device
+  (ops/devlevel.py), and defers the O(capacity) visited merge to ONE
+  rank-scatter per level instead of one per chunk (the level-new set's
+  content equals exactly the states the serial path would have merged
+  chunk-by-chunk).  On the HOST visited backend — the C-arena FpSet
+  and its disk tier, the production-scale configuration — the device
+  holds no visited set at all: the level's novel candidates come back
+  in one transfer (rows + fingerprint lanes, chunk-major CANDIDATE
+  order — the exact order the serial commit loop feeds the FpSet) and
+  the visited probe/insert runs as ONE batched host call per level, so
+  host syncs drop from O(chunks) to O(1) per level and the serial
+  winner rule is preserved (a cross-chunk intra-level duplicate is
+  caught by the level-new set with the earlier chunk winning — the
+  same winner the serial per-chunk insert picks).  A level costs <=2
+  successor launches TOTAL — one steady-state, two when a
+  segment-width overflow forces a re-dispatch at exact measured widths
+  — instead of the fused path's 2 per chunk.  Bit-identity with
+  ``legacy`` holds chunk for chunk (same candidate order, same
+  stable-sort winners, same verdict priority, same digest multisets;
+  docs/engine.md § Device-resident level pipeline states the
+  argument), and anything the device program cannot serve — the
+  device-hash backend, sub-gate chunks, shadow re-execution, kernels
+  without analyzer-proven field hulls (analysis.field_hulls), compile
+  failure — degrades to ``fused`` via the documented ladder
+  (device -> fused -> legacy).
 
 Plugging a new stage implementation: subclass (or parallel-implement)
 a pipeline with the same ``run_chunk`` contract and register it in
@@ -129,6 +140,9 @@ def key_vcap(key: tuple) -> Optional[int]:
       ("fgd",  bucket, inv_sig)                     — fused launch 1
       ("fsc",  bucket, vcap, widths, with_merge, device_out, pallas)
       ("dvl",  bucket, vcap, ncp, widths, ln, inv_sig, deadlock, pallas)
+      ("dvh",  bucket, ncp, widths, ln, inv_sig, deadlock, pallas)
+                 — the host-backend (deferred-probe) level program:
+                   no vcap component, the program embeds no visited set
     """
     tag = key[0]
     if tag in ("step", "fsc", "dvl"):
@@ -260,6 +274,57 @@ def sorted_dedup_stage(cand, parent, actid, valid, hi, lo,  # kspec: traced
         )
     return (out, out_parent, out_act, new_n, out_hi, out_lo,
             vhi, vlo, vn, out_rank)
+
+
+def candidate_dedup_stage(cand, parent, actid, valid, hi, lo,  # kspec: traced
+                          lhi, llo, ln, T, K):
+    """Stage 4 for the DEFERRED-probe host backends: intra-level novelty
+    with the compacted novel prefix emitted in CANDIDATE order.
+
+    Winners are elected by the SAME stable lexsort sequence as
+    :func:`sorted_dedup_stage` (first occurrence among equal
+    fingerprints in candidate order — exactly the row the serial host
+    commit's first-come FpSet insert keeps), but the novel prefix is
+    emitted in CANDIDATE order, because that is the order the serial
+    per-chunk host path hands rows to the FpSet: the deferred batched
+    probe replays the level in chunk-major candidate order, so the
+    committed arena contents — rows, parents, action ids, and hence
+    next-level chunk boundaries and trace values — are byte-identical
+    to the serial path's.  (lhi, llo, ln) is the device-resident
+    level-new sorted set; the sorted view (n_hi/n_lo/n_rank) feeds its
+    gated merge exactly as sorted_dedup_stage's outputs do.  States
+    already in the VISITED set are deliberately still emitted here —
+    the device holds no visited set in this mode; the host's
+    once-per-level batched probe filters them, which is the same
+    novelty decision the serial per-chunk insert makes, one level
+    later in wall time and with O(1) host syncs instead of O(chunks).
+
+    Returns (out, out_parent, out_act, out_hi, out_lo, new_n,
+    n_hi, n_lo, n_rank)."""
+    sent = jnp.uint32(dedup.SENT)
+    order = jnp.lexsort((lo, hi))
+    hi_s, lo_s = hi[order], lo[order]
+    invalid_s = (hi_s == sent) & (lo_s == sent)
+    first = dedup.first_occurrence_mask(hi_s, lo_s, invalid_s)
+    seen, rank = dedup.rank_sorted(lhi, llo, ln, hi_s, lo_s)
+    is_new = first & ~seen
+    # sorted-order compaction: what the level-new merge consumes
+    pos_s = jnp.where(is_new, jnp.cumsum(is_new) - 1, T)
+    n_hi = jnp.full((T,), sent).at[pos_s].set(hi_s)
+    n_lo = jnp.full((T,), sent).at[pos_s].set(lo_s)
+    n_rank = jnp.zeros((T,), jnp.int32).at[pos_s].set(rank)
+    new_n = jnp.sum(is_new, dtype=jnp.int32)
+    # candidate-order compaction: scatter the sorted novelty decisions
+    # back to candidate positions, then compact without re-sorting
+    isnew_c = jnp.zeros((T,), bool).at[order].set(is_new)
+    pos_c = jnp.where(isnew_c, jnp.cumsum(isnew_c) - 1, T)
+    out = jnp.zeros((T, K), jnp.uint32).at[pos_c].set(cand)
+    out_parent = jnp.full((T,), -1, jnp.int32).at[pos_c].set(parent)
+    out_act = jnp.full((T,), -1, jnp.int32).at[pos_c].set(actid)
+    out_hi = jnp.full((T,), sent).at[pos_c].set(hi)
+    out_lo = jnp.full((T,), sent).at[pos_c].set(lo)
+    return (out, out_parent, out_act, out_hi, out_lo, new_n,
+            n_hi, n_lo, n_rank)
 
 
 # --------------------------------------------------------------------------
@@ -851,15 +916,27 @@ def device_hull_fallback(model) -> Optional[str]:
 class DevicePipeline:
     """Device-resident level pipeline (module docstring): one dispatched
     ``lax.while_loop`` program runs every gated chunk of a BFS level —
-    <=2 successor launches per LEVEL — with the visited-set merge
-    deferred to one rank-scatter per level.  Requires the sorted-set
-    ``device`` visited backend and analyzer-proven per-field value hulls
+    <=2 successor launches per LEVEL.  Two native backends:
+
+    - sorted-set ``device``: in-jit dual-probe dedup (read-only visited
+      set + level-new set), the visited-set merge deferred to one
+      rank-scatter per level, in-jit digest folds;
+    - ``host`` (incl. the disk tier): deferred-probe mode — the device
+      holds NO visited set, intra-level novelty is decided against the
+      level-new sorted set alone, and the level's novel candidates come
+      back (rows + fingerprint lanes, chunk-major CANDIDATE order) for
+      ONE batched host FpSet / tiered-run probe per level
+      (engine.bfs._commit_device_level) — host syncs drop from
+      O(chunks) to O(1) per level on the production backend.
+
+    Both require analyzer-proven per-field value hulls
     (analysis.field_hulls: the in-jit pack stage runs with no host-side
     validation between chunks, so the no-truncation proof is a hard
     precondition here, independent of the KSPEC_ANALYZE build-gate
-    toggle); everything else — and any compile/dispatch failure —
-    degrades to the ``fused`` per-chunk path, which itself degrades to
-    ``legacy`` (the documented ladder)."""
+    toggle); everything else — ``device-hash``, sub-gate chunks, shadow
+    re-execution, and any compile/dispatch failure — degrades to the
+    ``fused`` per-chunk path, which itself degrades to ``legacy`` (the
+    documented ladder)."""
 
     name = "device"
     launches_per_chunk = 2  # nominal figure when delegating per-chunk
@@ -886,12 +963,21 @@ class DevicePipeline:
         #: sticky fallback reason; None while the level path is live
         self.device_fallback: Optional[str] = None
         self.device_levels = 0  # levels actually run device-resident
-        if visited_backend != "device":
-            self.device_fallback = (
-                f"visited backend {visited_backend!r} is not the "
-                f"device-resident sorted set"
-            )
-        else:
+        #: deferred-probe mode (host / disk-tier visited backends): the
+        #: level program carries NO visited set — intra-level novelty
+        #: against the level-new sorted set only, and the host probes
+        #: the level's novel candidates in ONE batched call per level
+        #: (engine.bfs._commit_device_level's host branch)
+        self.host_mode = visited_backend == "host"
+        from ..pipeline_registry import backend_fallback_reason
+
+        # the registry's per-backend support matrix is the ONE source of
+        # which backends this pipeline serves natively; unsupported
+        # cells degrade with the registry's own (backend-naming) reason
+        self.device_fallback = backend_fallback_reason(
+            "device", visited_backend
+        )
+        if self.device_fallback is None:
             self._check_hulls()
 
     def _check_hulls(self) -> None:
@@ -956,6 +1042,20 @@ class DevicePipeline:
 
     def _level_program(self, B: int, NCp: int, vcap: int, widths: tuple,
                        LN: int):
+        if self.host_mode:
+            # no vcap component: the program embeds no visited set, so
+            # capacity growth can never evict it (key_vcap -> None)
+            key = ("dvh", B, NCp, widths, LN,
+                   self.step.inv_sig(self.check_invariants),
+                   self.check_deadlock, self.step.use_pallas)
+            return self.step.cached(
+                key,
+                lambda: jax.jit(
+                    self._build_level_host(B, NCp, widths, LN)
+                ),
+                bucket=B, chunks=NCp, widths=repr(widths),
+                level_new_cap=LN, program="device-level-host",
+            )
         key = ("dvl", B, vcap, NCp, widths, LN,
                self.step.inv_sig(self.check_invariants),
                self.check_deadlock, self.step.use_pallas)
@@ -1121,6 +1221,131 @@ class DevicePipeline:
 
         return level
 
+    def _build_level_host(self, B: int, NCp: int, widths: tuple,
+                          LN: int):
+        """The whole-level program for the HOST (deferred-probe) visited
+        backends — the C-arena FpSet and the disk tier.  Identical chunk
+        walk to :meth:`_build_level`, with three deltas:
+
+        - the device holds NO visited set: novelty inside the level is
+          decided against the level-new sorted set alone
+          (candidate_dedup_stage — same stable-sort winners as the
+          device backend, but emitted in CANDIDATE order, the order the
+          serial host commit feeds the FpSet), and the host filters
+          already-visited states in ONE batched probe per level;
+        - the emitted prefix carries its fingerprint lanes out (ohi/olo
+          accumulators) so the host probe never recomputes them;
+        - no in-jit digest: the multiset the chain folds is only known
+          AFTER the probe, so the host folds the surviving fingerprints
+          exactly as the serial per-chunk commit does.
+
+        Verdicts derive from the FRONTIER states being expanded — states
+        the previous level already probed and committed — so the
+        deferred probe cannot change them; the serial priority
+        (invariants beat deadlock within a chunk, earlier chunks beat
+        later ones, a verdict chunk commits nothing) is mirrored
+        unchanged.  docs/engine.md § Device-resident level pipeline
+        states the full bit-identity argument."""
+        model, spec = self.model, self.spec
+        K = spec.num_lanes
+        T = self.step.expand_width(B, widths)
+        OC = LN + T  # one chunk of append headroom past LN (as _build_level)
+        expand = self.step.make_expand(B, widths)
+        check_invariants = self.check_invariants
+        check_deadlock = self.check_deadlock
+        use_pallas = self.step.use_pallas
+        n_actions = len(model.actions)
+
+        def level(fbuf, f_total, n_chunks):  # kspec: traced
+            sent = jnp.uint32(dedup.SENT)
+
+            def body(carry):  # kspec: traced
+                (i, orows, opar, oact, ohi, olo, on, lhi, llo, ln,
+                 vkind, vinv, vidx, act_en, agmax, ovf) = carry
+                start = i * B
+                rows = jax.lax.dynamic_slice(fbuf, (start, 0), (B, K))
+                fvalid = (
+                    start + jnp.arange(B, dtype=jnp.int32)
+                ) < f_total
+                states = jax.vmap(spec.unpack)(rows)
+                (en_pre, cand, valid, parent, actid, a_en, a_guard,
+                 exp_ovf) = expand(states, fvalid)
+                deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
+                viol_any, viol_idx = invariant_stage(
+                    model, states, fvalid, check_invariants
+                )
+                (cand, parent, actid, rowvalid, _n_en,
+                 sq_ovf) = squeeze_stage(cand, parent, actid, valid,
+                                         T, K)
+                hi, lo = fp_stage(cand, rowvalid, spec, use_pallas)
+                (n_out, n_par, n_act, n_ohi, n_olo, new_n,
+                 s_hi, s_lo, s_rank) = candidate_dedup_stage(
+                    cand, parent, actid, rowvalid, hi, lo,
+                    lhi, llo, ln, T, K,
+                )
+                # verdicts, serial-commit priority (same as _build_level)
+                inv_any = jnp.any(viol_any)
+                inv_i = jnp.argmax(viol_any).astype(jnp.int32)
+                dl_any = jnp.bool_(check_deadlock) & jnp.any(deadlocked)
+                kind = jnp.where(
+                    inv_any, jnp.int32(1),
+                    jnp.where(dl_any, jnp.int32(2), jnp.int32(0)),
+                )
+                g_idx = jnp.where(
+                    inv_any, viol_idx[inv_i],
+                    jnp.argmax(deadlocked).astype(jnp.int32),
+                ).astype(jnp.int32) + start
+                take = (vkind == 0) & (kind != 0)
+                commit = kind == 0  # a verdict chunk commits nothing
+                ln_ovf = commit & ((ln + new_n) > LN)
+                commit_ok = commit & ~ovf & ~ln_ovf
+                app_n = jnp.where(commit_ok, new_n, 0)
+                orows = devlevel.append_rows(orows, n_out, on)
+                opar = devlevel.append_vec(opar, n_par + start, on)
+                oact = devlevel.append_vec(oact, n_act, on)
+                ohi = devlevel.append_vec(ohi, n_ohi, on)
+                olo = devlevel.append_vec(olo, n_olo, on)
+                lhi, llo, ln = dedup.merge_ranked(
+                    lhi, llo, ln, s_hi, s_lo, s_rank, app_n, LN
+                )
+                act_en = act_en + jnp.where(commit_ok, a_en, 0)
+                agmax = jnp.maximum(agmax, a_guard)
+                ovf = ovf | jnp.any(exp_ovf) | sq_ovf | ln_ovf
+                return (i + 1, orows, opar, oact, ohi, olo,
+                        on + app_n, lhi, llo, ln,
+                        jnp.where(take, kind, vkind),
+                        jnp.where(take, inv_i, vinv),
+                        jnp.where(take, g_idx, vidx),
+                        act_en, agmax, ovf)
+
+            def cond(carry):  # kspec: traced
+                return (carry[0] < n_chunks) & (carry[10] == 0)
+
+            init = (
+                jnp.int32(0),
+                jnp.zeros((OC, K), jnp.uint32),
+                jnp.full((OC,), -1, jnp.int32),
+                jnp.full((OC,), -1, jnp.int32),
+                jnp.full((OC,), sent),
+                jnp.full((OC,), sent),
+                jnp.int32(0),
+                jnp.full((LN,), sent),
+                jnp.full((LN,), sent),
+                jnp.int32(0),
+                jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                jnp.zeros((n_actions,), jnp.int32),
+                jnp.zeros((n_actions,), jnp.int32),
+                jnp.bool_(False),
+            )
+            (_i, orows, opar, oact, ohi, olo, on, _lh, _ll, _ln,
+             vkind, vinv, vidx, act_en, agmax, ovf) = jax.lax.while_loop(
+                cond, body, init
+            )
+            return (orows, opar, oact, ohi, olo, on, vkind, vinv,
+                    vidx, act_en, agmax, ovf)
+
+        return level
+
     def run_level(self, frontier_np, f_total: int, depth: int,
                   vhi, vlo, vn, vcap: int, plan):
         """Run the whole-level program (with the <=1 exact-width
@@ -1160,20 +1385,30 @@ class DevicePipeline:
         fbuf = None
         outgrown: list = []  # vcaps outgrown this level; evicted on success
         pre_v = (vhi, vlo, vn)  # re-dispatch replays from pre-level state
+        # output-tuple indices differ between the two program variants
+        # (the host program has no visited set and no digest, but adds
+        # the ohi/olo fingerprint accumulators)
+        i_vkind, i_agmax, i_ovf = (
+            (6, 10, 11) if self.host_mode else (7, 11, 13)
+        )
         while True:
             try:
                 injected = self.fault.chunk_error(escalated=True)
                 if injected is not None:
                     raise injected
-                need = int(vn) + min(NCp * T, LN + T)
-                if need > vcap:
-                    # eviction of the outgrown capacity's programs is
-                    # DEFERRED until this level dispatches successfully:
-                    # a growth followed by a device compile failure must
-                    # leave the per-chunk fallback's programs warm
-                    outgrown.append(vcap)
-                    vhi, vlo, vcap = grow_visited(vhi, vlo, vcap, need)
-                    pre_v = (vhi, vlo, vn)
+                if not self.host_mode:
+                    need = int(vn) + min(NCp * T, LN + T)
+                    if need > vcap:
+                        # eviction of the outgrown capacity's programs
+                        # is DEFERRED until this level dispatches
+                        # successfully: a growth followed by a device
+                        # compile failure must leave the per-chunk
+                        # fallback's programs warm
+                        outgrown.append(vcap)
+                        vhi, vlo, vcap = grow_visited(
+                            vhi, vlo, vcap, need
+                        )
+                        pre_v = (vhi, vlo, vn)
                 if fbuf is None:
                     # only the handled prefix rides the device buffer: an
                     # un-gated tail chunk (handled < f_total) runs through
@@ -1183,10 +1418,14 @@ class DevicePipeline:
                         _pad_rows(frontier_np[:handled], NCp * B)
                     )
                 fn = self._level_program(B, NCp, vcap, widths, LN)
-                outs = fn(fbuf, jnp.int32(handled), jnp.int32(nc),
-                          *pre_v)
+                if self.host_mode:
+                    outs = fn(fbuf, jnp.int32(handled), jnp.int32(nc))
+                else:
+                    outs = fn(fbuf, jnp.int32(handled), jnp.int32(nc),
+                              *pre_v)
                 dispatched += 1
-                overflow = bool(outs[13])  # forces the level program
+                # forces the level program (the ONE device sync/level)
+                overflow = bool(outs[i_ovf])
             except Exception as e:  # noqa: BLE001 — XLA compile/run
                 action = self.chunk_retry.handle(
                     e, escalated=True, depth=depth
@@ -1197,8 +1436,8 @@ class DevicePipeline:
                     f"{type(e).__name__}: {e}"[:200], depth
                 )
                 return None
-            agmax_np = np.asarray(outs[11], np.int64)
-            if overflow and int(outs[7]) == 0 and not exact:
+            agmax_np = np.asarray(outs[i_agmax], np.int64)
+            if overflow and int(outs[i_vkind]) == 0 and not exact:
                 # a segment (or the level-new set) overflowed: outputs
                 # are incomplete — discard and re-dispatch ONCE from the
                 # pre-level visited state at widths sized from the
@@ -1225,8 +1464,43 @@ class DevicePipeline:
             self.pool.hw, agmax_np.astype(np.float64) / max(B, 1),
             out=self.pool.hw,
         )
-        self._ln_hw = max(self._ln_hw, int(outs[3]))
         self.device_levels += 1
+        if self.host_mode:
+            # LN high water tracks the PRE-probe level-new count here
+            # (the level-new set is what it sizes, and that set holds
+            # the not-yet-probed candidates)
+            self._ln_hw = max(self._ln_hw, int(outs[5]))
+
+            def finalize(outs=outs, dispatched=dispatched):
+                on = int(outs[5])
+                vk = int(outs[6])
+                verdict = None
+                if vk:
+                    verdict = (
+                        "invariant" if vk == 1 else "deadlock",
+                        int(outs[8]),
+                        int(outs[7]),
+                    )
+                return dict(
+                    rows=np.asarray(outs[0][:on]),
+                    parent=np.asarray(outs[1][:on], np.int32),
+                    act=np.asarray(outs[2][:on], np.int32),
+                    hi=np.ascontiguousarray(
+                        np.asarray(outs[3][:on]), np.uint32
+                    ),
+                    lo=np.ascontiguousarray(
+                        np.asarray(outs[4][:on]), np.uint32
+                    ),
+                    new_n=on,
+                    verdict=verdict,
+                    act_en=np.asarray(outs[9], np.int64),
+                    digest=None,  # host folds the probe survivors
+                    launches=dispatched,
+                )
+
+            # visited refs unchanged: the host set is the visited state
+            return vhi, vlo, vn, vcap, finalize
+        self._ln_hw = max(self._ln_hw, int(outs[3]))
         new_vhi, new_vlo, new_vn = outs[4], outs[5], outs[6]
 
         def finalize(outs=outs, dispatched=dispatched):

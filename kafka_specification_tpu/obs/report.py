@@ -339,6 +339,7 @@ def report_data(run_dir: str, now: Optional[float] = None) -> dict:
         "integrity": _integrity(data),
         "overlap": _overlap(data),
         "launches": _launches(data),
+        "host_probe": _host_probe(data),
     }
 
 
@@ -393,6 +394,30 @@ def _launches(data: dict) -> dict:
         or bool(shard_series) or out["shard_last"] is not None
     )
     return out
+
+
+def _host_probe(data: dict) -> dict:
+    """Deferred batched host-probe beat: the `kspec_host_probe_ms`
+    gauge history (metrics snapshots).  Set only by the host-backend
+    device-resident pipelines — ONE batched FpSet / tiered-run probe
+    per level — so its presence is itself the proof the deferred path
+    engaged; the value is the per-level wall of that one call.  Reads
+    the gauge side channel only (the emitted stats stream stays
+    record-for-record historical, like the launch counters)."""
+    series = []
+    for snap in data.get("metrics_history") or ():
+        v = (snap.get("gauges") or {}).get("kspec_host_probe_ms")
+        if v is not None:
+            series.append(v)
+    last = ((data.get("metrics") or {}).get("gauges") or {}).get(
+        "kspec_host_probe_ms"
+    )
+    return {
+        "series": series,
+        "last": last,
+        "max": max(series) if series else None,
+        "present": bool(series) or last is not None,
+    }
 
 
 def _overlap(data: dict) -> dict:
@@ -739,6 +764,15 @@ def render_report(run_dir: str, now: Optional[float] = None,
                     f"max {ln['shard_max']} " + _spark(ln["shard_series"])
                 )
         out.append("  launches: " + "  ".join(bits))
+    hp = r.get("host_probe") or {}
+    if hp.get("present"):
+        # probe-ms/level beat, next to the launches sparkline: the
+        # deferred-probe device path's host-sync wall — ONE batched
+        # FpSet/tiered-run call per level on the host backend
+        bits = [f"host-probe ms/level last {hp.get('last')}"]
+        if hp.get("series"):
+            bits.append(f"max {hp['max']} " + _spark(hp["series"]))
+        out.append("  probe: " + "  ".join(bits))
     if r["open_level"] is not None and v["status"] in ("crashed", "stalled"):
         out.append(f"  died mid-level: level {r['open_level']} began but "
                    f"never completed")

@@ -24,6 +24,15 @@ that reformulation needs beyond what ops/dedup.py already provides:
   running output offset; rows past the live prefix are garbage the next
   chunk overwrites (and the final host slice clips).
 
+The HOST-backend (deferred-probe) level programs compose the same
+helpers with two deltas: ``append_vec`` additionally carries the
+emitted prefix's fingerprint lanes out (the once-per-level batched
+host probe consumes them instead of recomputing), and the digest
+helpers are NOT used — the chain's multiset is only known after the
+host probe, so the host folds the survivors.  ``level_new_capacity``
+sizes the level-new set identically in both modes (in host mode it
+bounds the PRE-probe candidate count, which is what that set holds).
+
 Everything here is shape-static and jit-pure; the purity lint
 (`cli analyze`) sweeps this module.
 """

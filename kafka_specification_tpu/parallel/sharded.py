@@ -916,25 +916,260 @@ def _make_sharded_level(
     return jax.jit(sharded)
 
 
+def _make_sharded_level_host(
+    model: Model,
+    mesh: Mesh,
+    expander: _Step,
+    B: int,
+    NCp: int,
+    widths: tuple,
+    LN: int,
+    exchange: str,
+    dest_w: int,
+    compress: bool,
+    check_deadlock: bool,
+):
+    """The sharded device-resident level program for the HOST (and
+    disk-tier) visited backends — :func:`_make_sharded_level`'s
+    deferred-probe twin.  Three deltas from the device-backend program:
+
+    - no visited shards ride the program at all: novelty inside the
+      level is decided against each shard's device-resident level-new
+      sorted set alone (the same stable-lexsort winners — and the same
+      SORTED emission order — as the per-chunk sharded host step), and
+      each owner shard's host FpSet probes the level's novel candidates
+      in ONE batched insert after the program completes
+      (check_sharded._run_device_level's host branch): O(1) host syncs
+      AND O(1) collective-bearing launches per shard per level;
+    - the emitted prefix carries its fingerprint lanes out (ohi/olo
+      accumulators) so the host probe never recomputes them;
+    - no in-jit digest folds — the chain's multiset is only known after
+      the probe, so the host folds the survivors exactly as the
+      per-chunk host commit does (fingerprint_rows over the kept rows).
+
+    The exchange (+ codec) still runs inside the loop, and the framing
+    digests still accumulate — fabric integrity is independent of where
+    the visited set lives.  Bit-identity with the per-chunk sharded
+    host path holds chunk for chunk: routing sends a fingerprint to the
+    same owner shard every time, so (level-new ∪ host set) partitions
+    novelty exactly as the per-chunk path's serial inserts do, with the
+    earlier chunk winning cross-chunk intra-level duplicates — the same
+    winner the serial per-chunk FpSet insert picks."""
+    spec = model.spec
+    K = spec.num_lanes
+    D = mesh.devices.size
+    expand = expander.make_expand(B, widths)
+    T = expander.expand_width(B, widths)
+    W = dest_w
+    R = D * W if exchange == "all_to_all" else D * T
+    OC = LN + R  # output buffer: one chunk of append headroom past LN
+    F = NCp * B  # per-shard frontier buffer rows
+    n_actions = len(model.actions)
+    route = _make_exchange(D, W, R, K, exchange, compress)
+    from ..engine.pipeline import sorted_dedup_stage
+
+    def level_body(fbuf, flen, ncs):  # kspec: traced
+        flen = flen[0]
+        ncs = ncs[0]
+        me = jax.lax.axis_index("d")
+        sent = jnp.uint32(dedup.SENT)
+
+        def body(carry):  # kspec: traced
+            (i, orows, opar, oact, ohi, olo, on, lhi, llo, ln,
+             vkind, vshard, vinv, vidx,
+             act_en, agmax, s_acc, r_acc, ovf, nclean) = carry
+            start = i * B
+            rows = jax.lax.dynamic_slice(fbuf, (start, 0), (B, K))
+            fvalid = (
+                start + jnp.arange(B, dtype=jnp.int32)
+            ) < flen
+            states = jax.vmap(spec.unpack)(rows)
+            (en_pre, cand, valid, parent, actid, a_en, a_guard,
+             exp_ovf) = expand(states, fvalid)
+            deadlocked = fvalid & ~jnp.any(en_pre, axis=1)
+            hi, lo = fingerprint_lanes(cand, spec.exact64)
+            hi = jnp.where(valid, hi, sent)
+            lo = jnp.where(valid, lo, sent)
+            parent_g = me.astype(jnp.int32) * F + (start + parent)
+            sent_dig = _fp_digest(hi, lo, valid)
+            (r_hi, r_lo, r_cand, r_parent, r_act, ovf_dest) = route(
+                hi, lo, cand, parent_g, actid, valid, me
+            )
+            recv_dig = _fp_digest(
+                r_hi, r_lo, ~((r_hi == sent) & (r_lo == sent))
+            )
+            # the SHARED winner-selection sequence, primary set = this
+            # shard's level-new sorted set, NO visited probe (that is
+            # the host's one batched call after the program)
+            (n_out, n_par, n_act, new_n, n_hi, n_lo, _l1, _l2, _l3,
+             n_rank) = sorted_dedup_stage(
+                r_cand, r_parent, r_act,
+                ~((r_hi == sent) & (r_lo == sent)),
+                r_hi, r_lo, lhi, llo, ln, LN, R, K, False,
+            )
+            # frontier verdicts, replicated election (identical to the
+            # device-backend program — verdicts derive from frontier
+            # states only, so the deferred probe cannot change them)
+            if model.invariants:
+                v_any, v_idx = [], []
+                for inv in model.invariants:
+                    ok = jax.vmap(inv.pred)(states)
+                    bad = fvalid & ~ok
+                    v_any.append(jnp.any(bad))
+                    v_idx.append(jnp.argmax(bad).astype(jnp.int32))
+                viol_any = jnp.stack(v_any)
+                viol_idx = jnp.stack(v_idx)
+            else:
+                viol_any = jnp.zeros((1,), bool)
+                viol_idx = jnp.zeros((1,), jnp.int32)
+            g_viol = jax.lax.all_gather(
+                viol_any[None], "d", tiled=True
+            )
+            g_vix = jax.lax.all_gather(viol_idx[None], "d", tiled=True)
+            dl_pair = jnp.stack([
+                jnp.any(deadlocked).astype(jnp.int32),
+                jnp.argmax(deadlocked).astype(jnp.int32),
+            ])
+            g_dl = jax.lax.all_gather(dl_pair[None], "d", tiled=True)
+            inv_any = jnp.any(g_viol)
+            inv_i = jnp.argmax(jnp.any(g_viol, axis=0)).astype(jnp.int32)
+            d_inv = jnp.argmax(g_viol[:, inv_i]).astype(jnp.int32)
+            dl_any = jnp.bool_(check_deadlock) & jnp.any(g_dl[:, 0] > 0)
+            d_dl = jnp.argmax(g_dl[:, 0]).astype(jnp.int32)
+            kind = jnp.where(
+                inv_any, jnp.int32(1),
+                jnp.where(dl_any, jnp.int32(2), jnp.int32(0)),
+            )
+            vd = jnp.where(inv_any, d_inv, d_dl)
+            vix_l = jnp.where(
+                inv_any, g_vix[d_inv, inv_i], g_dl[d_dl, 1]
+            ) + start
+            take = (vkind == 0) & (kind != 0)
+            commit = kind == 0  # a verdict chunk commits nothing
+            ln_ovf = jax.lax.pmax(
+                (commit & ((ln + new_n) > LN)).astype(jnp.int32), "d"
+            ) > 0
+            this_ovf = jax.lax.pmax(
+                (jnp.any(exp_ovf) | ovf_dest).astype(jnp.int32), "d"
+            ) > 0
+            commit_ok = commit & ~ovf & ~ln_ovf
+            clean = ~ovf & ~this_ovf & ~ln_ovf
+            app_n = jnp.where(commit_ok, new_n, 0)
+            orows = devlevel.append_rows(orows, n_out, on)
+            opar = devlevel.append_vec(opar, n_par, on)
+            oact = devlevel.append_vec(oact, n_act, on)
+            ohi = devlevel.append_vec(ohi, n_hi, on)
+            olo = devlevel.append_vec(olo, n_lo, on)
+            lhi, llo, ln = dedup.merge_ranked(
+                lhi, llo, ln, n_hi, n_lo, n_rank, app_n, LN
+            )
+            s_acc = _acc_digest(s_acc, sent_dig, clean)
+            r_acc = _acc_digest(r_acc, recv_dig, clean)
+            act_en = act_en + jnp.where(commit_ok, a_en, 0)
+            agmax = jnp.maximum(agmax, a_guard)
+            nclean = nclean + jnp.where(clean, 1, 0)
+            ovf = ovf | this_ovf | ln_ovf
+            return (i + 1, orows, opar, oact, ohi, olo, on + app_n,
+                    lhi, llo, ln,
+                    jnp.where(take, kind, vkind),
+                    jnp.where(take, vd, vshard),
+                    jnp.where(take, inv_i, vinv),
+                    jnp.where(take, vix_l, vidx),
+                    act_en, agmax, s_acc, r_acc, ovf, nclean)
+
+        def cond(carry):  # kspec: traced
+            return (carry[0] < ncs) & (carry[10] == 0)
+
+        init = (
+            jnp.int32(0),
+            jnp.zeros((OC, K), jnp.uint32),
+            jnp.full((OC,), -1, jnp.int32),
+            jnp.full((OC,), -1, jnp.int32),
+            jnp.full((OC,), sent),
+            jnp.full((OC,), sent),
+            jnp.int32(0),
+            jnp.full((LN,), sent),
+            jnp.full((LN,), sent),
+            jnp.int32(0),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            jnp.zeros((n_actions,), jnp.int32),
+            jnp.zeros((n_actions,), jnp.int32),
+            jnp.zeros((5,), jnp.uint32),
+            jnp.zeros((5,), jnp.uint32),
+            jnp.bool_(False),
+            jnp.int32(0),
+        )
+        (_i, orows, opar, oact, ohi, olo, on, _lh, _ll, _ln, vkind,
+         vshard, vinv, vidx, act_en, agmax, s_acc, r_acc, ovf,
+         nclean) = jax.lax.while_loop(cond, body, init)
+        return (
+            orows,  # [OC, K] -> [D*OC, K]
+            opar,
+            oact,
+            ohi,  # [OC] novel-candidate fingerprint lanes (host probe)
+            olo,
+            on[None],
+            vkind[None], vshard[None], vinv[None], vidx[None],
+            act_en[None],
+            agmax[None],
+            s_acc[None], r_acc[None],  # [1, 5] framing accumulators
+            ovf[None],
+            nclean[None],
+        )
+
+    sharded = _shard_map(
+        level_body,
+        mesh=mesh,
+        in_specs=(
+            P("d", None),  # frontier buffer rows [D*F, K]
+            P("d"),        # per-shard pending lengths
+            P("d"),        # per-shard (replicated-value) chunk counts
+        ),
+        out_specs=(
+            P("d", None),  # next-frontier candidate rows [D*OC, K]
+            P("d"),        # parents (mesh-global level row ids)
+            P("d"),        # action ids
+            P("d"),        # candidate fingerprint hi lanes
+            P("d"),        # candidate fingerprint lo lanes
+            P("d"),        # per-shard pre-probe candidate counts
+            P("d"), P("d"), P("d"), P("d"),  # verdict kind/shard/inv/idx
+            P("d", None),  # act_en [D, n_actions]
+            P("d", None),  # agmax [D, n_actions]
+            P("d", None),  # sent framing accumulator [D, 5]
+            P("d", None),  # recv framing accumulator [D, 5]
+            P("d"),        # replicated overflow flag
+            P("d"),        # clean (counted) chunks
+        ),
+        **_SHARD_MAP_KW,
+    )
+    return jax.jit(sharded)
+
+
 class ShardedDeviceLevel:
     """Policy/state holder for the sharded device-resident level path
-    (`--pipeline device` + visited_backend="device"): the preconditions,
-    the serial-chunking plan, and the width/level-new sizing ladders.
-    The dispatch/commit driver lives in check_sharded (it needs the
-    engine loop's locals); this object is what survives across levels.
+    (`--pipeline device`): the preconditions, the serial-chunking plan,
+    and the width/level-new sizing ladders.  The dispatch/commit driver
+    lives in check_sharded (it needs the engine loop's locals); this
+    object is what survives across levels.
 
-    Preconditions mirror the single-device DevicePipeline: the
-    sorted-set device visited backend AND analyzer-proven per-field
-    value hulls (engine.pipeline.device_hull_fallback — a HARD
-    precondition, the in-jit pack stage has no host visibility between
-    chunks).  Any unmet precondition or compile/dispatch failure sets
-    `fallback` (sticky) and the run degrades to the per-chunk sharded
-    ladder — results identical, launches O(chunks)."""
+    Preconditions mirror the single-device DevicePipeline: a sorted-
+    dedup visited backend — "device" (in-jit dual-probe + one merge per
+    shard per level) or "host"/disk tier (deferred-probe mode: ONE
+    batched per-shard host FpSet insert per level) — AND analyzer-
+    proven per-field value hulls (engine.pipeline.device_hull_fallback
+    — a HARD precondition, the in-jit pack stage has no host visibility
+    between chunks).  The registry's per-backend matrix
+    (pipeline_registry.backend_fallback_reason) is the one source of
+    which backends serve natively; any unmet precondition or
+    compile/dispatch failure sets `fallback` (sticky) and the run
+    degrades to the per-chunk sharded ladder — results identical,
+    launches O(chunks)."""
 
     def __init__(self, model: Model, mesh: Mesh, expander: _Step,
                  adapt: AdaptiveCompact, visited_backend: str,
                  check_deadlock: bool):
         from ..engine.pipeline import PooledWidths, device_hull_fallback
+        from ..pipeline_registry import backend_fallback_reason
 
         self.model = model
         self.mesh = mesh
@@ -945,13 +1180,13 @@ class ShardedDeviceLevel:
         self._ln_hw = 0  # per-level new-state high water (LN ladder)
         self.levels = 0  # levels actually run device-resident
         self.launches_last = 0
-        self.fallback: Optional[str] = None
-        if visited_backend != "device":
-            self.fallback = (
-                f"visited backend {visited_backend!r} is not the "
-                f"device-resident sorted set"
-            )
-        else:
+        #: deferred-probe mode: the per-shard level programs carry no
+        #: visited shards; the host probes each shard's level batch once
+        self.host_mode = visited_backend == "host"
+        self.fallback: Optional[str] = backend_fallback_reason(
+            "device", visited_backend
+        )
+        if self.fallback is None:
             self.fallback = device_hull_fallback(model)
 
     def _gated(self, B: int) -> bool:
@@ -2423,6 +2658,7 @@ def check_sharded(
             # launch PER SHARD each (the kspec_shard_launches_level
             # gauge and the device path's O(1)/level contract)
             lvl_dispatches = 0
+            lvl_probe_ms = 0.0  # deferred batched host-probe wall
             offs = [0] * D
             # base offset of each shard's rows in this level's shard-major order
             prev_base = np.concatenate([[0], np.cumsum([p.shape[0] for p in pending])])
@@ -2806,7 +3042,7 @@ def check_sharded(
                 nonlocal lvl_act_en, lvl_new_per_shard, lvl_en_per_shard
                 nonlocal lvl_recv_per_shard, shard_visited
                 nonlocal lvl_exch_bytes, lvl_exch_raw_bytes
-                nonlocal lvl_dispatches
+                nonlocal lvl_dispatches, lvl_probe_ms
                 lens = [p.shape[0] for p in pending]
                 plan = sdev.plan_level(lens, chunk, min_bucket)
                 if plan is None:
@@ -2825,6 +3061,16 @@ def check_sharded(
                 compress = compress_on
                 exact = False
                 dispatched = 0
+                host_mode = sdev.host_mode
+                # output-tuple indices differ between the two program
+                # variants (the host program carries no visited shards
+                # or digest folds, but adds the ohi/olo accumulators)
+                (i_cnt, i_vk, i_vd, i_vinv, i_vix, i_aen, i_agm,
+                 i_sd, i_rd, i_ovf, i_ncl) = (
+                    (5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15)
+                    if host_mode
+                    else (3, 7, 8, 9, 10, 11, 12, 17, 18, 19, 20)
+                )
                 t0l = time.perf_counter()
                 # only the handled prefix rides the device buffer; a
                 # smaller-bucket serial tail runs per-chunk afterwards
@@ -2840,40 +3086,61 @@ def check_sharded(
                         injected = fault.chunk_error(escalated=True)
                         if injected is not None:
                             raise injected
-                        need = int(fetch_global(pre_v[2]).max()) + min(
-                            nc * R, LN + R
-                        )
-                        if need > vcap:
-                            g_hi, g_lo, vcap = _grow_sorted_shards(
-                                pre_v[0], pre_v[1], vcap,
-                                _next_pow2(need), layouts["fpset"],
+                        if host_mode:
+                            key = ("lvlh", B, NCp, widths, LN, W,
+                                   exchange, compress)
+                            if key not in steps:
+                                steps[key] = _make_sharded_level_host(
+                                    model, mesh, expander, B, NCp,
+                                    widths, LN, exchange, W, compress,
+                                    check_deadlock,
+                                )
+                            outs = steps[key](
+                                put_global(
+                                    fbuf.reshape(D * F, K),
+                                    layouts["frontier"],
+                                ),
+                                put_global(flen, layouts["pershard"]),
+                                put_global(
+                                    np.full(D, nc, np.int32),
+                                    layouts["pershard"],
+                                ),
                             )
-                            pre_v = (g_hi, g_lo, pre_v[2])
-                        key = ("lvl", B, NCp, vcap, widths, LN, W,
-                               exchange, compress)
-                        if key not in steps:
-                            steps[key] = _make_sharded_level(
-                                model, mesh, expander, B, NCp, vcap,
-                                widths, LN, exchange, W, compress,
-                                check_deadlock,
+                        else:
+                            need = int(
+                                fetch_global(pre_v[2]).max()
+                            ) + min(nc * R, LN + R)
+                            if need > vcap:
+                                g_hi, g_lo, vcap = _grow_sorted_shards(
+                                    pre_v[0], pre_v[1], vcap,
+                                    _next_pow2(need), layouts["fpset"],
+                                )
+                                pre_v = (g_hi, g_lo, pre_v[2])
+                            key = ("lvl", B, NCp, vcap, widths, LN, W,
+                                   exchange, compress)
+                            if key not in steps:
+                                steps[key] = _make_sharded_level(
+                                    model, mesh, expander, B, NCp,
+                                    vcap, widths, LN, exchange, W,
+                                    compress, check_deadlock,
+                                )
+                            outs = steps[key](
+                                put_global(
+                                    fbuf.reshape(D * F, K),
+                                    layouts["frontier"],
+                                ),
+                                put_global(flen, layouts["pershard"]),
+                                put_global(
+                                    np.full(D, nc, np.int32),
+                                    layouts["pershard"],
+                                ),
+                                pre_v[0], pre_v[1], pre_v[2],
                             )
-                        outs = steps[key](
-                            put_global(
-                                fbuf.reshape(D * F, K),
-                                layouts["frontier"],
-                            ),
-                            put_global(flen, layouts["pershard"]),
-                            put_global(
-                                np.full(D, nc, np.int32),
-                                layouts["pershard"],
-                            ),
-                            pre_v[0], pre_v[1], pre_v[2],
-                        )
                         dispatched += 1
                         lvl_dispatches += 1
                         # the one device sync per level: the overflow-
                         # flag read forces the whole level program
-                        overflow = bool(fetch_global(outs[19]).any())
+                        overflow = bool(fetch_global(outs[i_ovf]).any())
                     except Exception as e:  # noqa: BLE001 — XLA
                         action = chunk_retry.handle(
                             e, escalated=True, depth=depth,
@@ -2885,10 +3152,10 @@ def check_sharded(
                             f"{type(e).__name__}: {e}"[:200], depth
                         )
                         return
-                    agmax_np = fetch_global(outs[12]).max(axis=0).astype(
-                        np.int64
-                    )
-                    vk = int(fetch_global(outs[7])[0])
+                    agmax_np = fetch_global(outs[i_agm]).max(
+                        axis=0
+                    ).astype(np.int64)
+                    vk = int(fetch_global(outs[i_vk])[0])
                     if overflow and vk == 0 and not exact:
                         # a segment / destination bucket / codec budget
                         # / the level-new set overflowed: outputs are
@@ -2909,9 +3176,12 @@ def check_sharded(
                         exact = True
                         continue
                     break
-                # committed: install the merged visited arrays
-                dev_vhi, dev_vlo, dev_vn = outs[4], outs[5], outs[6]
-                counts = fetch_global(outs[3]).astype(np.int64)  # [D]
+                # committed: install the merged visited arrays (the
+                # host-mode program carries no visited shards — the
+                # host sets below ARE the visited state)
+                if not host_mode:
+                    dev_vhi, dev_vlo, dev_vn = outs[4], outs[5], outs[6]
+                counts = fetch_global(outs[i_cnt]).astype(np.int64)  # [D]
                 sdev.observe(agmax_np, B, int(counts.max()))
                 sdev.launches_last = dispatched
                 adapt.observe(agmax_np.astype(np.float64) / max(B, 1))
@@ -2926,8 +3196,8 @@ def check_sharded(
                 # a corruption in those chunks must still alarm, it
                 # must never be laundered by a later verdict
                 if chain is not None:
-                    sd = np.asarray(fetch_global(outs[17]), np.uint32)
-                    rd = np.array(fetch_global(outs[18]), np.uint32)
+                    sd = np.asarray(fetch_global(outs[i_sd]), np.uint32)
+                    rd = np.array(fetch_global(outs[i_rd]), np.uint32)
                     sp = fault.flip(
                         "exchange", depth + 1,
                         ckpt_depth=ckpt_durable_depth,
@@ -2961,7 +3231,7 @@ def check_sharded(
                 # committed dispatch's widths (same per-chunk formulas
                 # as the per-chunk path)
                 if exchange == "all_to_all":
-                    ncl = int(fetch_global(outs[20])[0])
+                    ncl = int(fetch_global(outs[i_ncl])[0])
                     raw_b = D * D * W * (8 + 4 * K + 4 + 4)
                     if compress:
                         from ..ops import fpcompress as _fpc
@@ -2977,9 +3247,9 @@ def check_sharded(
                     lvl_exch_bytes += ncl * sent_b
                     lvl_exch_raw_bytes += ncl * raw_b
                 if vk:
-                    d = int(fetch_global(outs[8])[0])
-                    inv_i = int(fetch_global(outs[9])[0])
-                    lidx = int(fetch_global(outs[10])[0])
+                    d = int(fetch_global(outs[i_vd])[0])
+                    inv_i = int(fetch_global(outs[i_vinv])[0])
+                    lidx = int(fetch_global(outs[i_vix])[0])
                     gidx = int(prev_base[d] + lidx)
                     name = (
                         model.invariants[inv_i].name
@@ -3004,36 +3274,117 @@ def check_sharded(
                         act3 = fetch_global(
                             outs[2].reshape(D, OC)[:, :cmax]
                         )
-                for d in range(D):
-                    c = int(counts[d])
-                    if not c:
-                        continue
-                    next_pending[d].append(out3[d, :c])
-                    if collect_trace:
-                        pg = par3[d, :c].astype(np.int64)
-                        # mesh-global level row ids -> level-global
-                        # indices in shard-major order (the plan's
-                        # chunk offsets are i*B, already inside pg)
-                        next_parent[d].append(
-                            prev_base[pg // F] + (pg % F)
+                if host_mode:
+                    # Deferred once-per-level batched host probe: each
+                    # owner shard's FpSet / disk tier takes the level's
+                    # novel candidates (unique within the level, the
+                    # per-chunk sorted emission order the serial host
+                    # commits replay) in ONE insert; masks are OR-merged
+                    # across processes so every process sees the same
+                    # novelty decision — host syncs O(1) per shard per
+                    # level instead of O(chunks)
+                    t_probe = time.perf_counter()
+                    masks = np.zeros((D, max(cmax, 1)), bool)
+                    if cmax:
+                        hi3 = fetch_global(
+                            outs[3].reshape(D, OC)[:, :cmax]
                         )
-                        next_act[d].append(act3[d, :c].astype(np.int64))
-                if chain is not None:
-                    # per-shard in-jit chain folds: the device-computed
-                    # (count, xor, sum) accumulators fold bit-exactly
-                    # like the per-chunk host folds over the same rows
-                    _integ.fold_shard_device_digests(
-                        chain,
-                        fetch_global(outs[13]),
-                        fetch_global(outs[14]),
-                        fetch_global(outs[15]),
-                        fetch_global(outs[16]),
+                        lo3 = fetch_global(
+                            outs[4].reshape(D, OC)[:, :cmax]
+                        )
+                        for d in range(D):
+                            c = int(counts[d])
+                            if c and host_sets[d] is not None:
+                                s = host_sets[d]
+                                fps = _u64(hi3[d, :c], lo3[d, :c])
+                                masks[d, :c] = (
+                                    s.insert_level(fps)
+                                    if hasattr(s, "insert_level")
+                                    else s.insert(fps)
+                                ).astype(bool)
+                        masks = or_across_processes(masks)
+                    newc = np.zeros(D, np.int64)
+                    for d in range(D):
+                        c = int(counts[d])
+                        if not c:
+                            continue
+                        mask = masks[d, :c]
+                        rows = out3[d, :c][mask]
+                        c2 = rows.shape[0]
+                        if not c2:
+                            continue
+                        next_pending[d].append(rows)
+                        if chain is not None:
+                            # fold the probe SURVIVORS via the numpy
+                            # fingerprint twin, deliberately NOT the
+                            # device lanes in hi3/lo3: digesting the
+                            # rows the host actually keeps, then
+                            # checking the chain against the device
+                            # fingerprints at save time, cross-checks
+                            # the two representations for free (the
+                            # per-chunk host commit's exact rationale)
+                            chain.fold(
+                                _integ.fingerprint_rows(
+                                    rows, spec.exact64
+                                )
+                            )
+                        if collect_trace:
+                            pg = par3[d, :c][mask].astype(np.int64)
+                            next_parent[d].append(
+                                prev_base[pg // F] + (pg % F)
+                            )
+                            next_act[d].append(
+                                act3[d, :c][mask].astype(np.int64)
+                            )
+                        newc[d] = c2
+                    lvl_probe_ms += (
+                        time.perf_counter() - t_probe
+                    ) * 1e3
+                    obs_.chunk_span(
+                        "host-probe",
+                        time.perf_counter() - t_probe,
+                        depth=depth, rows=int(counts.sum()),
+                        new=int(newc.sum()), batched="level",
                     )
-                lvl_new_per_shard += counts
-                lvl_recv_per_shard += counts
-                shard_visited += counts
+                    lvl_new_per_shard += newc
+                    lvl_recv_per_shard += counts
+                    shard_visited += newc
+                else:
+                    for d in range(D):
+                        c = int(counts[d])
+                        if not c:
+                            continue
+                        next_pending[d].append(out3[d, :c])
+                        if collect_trace:
+                            pg = par3[d, :c].astype(np.int64)
+                            # mesh-global level row ids -> level-global
+                            # indices in shard-major order (the plan's
+                            # chunk offsets are i*B, already inside pg)
+                            next_parent[d].append(
+                                prev_base[pg // F] + (pg % F)
+                            )
+                            next_act[d].append(
+                                act3[d, :c].astype(np.int64)
+                            )
+                    if chain is not None:
+                        # per-shard in-jit chain folds: the device-
+                        # computed (count, xor, sum) accumulators fold
+                        # bit-exactly like the per-chunk host folds
+                        # over the same rows
+                        _integ.fold_shard_device_digests(
+                            chain,
+                            fetch_global(outs[13]),
+                            fetch_global(outs[14]),
+                            fetch_global(outs[15]),
+                            fetch_global(outs[16]),
+                        )
+                    lvl_new_per_shard += counts
+                    lvl_recv_per_shard += counts
+                    shard_visited += counts
                 if obs_.collect:
-                    act_en_np = fetch_global(outs[11]).astype(np.int64)
+                    act_en_np = fetch_global(outs[i_aen]).astype(
+                        np.int64
+                    )
                     lvl_act_en += act_en_np.sum(axis=0)
                     lvl_en_per_shard += act_en_np.sum(axis=1)
                 for d in range(D):
@@ -3156,6 +3507,14 @@ def check_sharded(
                     # (= launches PER SHARD; in-memory only, like the
                     # launch counters of the single-device engine)
                     "shard_launches": int(lvl_dispatches),
+                    # deferred batched host-probe attribution (host-
+                    # backend device path; in-memory records + gauge/
+                    # span side channels only)
+                    **(
+                        {"host_probe_ms": round(lvl_probe_ms, 2)}
+                        if lvl_probe_ms
+                        else {}
+                    ),
                     "io_hidden_ms": round(
                         max(0.0, (busy1 - lvl_io0[0])
                             - (blk1 - lvl_io0[1])) * 1e3, 2),
@@ -3164,6 +3523,10 @@ def check_sharded(
                 _met.set_gauge(
                     "kspec_shard_launches_level", int(lvl_dispatches)
                 )
+                if lvl_probe_ms:
+                    _met.set_gauge(
+                        "kspec_host_probe_ms", round(lvl_probe_ms, 2)
+                    )
                 if lvl_exch_raw_bytes:
                     _met.set_gauge(
                         "kspec_exchange_bytes_level", int(lvl_exch_bytes)

@@ -19,6 +19,14 @@ PIPELINE_ENV = "KSPEC_PIPELINE"
 #: the second) — keys of every registry entry's per-engine support matrix
 ENGINES = ("single-device", "sharded")
 
+#: the visited backends a pipeline can be asked to serve — keys of every
+#: registry entry's per-BACKEND support matrix ("backends").  Each cell
+#: states whether the pipeline serves that backend natively or degrades
+#: (and to what), so `stats['device']['fallback']` reasons and the
+#: `cli pipelines` dump both read from ONE jax-free source instead of
+#: strings scattered across the engines.
+BACKENDS = ("device", "device-hash", "host")
+
 #: name -> registry entry; insertion order is the display order and the
 #: degradation ladder reads right-to-left (device -> fused -> legacy).
 #: Each entry's "engines" matrix states, PER ENGINE, whether the name
@@ -33,23 +41,62 @@ PIPELINE_REGISTRY = {
             "device-resident level pipeline: a bounded lax.while_loop "
             "processes every gated chunk of a BFS level in ONE dispatched "
             "program — guard-matrix expansion, in-jit segmented "
-            "compaction, fingerprints, dedup against the device-resident "
-            "visited set, invariant/deadlock verdicts and the per-level "
-            "digest folds all fused on-device; the visited merge runs "
-            "once per level instead of once per chunk.  Requires the "
-            "sorted-set device visited backend and analyzer-proven "
-            "per-field value hulls; anything else degrades to 'fused'"
+            "compaction, fingerprints, intra-level dedup against a "
+            "device-resident level-new sorted set, invariant/deadlock "
+            "verdicts and (device backend) the per-level digest folds "
+            "all fused on-device.  Sorted-set backend: the O(capacity) "
+            "visited merge runs once per level instead of once per "
+            "chunk.  Host/disk-tier backends: the visited probe is "
+            "DEFERRED to one batched host call per level (host syncs "
+            "O(1)/level instead of O(chunks)).  Requires analyzer-"
+            "proven per-field value hulls; anything else degrades to "
+            "'fused'"
         ),
         "fallback": "fused",
+        "backends": {
+            "device": {
+                "supported": True,
+                "detail": (
+                    "in-jit dual-probe dedup (read-only visited "
+                    "shard + level-new set), ONE O(capacity) rank-"
+                    "scatter merge per level, in-jit digest folds"
+                ),
+            },
+            "host": {
+                "supported": True,
+                "detail": (
+                    "deferred once-per-level batched host dedup "
+                    "— intra-level novelty on the device level-new set, "
+                    "the level's novel candidates probed/inserted "
+                    "against the C-arena FpSet (or the disk tier's "
+                    "bloom/interval-gated sorted runs) in ONE chunk-"
+                    "major batch per level; serial winner rule "
+                    "preserved, so results stay bit-identical to "
+                    "'legacy'"
+                ),
+            },
+            "device-hash": {
+                "supported": False,
+                "detail": (
+                    "the open-addressing HBM table mutates in place per "
+                    "probe (no read-only in-loop form), so a whole-"
+                    "level program has no exact replay on overflow — "
+                    "runs the fused per-chunk ladder instead (identical "
+                    "results)"
+                ),
+            },
+        },
         "engines": {
             "single-device": {
                 "supported": True,
                 "detail": (
                     "one lax.while_loop program per level, <=2 successor "
-                    "launches/level; degrades to 'fused' per-chunk on "
-                    "host/device-hash visited backends, disk tier, "
-                    "sub-gate chunks, shadow re-execution, unproven "
-                    "field hulls, or compile failure"
+                    "launches/level, on the device AND host/disk-tier "
+                    "visited backends (host: deferred once-per-level "
+                    "batched dedup); degrades to 'fused' per-chunk on "
+                    "the device-hash backend, sub-gate chunks, shadow "
+                    "re-execution, unproven field hulls, or compile "
+                    "failure"
                 ),
             },
             "sharded": {
@@ -63,9 +110,11 @@ PIPELINE_REGISTRY = {
                     "set, in-jit digest folds — inside ONE dispatched "
                     "program: O(1) collective-bearing launches per "
                     "level per shard, the O(capacity) visited merge "
-                    "once per level per shard.  Requires "
-                    "visited_backend=device + proven field hulls; "
-                    "degrades to the per-chunk sharded step otherwise "
+                    "(device backend) or ONE batched per-shard host "
+                    "FpSet probe (host/disk-tier backends) once per "
+                    "level per shard.  Requires proven field hulls and "
+                    "a sorted-dedup backend; device-hash degrades to "
+                    "the per-chunk sharded step "
                     "(sharded-device -> per-chunk -> legacy ladder)"
                 ),
             },
@@ -81,6 +130,28 @@ PIPELINE_REGISTRY = {
             "failure degrades the run to 'legacy'"
         ),
         "fallback": "legacy",
+        "backends": {
+            "device": {
+                "supported": True,
+                "detail": "in-jit sort/probe/rank-merge per chunk",
+            },
+            "host": {
+                "supported": True,
+                "detail": (
+                    "per-chunk squeeze+fingerprint on device, "
+                    "all dedup on the host FpSet / disk tier (one host "
+                    "probe per chunk — the O(chunks)-sync shape the "
+                    "'device' pipeline's deferred probe collapses)"
+                ),
+            },
+            "device-hash": {
+                "supported": True,
+                "detail": (
+                    "per-chunk insert-or-find on the HBM "
+                    "open-addressing table"
+                ),
+            },
+        },
         "engines": {
             "single-device": {
                 "supported": True,
@@ -107,6 +178,23 @@ PIPELINE_REGISTRY = {
             "pipeline is pinned against"
         ),
         "fallback": None,
+        "backends": {
+            "device": {
+                "supported": True,
+                "detail": "the historical in-step sorted dedup",
+            },
+            "host": {
+                "supported": True,
+                "detail": (
+                    "per-chunk host FpSet insert (the oracle "
+                    "path for the deferred-probe bit-identity pins)"
+                ),
+            },
+            "device-hash": {
+                "supported": True,
+                "detail": "per-chunk HBM hash-table insert",
+            },
+        },
         "engines": {
             "single-device": {
                 "supported": True,
@@ -126,6 +214,35 @@ PIPELINE_REGISTRY = {
 }
 
 DEFAULT_PIPELINE = "fused"
+
+
+def backend_support(name: str, backend: str) -> dict:
+    """The (pipeline, backend) support cell: {"supported": bool,
+    "detail": str}.  `backend` must be one of :data:`BACKENDS`.  The
+    detail string of an unsupported cell is the ONE fallback-reason
+    text the engines stamp into ``stats['device']['fallback']`` and the
+    ``pipeline-fallback`` event — so the reason an operator sees names
+    the backend and is identical to what ``cli pipelines`` documents."""
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"unknown visited backend {backend!r} (expected one of "
+            f"{BACKENDS})"
+        )
+    if name not in PIPELINE_REGISTRY:
+        raise ValueError(
+            f"unknown pipeline {name!r} (expected one of "
+            f"{pipeline_names()})"
+        )
+    return PIPELINE_REGISTRY[name]["backends"][backend]
+
+
+def backend_fallback_reason(name: str, backend: str):
+    """None when `name` natively serves `backend`, else the human-
+    readable (backend-naming) degradation reason."""
+    cell = backend_support(name, backend)
+    if cell["supported"]:
+        return None
+    return f"visited backend {backend!r}: {cell['detail']}"
 
 
 def engine_support(name: str, engine: str) -> dict:

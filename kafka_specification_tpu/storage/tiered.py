@@ -289,6 +289,59 @@ class TieredFpSet:
             self._maybe_spill()
         return novel
 
+    def insert_level(self, fps: np.ndarray,
+                     slice_rows: int = 1 << 18) -> np.ndarray:
+        """Once-per-level batched insert (the deferred-probe device
+        pipeline's host call): same novelty mask as :meth:`insert`, but
+        shaped for ONE call per BFS level instead of one per chunk.
+
+        Two things make the batched form cheaper than a chunk loop of
+        :meth:`insert` calls, without changing a single novelty answer:
+
+        - the disk probe runs over the SORTED query batch, once per run
+          per LEVEL: each run pays one interval gate, one bloom pass and
+          one searchsorted sweep for the whole level (sorted queries
+          walk the run's mmap monotonically, so the binary searches
+          touch each page once) — the per-chunk loop pays all three per
+          run per CHUNK;
+        - the hot-tier insert still runs in budget-bounded slices with
+          the spill check between them, so residency stays bounded at
+          ``mem_budget + slice_rows*16`` bytes exactly like the serial
+          path's per-chunk bound — a whole level can be much larger
+          than the budget.
+
+        The caller's batch is duplicate-free within the level (the
+        device level-new set guarantees it), so slice order cannot
+        change any first-occurrence decision; runs stay pairwise
+        disjoint because the disk probe still precedes every hot
+        insert.  Bit-identity with the per-chunk insert sequence
+        follows (tests/test_storage.py pins it)."""
+        if self._merge_job is not None:
+            self.poll_merge()
+        fps = np.ascontiguousarray(fps, np.uint64)
+        novel = np.zeros(fps.shape[0], bool)
+        if not fps.shape[0]:
+            return novel
+        order = np.argsort(fps, kind="stable")
+        fresh_sorted = ~self._disk_contains(fps[order])
+        fresh = np.zeros_like(fresh_sorted)
+        fresh[order] = fresh_sorted
+        idx = np.nonzero(fresh)[0]
+        # hot membership must be resolved BEFORE the sliced inserts: a
+        # mid-call spill moves the pre-call hot set to disk, so a later
+        # slice's hot.insert would wrongly re-admit a fingerprint the
+        # level started with in the hot tier (a double insert breaks
+        # the pairwise-disjoint-runs invariant; caught by the twin-set
+        # test before it ever shipped)
+        if idx.shape[0]:
+            idx = idx[~self.hot.contains(fps[idx])]
+        novel[idx] = True
+        for at in range(0, idx.shape[0], slice_rows):
+            sl = idx[at: at + slice_rows]
+            self.hot.insert(fps[sl])
+            self._maybe_spill()
+        return novel
+
     def contains(self, fps: np.ndarray) -> np.ndarray:
         fps = np.ascontiguousarray(fps, np.uint64)
         out = self.hot.contains(fps)

@@ -820,6 +820,14 @@ def main(argv=None):
             for eng, cell in e.get("engines", {}).items():
                 mark = "supported" if cell["supported"] else "degrades"
                 print(f"      [{eng}] {mark}: {cell['detail']}")
+            # per-BACKEND support matrix: which visited backends the
+            # pipeline serves natively vs degrades from — the detail of
+            # an unsupported cell is the exact fallback reason
+            # stats['device']['fallback'] records (one source,
+            # pipeline_registry.backend_fallback_reason)
+            for be, cell in e.get("backends", {}).items():
+                mark = "native" if cell["supported"] else "degrades"
+                print(f"      [backend {be}] {mark}: {cell['detail']}")
         return 0
 
     if args.cmd == "analyze":

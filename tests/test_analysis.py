@@ -187,12 +187,17 @@ def test_purity_lint_covers_sharded_level_body():
     assert rel in an.PURITY_MODULES
     path = os.path.join(an.repo_root(), rel)
     src = open(path).read()
-    # the level body and its cond are traced-marked
+    # BOTH level bodies (device backend + the host deferred-probe twin)
+    # and their conds are traced-marked
     assert src.count("def level_body(fbuf, flen, ncs, vhi, vlo, vn):  "
                      "# kspec: traced") == 1
-    # seeded mutant: a .item() materialization inside the while-loop body
+    assert src.count("def level_body(fbuf, flen, ncs):  "
+                     "# kspec: traced") == 1
+    # seeded mutant: a .item() materialization inside the while-loop
+    # body — the needle now occurs in both level programs' loop bodies,
+    # so the mutant seeds into both (the lint must flag either)
     needle = "            ovf = ovf | this_ovf | ln_ovf\n"
-    assert src.count(needle) == 1
+    assert src.count(needle) == 2
     mutated = src.replace(
         needle, needle + "            _bad = int(ovf.item())\n"
     )

@@ -558,6 +558,10 @@ def test_chain_identical_across_pipelines_engines_and_layouts(tmp_path):
         # path to actually engage at this model's tiny buckets)
         ("device", dict(pipeline="device", compact_gate=32)),
         ("host", dict(visited_backend="host")),
+        # deferred-probe device path: the chain folds the batched
+        # probe's SURVIVORS — must land identical to every other fold
+        ("device-host", dict(pipeline="device", visited_backend="host",
+                             compact_gate=32)),
     ):
         ck = str(tmp_path / tag)
         check(frl.make_model(2, 2, 2), checkpoint_dir=ck, **model_kw, **kw)

@@ -202,7 +202,7 @@ def test_stats_shim_record_for_record_identical(tmp_path):
         {k: v for k, v in r.items()
          if k not in ("successor_launches", "launches_per_chunk_max",
                       "io_hidden_ms", "io_exposed_ms",
-                      "overlap_efficiency")}
+                      "overlap_efficiency", "host_probe_ms")}
         for r in r1.stats["levels"]
     ] == recs_bare
 
@@ -253,7 +253,8 @@ def test_sharded_per_shard_breakdowns_and_imbalance(tmp_path):
     assert [
         {k: v for k, v in r.items()
          if k not in ("exch_bytes", "exch_raw_bytes", "io_hidden_ms",
-                      "io_exposed_ms", "shard_launches")}
+                      "io_exposed_ms", "shard_launches",
+                      "host_probe_ms")}
         for r in res.stats["levels"]
     ] == recs
     prom = open(run.metrics_prom).read()

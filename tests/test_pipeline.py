@@ -75,10 +75,12 @@ def _assert_parity(module, pipeline="fused", **extra_kw):
     r_fus = check(m, pipeline=pipeline, **kw)
     assert r_fus.stats["pipeline"] == pipeline
     assert r_fus.stats["pipeline_fallback"] is False
-    if pipeline == "device" and kw.get("visited_backend", "device") == \
-            "device":
+    if pipeline == "device" and kw.get("visited_backend", "device") in \
+            ("device", "host"):
         # the device path must actually ENGAGE (a silent fused
-        # delegation would vacuously pass every parity assertion)
+        # delegation would vacuously pass every parity assertion) —
+        # on BOTH native backends: the sorted device set and the
+        # deferred-probe host FpSet
         assert r_fus.stats["device"]["levels"] > 0, r_fus.stats["device"]
     assert r_leg.levels == r_fus.levels
     assert r_leg.total == r_fus.total
@@ -159,19 +161,236 @@ def test_device_pipeline_ungated_tail_chunk():
     assert t_leg == t_dev
 
 
-@pytest.mark.parametrize("backend", ["host", "device-hash"])
-def test_device_pipeline_non_device_backend_falls_back(backend):
-    """The degradation ladder's first rung: on a visited backend the
-    whole-level program cannot serve, --pipeline device runs the fused
-    per-chunk path — same results, zero device levels, and the reason
-    recorded (stats['device']['fallback'])."""
+def test_device_pipeline_hash_backend_falls_back():
+    """The degradation ladder's first rung: the device-hash backend has
+    no whole-level program (the table mutates in place per probe), so
+    --pipeline device runs the fused per-chunk path — same results,
+    zero device levels, and the reason recorded NAMING the backend
+    (stats['device']['fallback'], from the registry's per-backend
+    matrix)."""
     m = _model("Kip101")
-    r_dev = check(m, pipeline="device", visited_backend=backend, **KW)
+    r_dev = check(m, pipeline="device", visited_backend="device-hash",
+                  **KW)
     assert r_dev.stats["device"]["levels"] == 0
     assert r_dev.stats["device"]["fallback"] is not None
-    r_ref = check(m, pipeline="fused", visited_backend=backend, **KW)
+    assert "device-hash" in r_dev.stats["device"]["fallback"]
+    r_ref = check(m, pipeline="fused", visited_backend="device-hash",
+                  **KW)
     assert r_dev.levels == r_ref.levels
     assert r_dev.total == r_ref.total
+
+
+@pytest.mark.device_host
+def test_device_host_backend_bit_identity_violating_model():
+    """Tier-1 anchor for the DEFERRED-PROBE host backend (the tentpole
+    of the host-backend device path): the violating TruncateToHW case
+    run as whole-level device programs with intra-level dedup on device
+    and ONE batched C-arena FpSet probe per level is bit-identical to
+    the legacy per-chunk oracle — counts, duplicate accounting,
+    enablement histograms, the first-violation verdict and the trace
+    VALUES, with the device path proven engaged."""
+    r_leg, r_dev = _assert_parity(
+        "KafkaTruncateToHighWatermark", pipeline="device",
+        visited_backend="host",
+    )
+    assert r_leg.violation is not None
+    # the probe attribution rides the in-memory level records
+    assert any(
+        lvl.get("host_probe_ms") is not None
+        for lvl in r_dev.stats["levels"]
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.device_host
+@pytest.mark.parametrize("module", ["Kip101", "Kip320", "AsyncIsr"])
+def test_device_host_backend_bit_identity_matrix(module):
+    """Deferred-probe parity over the rest of the model matrix (passing
+    runs, constraint pruning on AsyncIsr)."""
+    _assert_parity(module, pipeline="device", visited_backend="host")
+
+
+@pytest.mark.device_host
+def test_device_host_backend_ungated_tail_chunk():
+    """Host-backend twin of the ungated-tail case: a sub-gate trailing
+    partial chunk stays on the fused per-chunk ladder (its host FpSet
+    insert runs per chunk, AFTER the level's batched probe committed)
+    while the gated prefix runs device-resident — the split must be
+    bit-identical, which pins the probe/tail commit ordering."""
+    kw = {**KW, "min_bucket": 16, "chunk_size": 32,
+          "visited_backend": "host"}
+    m = _model("KafkaTruncateToHighWatermark")
+    r_leg = check(m, pipeline="legacy", **kw)
+    r_dev = check(m, pipeline="device", **kw)
+    assert r_dev.stats["device"]["levels"] > 0
+    assert r_dev.stats["device"]["fallback"] is None
+    assert r_leg.levels == r_dev.levels
+    assert r_leg.total == r_dev.total
+    for a, b in zip(r_leg.stats["levels"], r_dev.stats["levels"]):
+        assert a["duplicates"] == b["duplicates"]
+        assert a["action_enablement"] == b["action_enablement"]
+    t_leg = [(a, repr(s)) for a, s in r_leg.violation.trace]
+    t_dev = [(a, repr(s)) for a, s in r_dev.violation.trace]
+    assert t_leg == t_dev
+
+
+@pytest.mark.device_host
+def test_device_host_backend_disk_tier_bit_identity(tmp_path):
+    """Disk tier (forced tiny budget, real spills + batched sorted run
+    probes) under the device pipeline: bit-identical to legacy on the
+    same store — the deferred probe makes the disk tier FASTER, never
+    excluded (one sorted batch probe per run per level)."""
+    kw = {**KW, "store_trace": False, "store": "disk",
+          "mem_budget": 4096}
+    m = _model("KafkaTruncateToHighWatermark")
+    r_leg = check(m, pipeline="legacy",
+                  spill_dir=str(tmp_path / "leg"), **kw)
+    r_dev = check(m, pipeline="device",
+                  spill_dir=str(tmp_path / "dev"), **kw)
+    assert r_dev.stats["device"]["levels"] > 0
+    assert r_dev.stats["device"]["fallback"] is None
+    assert r_dev.stats["spill"]["spills"] > 0  # the tier really spilled
+    assert r_leg.levels == r_dev.levels
+    assert r_leg.total == r_dev.total
+    assert (r_leg.violation is None) == (r_dev.violation is None)
+    assert r_dev.violation.depth == r_leg.violation.depth
+    # traces reconstruct from the on-disk parent log under BOTH
+    t_leg = [(a, repr(s)) for a, s in r_leg.violation.trace]
+    t_dev = [(a, repr(s)) for a, s in r_dev.violation.trace]
+    assert t_leg == t_dev
+    # ... and with SUB-GATE TAIL chunks on the spilled frontier (the
+    # tail runs per-chunk AFTER the device span — from the already-
+    # materialized rows, at the serial offsets, without re-reading the
+    # handled prefix from disk)
+    kw.update(min_bucket=16, chunk_size=32)
+    r_leg2 = check(m, pipeline="legacy",
+                   spill_dir=str(tmp_path / "leg2"), **kw)
+    r_dev2 = check(m, pipeline="device",
+                   spill_dir=str(tmp_path / "dev2"), **kw)
+    assert r_dev2.stats["device"]["levels"] > 0
+    assert r_leg2.levels == r_dev2.levels
+    assert r_leg2.total == r_dev2.total
+    assert r_dev2.violation.depth == r_leg2.violation.depth
+
+
+@pytest.mark.slow
+@pytest.mark.device_host
+def test_resume_cross_pipeline_host_backend_chain_equality(tmp_path):
+    """Cross-pipeline checkpoint resume on the HOST backend, both
+    orders, with digest-chain equality: a checkpoint written under the
+    deferred-probe device path resumes bit-identical under legacy and
+    vice versa, and both orders seal the IDENTICAL digest chain (the
+    PR 12 matrix pinned this for the device backend only; slow tier
+    like its device-backend predecessor test_resume_cross_pipeline)."""
+    import numpy as np
+
+    from kafka_specification_tpu.resilience.checkpoints import (
+        verify_file,
+    )
+
+    kw = {**KW, "store_trace": False, "visited_backend": "host"}
+    ref = check(_model("Kip101"), pipeline="fused", **kw)
+    chains = {}
+    for first, second in (("device", "legacy"), ("legacy", "device")):
+        ck = tmp_path / f"{first}-{second}"
+        cut = check(
+            _model("Kip101"), pipeline=first, checkpoint_dir=str(ck),
+            max_depth=5, **kw,
+        )
+        assert cut.diameter == 5
+        resumed = check(
+            _model("Kip101"), pipeline=second, checkpoint_dir=str(ck),
+            **kw,
+        )
+        assert resumed.levels == ref.levels
+        assert resumed.total == ref.total
+        arrays = verify_file(str(ck / "bfs_checkpoint.npz"))
+        chains[(first, second)] = np.asarray(arrays["digest_chain"])
+    a, b = chains.values()
+    assert np.array_equal(a, b)
+
+
+@pytest.mark.device_host
+def test_seed_composed_with_device_pipeline_host_backend():
+    """check(seed=) (the PR 14 state-cache delta seeding) composed with
+    --pipeline device on the host backend: counts/levels/verdicts
+    bit-identical to a cold seeded legacy run, with the device path
+    proven engaged past the seed boundary."""
+    from kafka_specification_tpu.resilience.integrity import (
+        LevelDigestChain,
+        fingerprint_rows,
+    )
+
+    m = _model("Kip101")
+    buf: list = []
+    kw = {k: v for k, v in KW.items() if k != "store_trace"}
+    bounded = check(m, max_depth=3, store_trace=True, collect_trace=buf,
+                    **kw)
+    assert bounded.violation is None and bounded.diameter == 3
+    rows = [t[0] for t in buf]
+    chain = LevelDigestChain()
+    fps_all = []
+    for d in range(len(bounded.levels)):
+        fps = fingerprint_rows(rows[d], m.spec.exact64)
+        chain.fold(fps)
+        chain.seal(d, bounded.levels[d])
+        fps_all.append(fps)
+    import numpy as np
+
+    seed = {
+        "visited_fps": np.sort(np.concatenate(fps_all)),
+        "frontier": rows[-1],
+        "levels": list(bounded.levels),
+        "total": bounded.total,
+        "depth": len(bounded.levels) - 1,
+        "digest_chain": chain.to_array(),
+    }
+    kw = {**kw, "store_trace": False, "visited_backend": "host"}
+    cold = check(m, pipeline="legacy", **kw)
+    seeded = check(m, pipeline="device", seed=dict(seed), **kw)
+    assert seeded.stats["device"]["levels"] > 0
+    assert seeded.stats["device"]["fallback"] is None
+    assert seeded.stats["seeded_from_depth"] == 3
+    assert seeded.levels == cold.levels
+    assert seeded.total == cold.total
+    assert (seeded.violation is None) == (cold.violation is None)
+
+
+@pytest.mark.perf
+@pytest.mark.device_host
+def test_device_host_backend_one_probe_per_level(tmp_path):
+    """The tentpole's sync contract, span-proven: on the host backend
+    the device pipeline makes exactly ONE batched host-probe call per
+    device-resident level (host syncs O(1)/level, vs one FpSet insert
+    per chunk on the fused path) and dispatches <=2 successor programs
+    per level — including MULTI-CHUNK levels (chunk_size 32)."""
+    m = _model("Kip101")
+    run = RunContext(str(tmp_path / "devhost"))
+    kw = {k: v for k, v in KW.items() if k != "stats_path"}
+    kw.update(chunk_size=32, visited_backend="host")
+    res = check(m, pipeline="device", run=run, **kw)
+    run.deactivate()
+    assert res.stats["device"]["levels"] > 0
+    assert res.stats["device"]["fallback"] is None
+    for lvl in res.stats["levels"]:
+        assert lvl["successor_launches"] <= 2, lvl
+    with open(os.path.join(run.dir, "spans.jsonl")) as fh:
+        spans = [json.loads(line) for line in fh]
+    dev = [s for s in spans
+           if s.get("span") == "step" and s.get("ph") != "B"
+           and s.get("pipeline") == "device"]
+    assert dev, "no device-level step spans recorded"
+    assert all(s["launches"] <= 2 for s in dev)
+    assert any(s.get("chunks", 1) > 1 for s in dev)
+    probes = [s for s in spans
+              if s.get("span") == "host-probe" and s.get("ph") != "B"]
+    # exactly one batched probe per device-resident level
+    assert len(probes) == res.stats["device"]["levels"]
+    assert all(p.get("batched") == "level" for p in probes)
+    # bit-identity cross-check at this chunking
+    r_leg = check(m, pipeline="legacy", **kw)
+    assert r_leg.levels == res.levels
+    assert r_leg.total == res.total
 
 
 @pytest.mark.slow
@@ -487,6 +706,43 @@ def test_cli_pipelines_list_is_jax_free_registry_dump(capsys):
     out = capsys.readouterr().out
     assert "device" in out and "degrades to 'fused'" in out
     assert "bit-identity oracle" in out
+    # the per-backend cells render too (which visited backends each
+    # pipeline serves natively vs degrades from)
+    assert "[backend host] native" in out
+    assert "[backend device-hash] degrades" in out
+
+
+def test_pipeline_registry_backend_matrix():
+    """Satellite: the per-BACKEND support matrix is the single queryable
+    source for which visited backends each pipeline serves natively,
+    and the unsupported cells' details ARE the fallback reasons the
+    engines stamp (backend_fallback_reason names the backend)."""
+    from kafka_specification_tpu.pipeline_registry import (
+        BACKENDS,
+        backend_fallback_reason,
+        backend_support,
+        list_pipelines,
+    )
+
+    assert BACKENDS == ("device", "device-hash", "host")
+    assert backend_support("device", "device")["supported"] is True
+    assert backend_support("device", "host")["supported"] is True
+    assert "batched" in backend_support("device", "host")["detail"]
+    assert backend_support("device", "device-hash")["supported"] is False
+    # fused and legacy serve every backend natively
+    for name in ("fused", "legacy"):
+        for be in BACKENDS:
+            assert backend_support(name, be)["supported"] is True
+            assert backend_fallback_reason(name, be) is None
+    reason = backend_fallback_reason("device", "device-hash")
+    assert reason is not None and "device-hash" in reason
+    assert backend_fallback_reason("device", "host") is None
+    with pytest.raises(ValueError, match="unknown visited backend"):
+        backend_support("device", "redis")
+    for e in list_pipelines():
+        assert set(e["backends"]) == set(BACKENDS)
+        for cell in e["backends"].values():
+            assert isinstance(cell["supported"], bool) and cell["detail"]
 
 
 def test_pipeline_registry_is_the_single_source():

@@ -205,9 +205,10 @@ def test_sharded_device_elastic_4_to_2(tmp_path, monkeypatch):
 
 
 def test_sharded_device_fallback_non_device_backend():
-    """The degradation ladder: a non-device visited backend records the
-    sticky fallback reason and the per-chunk path serves the run —
-    results identical to the oracle."""
+    """The degradation ladder: the device-hash backend (no whole-level
+    program) records the sticky fallback reason NAMING the backend and
+    the per-chunk path serves the run — results identical to the
+    oracle."""
     m = frl.make_model(3, 4, 1)
     ref = check_sharded(m, pipeline="legacy", min_bucket=64,
                         visited_backend="device-hash")
@@ -216,6 +217,47 @@ def test_sharded_device_fallback_non_device_backend():
     assert res.total == ref.total == 125
     assert res.stats["device"]["levels"] == 0
     assert "device-hash" in res.stats["device"]["fallback"]
+
+
+@pytest.mark.device_host
+def test_sharded_device_host_backend_bit_identity():
+    """`--sharded --pipeline device` on the HOST backend (the deferred
+    per-shard probe): each shard's level runs as ONE dispatched program
+    with NO visited shards on device, and each owner shard's FpSet
+    takes one batched insert per level — bit-identical to the per-chunk
+    sharded oracle on the violating workload (counts, levels,
+    first-violation rule, trace VALUES), device path proven engaged,
+    probe attribution recorded."""
+    ref = check_sharded(_mk_violating(), pipeline="legacy",
+                        visited_backend="host", **KW)
+    res = check_sharded(_mk_violating(), pipeline="device",
+                        visited_backend="host",
+                        stats_path=os.devnull, **KW)
+    assert res.stats["device"]["levels"] > 0
+    assert res.stats["device"]["fallback"] is None
+    assert _verdict(res) == _verdict(ref)
+    assert res.violation.trace == ref.violation.trace
+    assert res.violation.depth == 8 and \
+        res.violation.invariant == "WeakIsr"
+    assert any(
+        lvl.get("host_probe_ms") is not None
+        for lvl in res.stats.get("levels", [])
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.device_host
+def test_sharded_device_host_backend_clean_model():
+    """Deferred per-shard probe on a passing workload (multi-chunk
+    levels): counts/levels equal the per-chunk sharded host oracle."""
+    m = kip320.make_model(Config(2, 2, 1, 1))
+    ref = check_sharded(m, pipeline="legacy", visited_backend="host",
+                        **KW)
+    res = check_sharded(m, pipeline="device", visited_backend="host",
+                        **KW)
+    assert res.stats["device"]["levels"] > 0
+    assert (res.total, res.levels) == (ref.total, ref.levels) == \
+        (277, ref.levels)
 
 
 @pytest.mark.fault

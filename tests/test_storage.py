@@ -89,6 +89,34 @@ def test_tiered_fpset_novelty_matches_python_set(tmp_path):
     assert set(s.dump().tolist()) == ref
 
 
+@pytest.mark.device_host
+def test_tiered_fpset_insert_level_matches_per_chunk_inserts(tmp_path):
+    """The batched once-per-level probe (insert_level, the deferred-
+    probe device pipeline's host call): novelty masks bit-identical to
+    the equivalent per-chunk insert() sequence on a twin set, across
+    spills/merges, with residency still bounded (the hot tier spills
+    between slices).  Batches are duplicate-free within a call — the
+    device level-new set guarantees that — but duplicate ACROSS calls
+    and against spilled runs, which is exactly the level shape."""
+    a = TieredFpSet(str(tmp_path / "a"), mem_budget=256, runs_per_merge=2)
+    b = TieredFpSet(str(tmp_path / "b"), mem_budget=256, runs_per_merge=2)
+    rng = np.random.default_rng(11)
+    for _ in range(20):
+        level = rng.choice(
+            np.arange(2000, dtype=np.uint64), size=int(rng.integers(5, 120)),
+            replace=False,
+        ).astype(np.uint64)
+        got = a.insert_level(level, slice_rows=16)  # force slice spills
+        # twin: the serial shape — one insert() per 16-row chunk
+        want = np.zeros(level.shape[0], bool)
+        for at in range(0, level.shape[0], 16):
+            want[at: at + 16] = b.insert(level[at: at + 16])
+        np.testing.assert_array_equal(got, want)
+    assert len(a) == len(b)
+    assert a.stats()["spills"] > 0  # the budget really forced spills
+    assert set(a.dump().tolist()) == set(b.dump().tolist())
+
+
 def test_tiered_fpset_manifest_roundtrip(tmp_path):
     s = TieredFpSet(str(tmp_path / "fps"), mem_budget=200, runs_per_merge=3)
     fps = np.arange(100, dtype=np.uint64) * 977
