@@ -597,6 +597,141 @@ def render_run_index(root: str, rows: list) -> str:
     return "\n".join(out)
 
 
+def _parse_prom(path: str) -> dict:
+    """Parse one Prometheus textfile export into ``{key: value}``.
+
+    The key is the metric name plus its labels with ``run_id`` stripped
+    (every daemon stamps its own run id; a cross-daemon rollup must sum
+    ACROSS restarts, not treat each incarnation as a new series).
+    Histogram series are skipped — the rollup wants counters/gauges."""
+    out: dict = {}
+    try:
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return out
+    for ln in lines:
+        if not ln or ln.startswith("#"):
+            continue
+        try:
+            key, val = ln.rsplit(" ", 1)
+            value = float(val)
+        except ValueError:
+            continue
+        base, _, labels = key.partition("{")
+        if base.endswith(("_bucket", "_sum", "_count")):
+            continue
+        kept = [
+            part for part in labels.rstrip("}").split(",")
+            if part and not part.startswith("run_id=")
+        ]
+        if kept:
+            out["{}{{{}}}".format(base, ",".join(sorted(kept)))] = value
+        else:
+            out[base] = value
+    return out
+
+
+# gauges describe the ONE shared queue every daemon of a host sees, so a
+# per-host rollup takes the max across daemons instead of summing
+_ROLLUP_GAUGES = (
+    "kspec_svc_queue_pending",
+    "kspec_svc_queue_claimed",
+)
+
+
+def host_metrics_rollup(service_dir: str) -> dict:
+    """Sum every daemon's ``metrics*.prom`` under one host's service dir
+    (counters summed, shared-queue gauges maxed) — the per-host row of
+    the router report."""
+    try:
+        names = sorted(
+            n for n in os.listdir(service_dir)
+            if n.startswith("metrics") and n.endswith(".prom")
+        )
+    except OSError:
+        names = []
+    rolled: dict = {}
+    for name in names:
+        for key, value in _parse_prom(
+            os.path.join(service_dir, name)
+        ).items():
+            base = key.partition("{")[0]
+            if base in _ROLLUP_GAUGES:
+                rolled[key] = max(rolled.get(key, 0.0), value)
+            else:
+                rolled[key] = rolled.get(key, 0.0) + value
+    return rolled
+
+
+def router_report_data(router_dir: str) -> dict:
+    """The cross-host rollup for a router directory: per-host health +
+    queue depths (the router's own view) joined with each host's summed
+    daemon metrics, plus fleet-wide totals and the router event tally.
+    Jax-free like everything in obs."""
+    from ..service.router import Router
+
+    router = Router(router_dir)
+    data = router.overview()
+    totals: dict = {}
+    for h in data["hosts"]:
+        rolled = host_metrics_rollup(os.path.join(h["dir"], "service"))
+        h["metrics"] = rolled
+        for key, value in rolled.items():
+            # summing is right even for the queue gauges here: across
+            # HOSTS they describe distinct queues
+            totals[key] = totals.get(key, 0.0) + value
+    data["totals"] = totals
+    events: dict = {}
+    for rec in read_jsonl_tolerant(router.events_path):
+        kind = rec.get("event")  # records are kind="router", event=<what>
+        if kind:
+            events[kind] = events.get(kind, 0) + 1
+    data["events"] = events
+    return data
+
+
+def render_router_report(data: dict) -> str:
+    out = [
+        f"Router {data['dir']}: {len(data['hosts'])} hosts, "
+        f"{data['routes']} routed jobs, dead after "
+        f"{data['dead_after_s']}s (+{data['clock_skew_s']}s skew "
+        "allowance)"
+    ]
+    for h in data["hosts"]:
+        age = h["hb_age_s"]
+        m = h.get("metrics") or {}
+        jobs = sum(
+            v for k, v in m.items()
+            if k.startswith("kspec_svc_jobs_total")
+        )
+        hits = m.get("kspec_svc_state_cache_hits_total", 0)
+        falls = m.get("kspec_svc_state_cache_fallbacks_total", 0)
+        out.append(
+            f"  host{h['host']} [{h['state']:>6}] {h['dir']}: "
+            f"{h['pending']} pending, {h['claimed']} in flight, "
+            f"{jobs:.0f} verdicts, cache {hits:.0f} hits/"
+            f"{falls:.0f} fallbacks, heartbeat "
+            + ("never" if age is None else f"{age:.1f}s ago")
+        )
+    ev = data.get("events") or {}
+    if ev:
+        out.append(
+            "  router events: "
+            + ", ".join(f"{k}={ev[k]}" for k in sorted(ev))
+        )
+    t = data.get("totals") or {}
+    done = sum(
+        v for k, v in t.items() if k.startswith("kspec_svc_jobs_total")
+    )
+    out.append(
+        f"  fleet totals: {done:.0f} verdicts, "
+        f"{t.get('kspec_svc_state_cache_hits_total', 0):.0f} cache hits, "
+        f"{t.get('kspec_svc_takeovers_total', 0):.0f} takeovers"
+    )
+    return "\n".join(out)
+
+
 def _fmt_bytes(n) -> str:
     if n is None:
         return "?"

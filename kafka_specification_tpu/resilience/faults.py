@@ -121,6 +121,32 @@ exception — a resume from the previous good generation starts below N
 again and would re-fire; it is meant for in-process torn-write tests, not
 supervised runs.
 
+Host faults (the cross-host service chaos family — service/router.py +
+service/fleet.py; each host of a routed fleet is an isolated service dir
+whose daemons run with `KSPEC_HOST_INSTANCE=<i>`, wired to
+`FaultPlan.set_host`; one composed plan string can then drive a whole
+multi-host drill, with each fault firing only on its targeted host):
+
+    kill@host<i>:N            kill host i's serving daemon while it
+                              handles its Nth job (before any verdict) —
+                              the whole-host-death rehearsal when the
+                              host runs one daemon.  Durable once per
+                              service dir, like crash@daemon, so a
+                              restarted host converges
+    partition@host<i>[:N]     host i loses the shared state-cache
+                              namespace for its next N jobs (default 1):
+                              lookups degrade to typed `cache-fallback`
+                              cold runs, publishes are DEFERRED and
+                              re-published when the partition heals —
+                              never a torn or unverified cross-host read
+    skew@host<i>:SECS         shift host i's wall clock by SECS (may be
+                              negative) in every timestamp it writes
+                              into cross-host-visible metadata (claim
+                              leases, heartbeats) — the drifted-clock
+                              rehearsal behind the KSPEC_CLOCK_SKEW
+                              allowance in lease expiry and router
+                              heartbeat freshness
+
 Budgeted faults (`compile_oom`, `transient_device_err:N`) are consumed
 in-process and do not persist across restarts.
 """
@@ -195,6 +221,30 @@ FAULT_REGISTRY = (
      "of this process AFTER its promote: the next lookup's chain/CRC "
      "verification rejects it with a cache-fallback event and the check "
      "degrades to a cold run — never a wrong verdict"),
+    ("kill", ("host",), "kill@host<i>:N",
+     "kill host i's serving daemon while it handles its Nth job, before "
+     "any verdict is derived (the whole-host-death rehearsal of the "
+     "routed fleet — service/router.py detects the stale heartbeats and "
+     "re-routes the host's pending jobs; its leased claims come back via "
+     "the janitor takeover protocol at lease expiry).  Fires once per "
+     "SERVICE DIR (durable fired-marker), so a restarted host converges; "
+     "hosts are scoped by KSPEC_HOST_INSTANCE, so one composed plan "
+     "string drives a whole multi-host drill"),
+    ("partition", ("host",), "partition@host<i>[:N]",
+     "host i loses the shared state-space-cache namespace for its next N "
+     "jobs (default 1): every lookup in the window degrades to a typed "
+     "cache-fallback cold run (reason 'partition') and every publish is "
+     "DEFERRED, then re-published when the partition heals — verdicts "
+     "are untouched and the federation never serves a torn read.  "
+     "Durable once per service dir, like kill@host"),
+    ("skew", ("host",), "skew@host<i>:SECS",
+     "shift host i's wall clock by SECS (float, may be negative) in "
+     "every timestamp it writes into cross-host-visible metadata — "
+     "claim-lease stamps and heartbeat records — rehearsing a fleet "
+     "member with a drifted clock.  The KSPEC_CLOCK_SKEW allowance in "
+     "lease expiry (service/queue.py) and router heartbeat freshness "
+     "(service/router.py) is what keeps a skewed-but-live host's claims "
+     "from being stolen; persistent for the process lifetime"),
 )
 
 _SITES_BY_KIND = {k: sites for k, sites, _g, _d in FAULT_REGISTRY}
@@ -214,10 +264,12 @@ def list_faults() -> list:
 class _Spec:
     kind: str  # crash | corrupt_ckpt | compile_oom | transient_device_err
     point: Optional[str]  # level | ckpt | None
-    arg: Optional[int]  # level number (crash/corrupt) — None = first
+    arg: Optional[float]  # level/ordinal (int) or seconds (skew) — None =
+    # first
     budget: int  # remaining firings
     shard: Optional[int] = None  # fire only on this shard's host process
     instance: Optional[int] = None  # fire only on this daemon instance
+    host: Optional[int] = None  # fire only on this service host
 
 
 def _split_shard(rest: str, tok: str):
@@ -287,6 +339,63 @@ def _parse_token(tok: str) -> _Spec:
             if nth < 1:
                 raise ValueError(f"fault {tok!r}: job ordinal must be >= 1")
             return _Spec("crash", "daemon", nth, 1, instance=inst)
+        if point.startswith("host") and name in ("kill", "partition",
+                                                 "skew"):
+            # service-host scope (service/router.py): the host index is
+            # part of the site token, like daemon<i> — the plan string is
+            # shared by every host of the routed fleet and each fault
+            # fires only on its target (KSPEC_HOST_INSTANCE -> set_host)
+            try:
+                host = int(point[len("host"):])
+            except ValueError:
+                raise ValueError(
+                    f"fault {tok!r}: host scope must be 'host<index>', "
+                    f"got {point!r}"
+                )
+            if host < 0:
+                raise ValueError(f"fault {tok!r}: host index must be >= 0")
+            if name == "kill":
+                try:
+                    nth = int(arg)
+                except ValueError:
+                    raise ValueError(
+                        f"fault {tok!r}: kill@host<i>:N needs an integer "
+                        "job ordinal N"
+                    )
+                if nth < 1:
+                    raise ValueError(
+                        f"fault {tok!r}: job ordinal must be >= 1"
+                    )
+                return _Spec("kill", "host", nth, 1, host=host)
+            if name == "partition":
+                if arg:
+                    try:
+                        njobs = int(arg)
+                    except ValueError:
+                        raise ValueError(
+                            f"fault {tok!r}: partition@host<i>:N needs an "
+                            "integer job count N"
+                        )
+                    if njobs < 1:
+                        raise ValueError(
+                            f"fault {tok!r}: job count must be >= 1"
+                        )
+                else:
+                    njobs = 1
+                return _Spec("partition", "host", njobs, 1, host=host)
+            try:
+                secs = float(arg)
+            except ValueError:
+                raise ValueError(
+                    f"fault {tok!r}: skew@host<i>:SECS needs a number of "
+                    "seconds (float, may be negative)"
+                )
+            if secs == 0.0:
+                raise ValueError(
+                    f"fault {tok!r}: a zero skew rehearses nothing — "
+                    "give a nonzero SECS"
+                )
+            return _Spec("skew", "host", secs, 1, host=host)
         if not arg:
             raise ValueError(f"fault {tok!r}: '@{point}' needs ':<level>'")
         try:
@@ -351,6 +460,10 @@ class FaultPlan:
         # scoped faults fire only on an exact match — None never fires,
         # so engine-side plans carrying daemon faults are inert there
         self.instance: Optional[int] = None
+        # which service host this process serves (set_host, wired by
+        # service/daemon.py from KSPEC_HOST_INSTANCE); host-scoped faults
+        # fire only on an exact match — same contract as `instance`
+        self.host: Optional[int] = None
         self.specs = [
             _parse_token(t.strip())
             for t in self.spec.split(",")
@@ -419,6 +532,70 @@ class FaultPlan:
             s.budget -= 1
             return True
         return False
+
+    # --- host-scoped faults (the routed fleet's chaos family) -----------
+    def set_host(self, host: int) -> None:
+        """Record which service host this process serves (the router's
+        per-host service dirs launch their daemons with
+        KSPEC_HOST_INSTANCE=i).  `kill@host<i>:N` / `partition@host<i>` /
+        `skew@host<i>:SECS` then fire only in the targeted host's
+        processes — one composed plan string drives a whole multi-host
+        drill, each fault landing on exactly its target."""
+        self.host = int(host)
+
+    def _host_match(self, s: _Spec) -> bool:
+        return (
+            s.host is not None
+            and self.host is not None
+            and s.host == self.host
+        )
+
+    def host_kill(self, lo: int, hi: Optional[int] = None) -> None:
+        """Raise InjectedCrash if a `kill@host<i>:N` fault targets this
+        host and job ordinal N falls in [lo, hi] — the daemon-side hook,
+        called next to `daemon_crash` before any verdict is derived.
+        The router sees the host's heartbeats go stale and re-routes its
+        pending jobs; leased claims come back through the takeover
+        protocol, so the verdict still publishes exactly once."""
+        hi = lo if hi is None else hi
+        for s in self.specs:
+            if s.kind != "kill" or s.budget <= 0:
+                continue
+            if not self._host_match(s):
+                continue
+            if not (lo <= s.arg <= hi):
+                continue
+            s.budget -= 1
+            raise InjectedCrash(
+                f"injected host kill on host {s.host} at job ordinal "
+                f"{int(s.arg)} (KSPEC_FAULT)"
+            )
+
+    def host_partition(self) -> int:
+        """Number of jobs host i must run cache-partitioned (once per
+        `partition@host<i>[:N]` fault targeting this host, then 0).  The
+        daemon consumes it at a claim sweep: for that many jobs every
+        state-cache lookup degrades to a typed `cache-fallback` cold run
+        and every publish is deferred, re-published on heal."""
+        for s in self.specs:
+            if s.kind != "partition" or s.budget <= 0:
+                continue
+            if not self._host_match(s):
+                continue
+            s.budget -= 1
+            return int(s.arg)
+        return 0
+
+    def skew_s(self) -> float:
+        """Injected wall-clock shift for this host's cross-host-visible
+        timestamps (claim leases, heartbeat records); 0.0 without a
+        matching `skew@host<i>:SECS`.  Not budget-consumed: a drifted
+        clock drifts for the whole process lifetime."""
+        total = 0.0
+        for s in self.specs:
+            if s.kind == "skew" and self._host_match(s):
+                total += float(s.arg)
+        return total
 
     def set_local_shards(self, shards) -> None:
         """Record which shards this process hosts (the sharded engine's
@@ -586,6 +763,38 @@ class FaultPlan:
                     s.budget -= 1
                     return True
         return False
+
+
+#: injected_skew_s cache: (KSPEC_FAULT, KSPEC_HOST_INSTANCE) -> seconds.
+#: The lease-stamp path calls this on every renewal; re-parsing the plan
+#: each time would put a parser on the queue hot path for nothing — the
+#: env pair is fixed for a process's lifetime in production and varies
+#: only across monkeypatched tests, which the keyed cache handles.
+_SKEW_CACHE: dict = {}
+
+
+def injected_skew_s() -> float:
+    """Wall-clock shift (seconds) the `skew@host<i>:SECS` fault injects
+    into timestamps THIS process writes into cross-host-visible metadata
+    (claim leases — service/queue.py — and heartbeat records).  0.0
+    unless KSPEC_FAULT carries a skew spec targeting this process's
+    KSPEC_HOST_INSTANCE; never raises (an unparseable plan is the
+    engine/CLI's error to report, not the lease writer's)."""
+    key = (
+        os.environ.get(ENV_VAR, ""),
+        os.environ.get("KSPEC_HOST_INSTANCE", ""),
+    )
+    if key not in _SKEW_CACHE:
+        skew = 0.0
+        if key[0] and key[1]:
+            try:
+                plan = FaultPlan(key[0])
+                plan.set_host(int(key[1]))
+                skew = plan.skew_s()
+            except (ValueError, TypeError):
+                skew = 0.0
+        _SKEW_CACHE[key] = skew
+    return _SKEW_CACHE[key]
 
 
 def corrupt_file(path: str, n_bytes: int = 64) -> None:

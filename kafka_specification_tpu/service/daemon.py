@@ -41,7 +41,7 @@ from typing import Optional
 from ..engine.bfs import check
 from ..obs import RunContext
 from ..obs.metrics import MetricsRegistry
-from ..resilience.faults import FaultPlan, InjectedCrash
+from ..resilience.faults import FaultPlan, InjectedCrash, injected_skew_s
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
 from ..resilience.integrity import EXIT_INTEGRITY, IntegrityError
 from ..resilience.resources import ResourceExhausted
@@ -104,6 +104,13 @@ class ServeConfig:
     # verify: any artifact problem degrades to a cold run with a
     # cache-fallback event — it can never produce a wrong verdict.
     state_cache: bool = True
+    # cache FEDERATION (docs/service.md): the cache root defaults to
+    # <svc>/state-cache, but pointing N hosts' daemons at ONE shared
+    # directory (--state-cache-dir / $KSPEC_STATE_CACHE_DIR) gives them a
+    # federated namespace — entries are content-addressed and re-proven
+    # on every read, so host B serves host A's publishes chain-verified
+    # with no coordination beyond the filesystem
+    state_cache_dir: Optional[str] = None
 
 
 class Daemon:
@@ -141,15 +148,34 @@ class Daemon:
         self.fault = FaultPlan.from_env()
         self.fault.set_instance(self.instance if self.instance is not None
                                 else 0)
+        # host identity (service/router.py): each host of a routed fleet
+        # exports KSPEC_HOST_INSTANCE=<i> to its daemons, arming the
+        # host-scoped chaos faults (kill@host<i> / partition@host<i> /
+        # skew@host<i>) for exactly that host's processes
+        if os.environ.get("KSPEC_HOST_INSTANCE"):
+            try:
+                self.fault.set_host(int(os.environ["KSPEC_HOST_INSTANCE"]))
+            except ValueError:
+                pass
         self.state_cache = None
         if cfg.state_cache:
             from .state_cache import StateSpaceCache
 
             self.state_cache = StateSpaceCache(
-                os.path.join(self.queue.dir, "state-cache"),
+                cfg.state_cache_dir
+                or os.environ.get("KSPEC_STATE_CACHE_DIR")
+                or os.path.join(self.queue.dir, "state-cache"),
                 fault_plan=self.fault,
                 event=self._event,
             )
+        # partition@host<i> window state: while _partition_left > 0 the
+        # next jobs' cache lookups degrade to typed cold runs and their
+        # publishes are deferred here, re-published when the window
+        # closes (the heal) — the shared namespace was LOST, not the
+        # daemon, so the work it completed meanwhile still federates
+        self._partition_left = 0
+        self._partition_ids: set = set()
+        self._partition_deferred: list = []
         self._seeds: dict = {}  # job_id -> engine seed dict (cache delta)
         self._trace_buf: list = []  # solo runs' trace store (publication)
         self._janitor_last = 0.0
@@ -319,6 +345,20 @@ class Daemon:
                 )
             except InjectedCrash:
                 self._mark_daemon_fault("crash")
+                raise
+        # kill@host<i>:N — the whole-host-death drill (service/router.py):
+        # same firing point and exactly-once story as crash@daemon, but
+        # scoped by KSPEC_HOST_INSTANCE so one composed plan string can
+        # target one host of a routed fleet.  The router sees the host's
+        # heartbeats go stale and re-routes its pending jobs; the leased
+        # claims come back through the takeover protocol.
+        if self._daemon_fault_armed("kill"):
+            try:
+                self.fault.host_kill(
+                    self.jobs_done + 1, self.jobs_done + len(group)
+                )
+            except InjectedCrash:
+                self._mark_daemon_fault("kill")
                 raise
         # the busy-heartbeat window opens BEFORE the kernel-cache lookup:
         # a cold miss runs build_model + prepare for minutes, and without
@@ -675,6 +715,17 @@ class Daemon:
         problem is a typed cache-fallback (inside lookup) + False."""
         if self.state_cache is None or spec.get("fault"):
             return False
+        if self._partition_check(spec):
+            # partition@host<i>: the shared cache namespace is GONE for
+            # this window — degrade to a local-cold run with the typed
+            # fallback every other cache problem gets; the publish side
+            # defers and re-publishes on heal
+            self._event(
+                "cache-fallback", reason="partition",
+                jobs=[spec["job_id"]],
+            )
+            self.metrics.inc("kspec_svc_state_cache_fallbacks_total")
+            return False
         from .state_cache import CacheHit, CacheSeed, key_for_job
         from .verdict import VERDICT_SCHEMA
 
@@ -718,10 +769,55 @@ class Daemon:
         self.metrics.inc("kspec_svc_state_cache_misses_total")
         return False
 
+    def _partition_check(self, spec: dict) -> bool:
+        """True while this job's cache consultation falls inside an
+        injected partition window (partition@host<i>[:N], armed lazily
+        on the first consultation after the fault matches; durable
+        fired-marker, so a restarted daemon converges).  The window
+        counts PUBLISHING jobs: each one registers here, defers its
+        publish, and the last one's deferral triggers the heal."""
+        if self._partition_left == 0 and self._daemon_fault_armed(
+            "partition"
+        ):
+            n = self.fault.host_partition()
+            if n:
+                self._mark_daemon_fault("partition")
+                self._partition_left = n
+                self._event("cache-partition-injected", jobs_degraded=n)
+        if self._partition_left <= 0:
+            return False
+        self._partition_ids.add(spec["job_id"])
+        return True
+
+    def _heal_partition(self) -> None:
+        """The partition window closed: the shared namespace is back, so
+        everything completed meanwhile re-publishes — the federation
+        sees the host's partitioned work as if it had never dropped off."""
+        deferred, self._partition_deferred = self._partition_deferred, []
+        for args in deferred:
+            self._publish_state_cache(*args)
+        self._event("cache-partition-heal", republished=len(deferred))
+
     def _publish_state_cache(self, spec, cfg, emitted, entry, res,
                              level_rows=None) -> None:
         from .state_cache import key_for_job
 
+        jid = spec.get("job_id")
+        if jid in self._partition_ids:
+            # mid-partition: the namespace is unreachable — defer, and
+            # re-publish when the window closes (never publish into a
+            # namespace the fault says we cannot see)
+            self._partition_ids.discard(jid)
+            self._partition_deferred.append(
+                (spec, cfg, emitted, entry, res, level_rows)
+            )
+            self._partition_left = max(0, self._partition_left - 1)
+            self._event(
+                "cache-publish-deferred", reason="partition", jobs=[jid],
+            )
+            if self._partition_left == 0:
+                self._heal_partition()
+            return
         try:
             key = key_for_job(
                 spec, cfg, emitted, job_invariants(spec["module"], cfg)
@@ -954,6 +1050,12 @@ class Daemon:
                     self.heartbeat_path,
                     heartbeat_record(
                         "service-heartbeat",
+                        # skew@host<i>:SECS shifts the clock this host
+                        # stamps into cross-host-visible metadata — the
+                        # router's freshness check reads these `unix`
+                        # fields, and its KSPEC_CLOCK_SKEW allowance is
+                        # what this fault rehearses (0-shift otherwise)
+                        t=time.time() + injected_skew_s(),
                         pid=os.getpid(),
                         jobs_done=self.jobs_done,
                         **fields,

@@ -78,6 +78,15 @@ class FleetServeConfig:
     scale_down_idle_s: float = 60.0
     # child construction
     serve_args: tuple = ()  # extra argv appended to each `cli serve`
+    # cache federation (docs/service.md): point every daemon of this
+    # fleet at a SHARED state-cache root (entries are content-addressed
+    # and re-proven per read, so N hosts federate over one namespace);
+    # None keeps the per-service-dir default <svc>/state-cache
+    state_cache_dir: Optional[str] = None
+    # host identity for the routed fleet (service/router.py): exported
+    # to every daemon as KSPEC_HOST_INSTANCE, scoping the kill@host<i> /
+    # partition@host<i> / skew@host<i> chaos faults to this host
+    host_instance: Optional[int] = None
     env: Optional[dict] = None
     command: Optional[object] = None  # callable(instance)->argv override
     events: Optional[str] = None  # default <svc>/service/fleet-events.jsonl
@@ -151,14 +160,23 @@ class FleetManager:
     def _command(self, instance: int) -> list:
         if self.cfg.command is not None:
             return list(self.cfg.command(instance))
-        return [
+        argv = [
             sys.executable, "-m", "kafka_specification_tpu.utils.cli",
             "serve", self.queue.dir,
         ] + list(self.cfg.serve_args)
+        if self.cfg.state_cache_dir:
+            argv += ["--state-cache-dir", self.cfg.state_cache_dir]
+        return argv
 
     def _spawn(self, slot: _Slot) -> None:
         env = dict(self.cfg.env if self.cfg.env is not None else os.environ)
         env["KSPEC_DAEMON_INSTANCE"] = str(slot.instance)
+        if self.cfg.host_instance is not None:
+            env["KSPEC_HOST_INSTANCE"] = str(self.cfg.host_instance)
+        if self.cfg.state_cache_dir:
+            # command-override children (tests' stub daemons) get the
+            # federation root too, even though _command wasn't consulted
+            env["KSPEC_STATE_CACHE_DIR"] = self.cfg.state_cache_dir
         os.makedirs(self.log_dir, exist_ok=True)
         slot.spawn_count += 1
         if slot.log_fh is not None:

@@ -56,6 +56,7 @@ import errno
 import hashlib
 import json
 import os
+import random
 import time
 from typing import Optional
 
@@ -96,26 +97,57 @@ RETRY_ATTEMPTS = int(os.environ.get("KSPEC_QUEUE_RETRY_ATTEMPTS", "5"))
 RETRY_BASE_S = float(os.environ.get("KSPEC_QUEUE_RETRY_BASE_S", "0.02"))
 RETRY_CAP_S = 0.25
 
+#: module-level jitter source; callers (tests) may pass their own seeded
+#: ``random.Random`` for a reproducible backoff trace
+_RETRY_RNG = random.Random()
+
+#: allowance for wall-clock disagreement between hosts sharing a queue
+#: directory (router vs claimer, janitor vs claimer): every freshness /
+#: expiry comparison of a timestamp WRITTEN BY ANOTHER HOST widens its
+#: window by this much, so a live claim from a slightly-behind clock is
+#: never stolen (KSPEC_CLOCK_SKEW overrides; single-host deployments can
+#: set it to 0)
+DEFAULT_CLOCK_SKEW_S = 5.0
+
+
+def clock_skew_s() -> float:
+    try:
+        return max(
+            0.0, float(os.environ.get("KSPEC_CLOCK_SKEW",
+                                      DEFAULT_CLOCK_SKEW_S))
+        )
+    except ValueError:
+        return DEFAULT_CLOCK_SKEW_S
+
 
 def is_transient_oserror(e: OSError) -> bool:
     return e.errno in _TRANSIENT_ERRNOS
 
 
 def retry_transient(fn, attempts: Optional[int] = None,
-                    base: Optional[float] = None):
-    """Run `fn()`; on a transient OSError retry with bounded exponential
+                    base: Optional[float] = None, rng=None):
+    """Run `fn()`; on a transient OSError retry with bounded FULL-JITTER
     backoff, re-raising the final failure.  Non-transient OSErrors
     (ENOENT, EACCES, ...) propagate immediately — they are answers or
-    real faults, not flakes."""
+    real faults, not flakes.
+
+    Full jitter (sleep ~ U[0, min(cap, base*2^i)]) instead of the plain
+    capped exponential: when a fleet-wide ESTALE hits every client of a
+    shared service directory at once, deterministic backoff re-collides
+    the whole fleet on each retry; uniform jitter spreads the herd.
+    `rng` (a ``random.Random``) makes the schedule reproducible in tests.
+    """
     attempts = RETRY_ATTEMPTS if attempts is None else attempts
     base = RETRY_BASE_S if base is None else base
+    rng = _RETRY_RNG if rng is None else rng
     for i in range(max(1, attempts)):
         try:
             return fn()
         except OSError as e:
             if not is_transient_oserror(e) or i >= attempts - 1:
                 raise
-            time.sleep(min(RETRY_CAP_S, base * (2.0 ** i)))
+            time.sleep(rng.uniform(0.0, min(RETRY_CAP_S,
+                                            base * (2.0 ** i))))
 
 PENDING = "pending"
 CLAIMED = "claimed"
@@ -445,12 +477,20 @@ class JobQueue:
         read is treated as no-lease (orphan) which only costs a requeue
         of an idempotent job."""
         try:
+            # injected_skew_s: the skew@host<i> fault shifts the wall
+            # clock THIS host stamps into cross-host-visible metadata
+            # (0.0 outside the chaos drills) — the rehearsal for a fleet
+            # member whose clock drifted
+            from ..resilience.faults import injected_skew_s
+
             with open(self._lease_path(job_id), "w") as fh:
                 json.dump(
                     {
                         "pid": os.getpid(),
                         "token": _PROC_TOKEN,
-                        "lease_unix": round(time.time(), 3),
+                        "lease_unix": round(
+                            time.time() + injected_skew_s(), 3
+                        ),
                     },
                     fh,
                 )
@@ -500,13 +540,16 @@ class JobQueue:
                 )
             except OSError:
                 return True  # claim vanished under us: nothing to hold
-            return age > 10.0
+            return age > 10.0 + clock_skew_s()
         if lease_ttl is None:
             lease_ttl = float(
                 os.environ.get("KSPEC_CLAIM_LEASE_TTL", DEFAULT_LEASE_TTL)
             )
+        # the lease timestamp may come from ANOTHER host's clock: widen
+        # the expiry window by the skew allowance so a live claimer whose
+        # clock runs a few seconds behind ours is never stolen from
         age = time.time() - float(lease.get("lease_unix", 0.0))
-        if age >= lease_ttl:
+        if age >= lease_ttl + clock_skew_s():
             # expiry dominates even a live pid: the busy-heartbeat loop
             # renews every few seconds, so an expired lease means the
             # claimer is wedged beyond rescue (or a foreign-host daemon
@@ -575,7 +618,7 @@ class JobQueue:
                                     "KSPEC_CLAIM_LEASE_TTL",
                                     DEFAULT_LEASE_TTL,
                                 )
-                            )
+                            ) + clock_skew_s()
                             else "dead-pid"
                         ),
                         "at": round(time.time(), 3),

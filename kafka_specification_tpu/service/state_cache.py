@@ -41,13 +41,27 @@ chunk size, overlap) deliberately do NOT key: the bit-identity contracts
 pin the verdict invariant across all of them.  Bounds split the key in
 two levels on disk::
 
-    <svc>/state-cache/<base16>/          base = everything but bounds
-        d<depth>-s<states>/entry.json    one entry per bounds pair
-        d<depth>-s<states>/visited.run   sorted u64 fingerprints (KRUN1)
-        d<depth>-s<states>/boundary.npy  deepest level's packed rows
+    <root>/<base16>/                          base = everything but bounds
+        d<depth>-s<states>/entry.json         one entry per bounds pair
+        d<depth>-s<states>/visited-<u>.run    sorted u64 fingerprints
+                                              (KRUN1)
+        d<depth>-s<states>/boundary-<u>.npy   deepest level's packed rows
 
 so a delta lookup (same base, larger depth bound) is a directory scan of
-the base, not of the whole cache.
+the base, not of the whole cache.  The cache root defaults to
+``<svc>/state-cache`` but may be any shared directory
+(``--state-cache-dir`` / ``$KSPEC_STATE_CACHE_DIR``): entries are
+content-addressed and re-proven on every read, so N hosts can share ONE
+namespace — a hit published by host A serves chain-verified from host B
+with no coordination beyond the filesystem (cache FEDERATION,
+docs/service.md).  Data filenames carry a per-publisher nonce ``<u>``
+and travel inside the entry record; concurrent same-key publishes each
+write their own data files and race only the atomic ``entry.json``
+promote — last promote wins, both candidates were chain-valid, and the
+loser's orphaned files are garbage-collected (grace-aged) by later
+publishes.  A reader mid-race sees the OLD entry, the NEW entry, or a
+verification failure that degrades to a typed cold run — never a torn
+read.
 
 Publication happens after a completed SOLO run (the daemon's singleton
 path): the per-level packed rows the trace store already holds are
@@ -468,6 +482,12 @@ class StateSpaceCache:
         try:
             os.makedirs(d, exist_ok=True)
             art_files = []
+            # per-publisher nonce: names this publisher's data files AND
+            # privatises the entry-promote tmp, so two hosts racing the
+            # same key never touch each other's in-flight bytes — the
+            # promote itself (os.replace) is the only shared step, and
+            # it is atomic: last promote wins
+            nonce = f"{os.getpid():x}-{os.urandom(4).hex()}"
             if with_artifact:
                 chain = _integ.LevelDigestChain()
                 all_fps = []
@@ -479,16 +499,24 @@ class StateSpaceCache:
                     chain.seal(depth, int(levels[depth]))
                     all_fps.append(fps)
                 visited = np.sort(np.concatenate(all_fps))
-                run_path = os.path.join(d, "visited.run")
+                # per-publisher data filenames (the names travel in the
+                # entry record, so lookup never assumes them): two hosts
+                # racing the same key each write their OWN data files and
+                # only the entry.json promote decides the winner — with a
+                # shared fixed name, a reader could open A's entry over
+                # B's half-written run, a torn read no verifier owes a
+                # defense against
+                run_path = os.path.join(d, f"visited-{nonce}.run")
                 run_meta = write_run(run_path, visited)
                 art_files.append(run_path)
                 boundary = np.ascontiguousarray(level_rows[-1], np.uint32)
-                b_path = os.path.join(d, "boundary.npy")
+                b_path = os.path.join(d, f"boundary-{nonce}.npy")
                 b_crc = _write_npy(b_path, boundary)
                 art_files.append(b_path)
                 entry["artifact"] = {
                     "visited": run_meta,
-                    "boundary": {"name": "boundary.npy", "crc32": b_crc,
+                    "boundary": {"name": os.path.basename(b_path),
+                                 "crc32": b_crc,
                                  "rows": int(boundary.shape[0])},
                     "chain": [[int(v) for v in row]
                               for row in chain.to_array().tolist()],
@@ -503,6 +531,7 @@ class StateSpaceCache:
                 # exactly what a real full disk does mid-publish (data
                 # without an entry is invisible; nothing half-trusted)
                 before_replace=lambda: plan.enospc("cache", ordinal),
+                tmp_nonce=nonce,
             )
         except OSError as e:
             self._fallback(key, f"publish-error: {e}", ordinal=ordinal)
@@ -521,13 +550,18 @@ class StateSpaceCache:
             artifact=entry["artifact"] is not None,
             states=verdict.get("distinct_states"),
         )
+        # a lost promote race leaves this publisher's data files orphaned
+        # in the entry dir: collect whatever the CURRENT entry does not
+        # reference (grace-aged, so a racing publisher mid-write is never
+        # collected before its own promote)
+        self.collect_garbage(key)
         # flip@cache:N — the silent-corruption rehearsal: bytes flip in
         # the promoted artifact; the NEXT lookup's verification must
         # reject it (cache-fallback + cold run, never a wrong verdict)
         if plan.flip("cache", ordinal):
             target = (
-                os.path.join(d, "visited.run")
-                if entry["artifact"] is not None
+                art_files[0]
+                if art_files
                 else os.path.join(d, "entry.json")
             )
             try:
@@ -535,6 +569,59 @@ class StateSpaceCache:
             except OSError:
                 pass
         return True
+
+    def collect_garbage(self, key: CacheKey,
+                        grace_s: Optional[float] = None) -> list:
+        """Remove data files in `key`'s entry dir that the CURRENT
+        promoted entry does not reference — the loser's artifacts after a
+        concurrent same-key publish race (both candidates were chain-
+        valid; last entry-promote won; the loser's uniquely-named run/
+        boundary files are invisible to every reader and now dead
+        weight).  Files younger than the grace window (default
+        KSPEC_STATE_CACHE_GC_GRACE_S, 120s) are kept: they may belong to
+        a publisher whose promote hasn't landed yet.  Returns the
+        basenames removed; never raises."""
+        if grace_s is None:
+            try:
+                grace_s = float(
+                    os.environ.get("KSPEC_STATE_CACHE_GC_GRACE_S", "120")
+                )
+            except ValueError:
+                grace_s = 120.0
+        d = self._entry_dir(key)
+        referenced = {"entry.json"}
+        try:
+            with open(os.path.join(d, "entry.json")) as fh:
+                entry = json.load(fh)
+            art = entry.get("artifact") or {}
+            if art.get("visited"):
+                referenced.add(art["visited"]["name"])
+            if art.get("boundary"):
+                referenced.add(art["boundary"]["name"])
+        except (OSError, ValueError, KeyError, TypeError):
+            # no / unreadable entry: nothing is provably garbage (the
+            # first publisher may be mid-race) — collect nothing
+            return []
+        removed = []
+        now = time.time()
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return []
+        for name in names:
+            if name in referenced or not (
+                name.endswith(".run") or name.endswith(".npy")
+            ):
+                continue  # tmp files belong to atomic_write's own cleanup
+            path = os.path.join(d, name)
+            try:
+                if now - os.path.getmtime(path) < grace_s:
+                    continue
+                os.unlink(path)
+                removed.append(name)
+            except OSError:
+                continue
+        return removed
 
 
 def entry_self_digest(entry: dict) -> str:

@@ -37,15 +37,23 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def atomic_write(path: str, write_fn, before_replace=None) -> None:
+def atomic_write(path: str, write_fn, before_replace=None,
+                 tmp_nonce=None) -> None:
     """Write `path` crash-safely: `write_fn(fh)` fills the tmp file, then
     it is fsync'd, atomically promoted, and the parent directory entry is
     fsync'd.  `before_replace` (if given) runs between the durable tmp
     write and the promote — the torn-write fault-injection point
     (`KSPEC_FAULT=crash@merge:N` / `enospc@...:N`).  Any failure unlinks
     the tmp before propagating, so a caller that survives the error (the
-    engines' RESOURCE_EXHAUSTED clean-exit path) leaves no orphan."""
-    tmp = path + ".tmp"
+    engines' RESOURCE_EXHAUSTED clean-exit path) leaves no orphan.
+
+    `tmp_nonce` privatises the tmp name (`path.<nonce>.tmp`) for callers
+    whose writers race each other to the SAME final path — the default
+    shared `path.tmp` would let one racer replace/unlink the sibling's
+    half-written tmp out from under it.  Nonce'd names still match
+    `sweep_tmp`'s pattern, so a crash mid-promote leaves nothing behind
+    that the janitor cannot collect."""
+    tmp = path + ".tmp" if tmp_nonce is None else f"{path}.{tmp_nonce}.tmp"
     try:
         with open(tmp, "wb") as fh:
             write_fn(fh)

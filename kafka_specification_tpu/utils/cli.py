@@ -602,6 +602,15 @@ def main(argv=None):
         "every artifact problem degrades to a cold run with a typed "
         "cache-fallback event — docs/service.md § State-space cache)",
     )
+    pserve.add_argument(
+        "--state-cache-dir", metavar="DIR",
+        help="shared state-space cache root (default: <service_dir>/"
+        "state-cache).  Point every host of a fleet at one directory to "
+        "federate the cache: entries are content-addressed and "
+        "self-verifying, so a hit published by another host is "
+        "chain-verified before it is served (docs/service.md § "
+        "Cross-host deployment)",
+    )
     pserve.add_argument("--cpu", action="store_true",
                         help="force the CPU platform")
 
@@ -660,6 +669,18 @@ def main(argv=None):
     pfleet.add_argument("--no-batching", action="store_true")
     pfleet.add_argument("--cache-entries", type=int, default=32)
     pfleet.add_argument("--no-state-cache", action="store_true")
+    pfleet.add_argument(
+        "--state-cache-dir", metavar="DIR",
+        help="shared state-space cache root for every daemon (see "
+        "`serve --state-cache-dir`; point multiple hosts' fleets at one "
+        "directory to federate the cache)",
+    )
+    pfleet.add_argument(
+        "--host-instance", type=int, metavar="I",
+        help="this fleet's host index in a cross-host deployment "
+        "(exported as KSPEC_HOST_INSTANCE to every daemon; scopes "
+        "host-targeted faults like kill@host<i> and skew@host<i>)",
+    )
     pfleet.add_argument("--cpu", action="store_true",
                         help="force the CPU platform in every daemon")
 
@@ -672,6 +693,13 @@ def main(argv=None):
     psub.add_argument("cfg")
     psub.add_argument("--module", help="TLA+ module (default: cfg stem)")
     psub.add_argument("--service-dir", help=svc_help)
+    psub.add_argument(
+        "--router", metavar="DIR",
+        help="submit through a cross-host router directory (`cli route`) "
+        "instead of a single service dir: the router places the job on "
+        "the healthiest live host and enforces the tenant's max_pending "
+        "cap fleet-WIDE",
+    )
     psub.add_argument("--tenant", default="default")
     psub.add_argument("--max-depth", type=int)
     psub.add_argument("--max-states", type=int)
@@ -706,6 +734,11 @@ def main(argv=None):
     )
     pst.add_argument("job_id", nargs="?")
     pst.add_argument("--service-dir", help=svc_help)
+    pst.add_argument(
+        "--router", metavar="DIR",
+        help="resolve the job through a router directory (locates the "
+        "host it was routed to, following reroutes)",
+    )
     pst.add_argument("--json", action="store_true")
 
     pres = sub.add_parser(
@@ -717,11 +750,55 @@ def main(argv=None):
     pres.add_argument("job_id")
     pres.add_argument("--service-dir", help=svc_help)
     pres.add_argument(
+        "--router", metavar="DIR",
+        help="fetch the verdict through a router directory (checks the "
+        "routed host first, then every host — a rerouted job's verdict "
+        "is found wherever it landed)",
+    )
+    pres.add_argument(
         "--wait", action="store_true",
         help="block until the verdict exists",
     )
     pres.add_argument("--timeout", type=float, default=300.0)
     pres.add_argument("--json", action="store_true")
+
+    proute = sub.add_parser(
+        "route",
+        help="run the cross-host router over N per-host service "
+        "directories: health-aware placement (heartbeat freshness, queue "
+        "depth), fleet-wide tenant admission, dead-host detection with "
+        "exactly-once re-routing of pending jobs to survivors — never "
+        "imports jax (docs/service.md § Cross-host deployment)",
+    )
+    proute.add_argument(
+        "router_dir",
+        help="router state directory (created on first run; holds "
+        "router.json, route records, and the router event log)",
+    )
+    proute.add_argument(
+        "--hosts", nargs="+", metavar="DIR",
+        help="per-host service directories to front (required on first "
+        "run; persisted in router.json and optional afterwards)",
+    )
+    proute.add_argument(
+        "--dead-after", type=float, default=None,
+        help="seconds without a daemon heartbeat before a host is "
+        "declared dead and its pending jobs re-route (default 30; the "
+        "comparison tolerates KSPEC_CLOCK_SKEW)",
+    )
+    proute.add_argument(
+        "--poll", type=float, default=1.0,
+        help="sweep interval seconds (default 1.0)",
+    )
+    proute.add_argument(
+        "--once", action="store_true",
+        help="run a single sweep (takeover + re-route pass) and exit",
+    )
+    proute.add_argument(
+        "--status", action="store_true",
+        help="print per-host health and queue depths, run no sweep",
+    )
+    proute.add_argument("--json", action="store_true")
 
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
     po.add_argument("cfg")
@@ -860,6 +937,17 @@ def main(argv=None):
         )
 
         run_dir = args.run_dir
+        if run_dir is not None and os.path.isfile(
+            os.path.join(run_dir, "router.json")
+        ):
+            # a router directory: render the cross-host rollup instead
+            # of a (nonexistent) single-run report
+            from ..obs.report import render_router_report, router_report_data
+
+            data = router_report_data(run_dir)
+            print(json.dumps(data) if args.json
+                  else render_router_report(data))
+            return 0
         if run_dir is None:
             root = args.root or os.environ.get("KSPEC_RUNS_ROOT", "runs")
             if args.latest:
@@ -880,6 +968,11 @@ def main(argv=None):
         else:
             print(render_report(run_dir))
         return 0
+
+    if args.cmd == "route":
+        # the router is operator infrastructure for a degraded fleet:
+        # jax-free by contract, like the clients it fronts
+        return _run_router(args)
 
     if args.cmd in ("submit", "status", "result"):
         # the tenant side of the service: MUST stay jax-free — clients
@@ -921,6 +1014,8 @@ def main(argv=None):
                 scale_up_pending=args.scale_up_pending,
                 scale_down_idle_s=args.scale_down_idle,
                 serve_args=tuple(serve_args),
+                state_cache_dir=args.state_cache_dir,
+                host_instance=args.host_instance,
             )
         )
 
@@ -980,6 +1075,7 @@ def main(argv=None):
                 cache_entries=args.cache_entries,
                 batching=not args.no_batching,
                 state_cache=not args.no_state_cache,
+                state_cache_dir=args.state_cache_dir,
             )
         )
 
@@ -1418,22 +1514,107 @@ def _service_dir(given) -> str:
     return given or os.environ.get("KSPEC_SERVICE_DIR", "service")
 
 
+def _run_router(args) -> int:
+    """`cli route`: cross-host placement + dead-host recovery.  Jax-free
+    by contract (it runs on the operator box, often while a host is
+    down — the worst possible moment for a cold start)."""
+    from ..service.router import Router
+
+    try:
+        router = Router(
+            args.router_dir,
+            hosts=args.hosts,
+            dead_after_s=args.dead_after,
+        )
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.status:
+        ov = router.overview()
+        if args.json:
+            print(json.dumps(ov))
+        else:
+            print(
+                f"router {ov['dir']}: {len(ov['hosts'])} hosts, "
+                f"{ov['routes']} routed jobs, dead after "
+                f"{ov['dead_after_s']}s (+{ov['clock_skew_s']}s skew)"
+            )
+            for h in ov["hosts"]:
+                age = h["hb_age_s"]
+                age_s = "never" if age is None else f"{age:.1f}s ago"
+                print(
+                    f"  host{h['host']} [{h['state']:>6}] {h['dir']}: "
+                    f"{h['pending']} pending, {h['claimed']} in flight, "
+                    f"heartbeat {age_s}"
+                )
+        return 0
+
+    if args.once:
+        out = router.sweep()
+        if args.json:
+            print(json.dumps(out))
+        else:
+            dead = [h["host"] for h in out["hosts"]
+                    if h["state"] == "dead"]
+            took = sum(len(v) for v in out["takeover"].values())
+            moved = sum(len(v) for v in out["rerouted"].values())
+            print(
+                f"sweep: {len(dead)} dead hosts"
+                + (f" ({', '.join(f'host{i}' for i in dead)})"
+                   if dead else "")
+                + f", {took} claims taken over, "
+                f"{moved} pending jobs re-routed"
+            )
+        return 0
+
+    print(
+        f"router serving {len(router.hosts)} hosts from {router.dir} "
+        f"(poll {args.poll}s)",
+        file=sys.stderr,
+    )
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: router.request_stop())
+    try:
+        router.serve(poll_s=args.poll)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def _run_service_client(args) -> int:
     """submit / status / result: the tenants' side of the service.  Only
     jax-free imports allowed here — the zero-cold-start contract."""
     from ..service.queue import JobQueue
     from ..service.verdict import render_verdict, verdict_exit_code
 
-    try:
-        # submit creates the tree (tenants may enqueue before the first
-        # daemon start); status/result are read-only so a mistyped
-        # --service-dir errors instead of minting an empty service tree
-        q = JobQueue(
-            _service_dir(args.service_dir), create=args.cmd == "submit"
-        )
-    except FileNotFoundError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 2
+    router = None
+    if getattr(args, "router", None):
+        # --router: resolve through the cross-host router instead of a
+        # single service dir (still jax-free — router.py never imports
+        # jax).  Placement and the fleet-WIDE tenant admission check
+        # live inside Router.submit
+        from ..service.router import Router
+
+        try:
+            router = Router(args.router)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        q = None
+    else:
+        try:
+            # submit creates the tree (tenants may enqueue before the
+            # first daemon start); status/result are read-only so a
+            # mistyped --service-dir errors instead of minting an empty
+            # service tree
+            q = JobQueue(
+                _service_dir(args.service_dir), create=args.cmd == "submit"
+            )
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     if args.cmd == "submit":
         from pathlib import Path
@@ -1461,30 +1642,35 @@ def _run_service_client(args) -> int:
             except ValueError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
-        # admission control: the tenant's max_pending cap (advisory —
-        # the check is client-side so a racing burst can overshoot; the
-        # budget that matters, the resource governor, is daemon-side)
-        from ..resilience.resources import (
-            budget_for_tenant,
-            load_tenant_budgets,
-        )
+        if router is None:
+            # admission control: the tenant's max_pending cap (advisory
+            # — the check is client-side so a racing burst can
+            # overshoot; the budget that matters, the resource
+            # governor, is daemon-side).  With --router the check moves
+            # inside Router.submit, where it is fleet-wide
+            from ..resilience.resources import (
+                budget_for_tenant,
+                load_tenant_budgets,
+            )
 
-        try:
-            budgets = load_tenant_budgets(q.tenants_path)
-        except (OSError, ValueError) as e:
-            print(f"error: bad tenants.json: {e}", file=sys.stderr)
-            return 2
-        b = budget_for_tenant(budgets, args.tenant)
-        if b is not None and b.max_pending is not None:
-            mine = q.pending_for_tenant(args.tenant, stop_at=b.max_pending)
-            if mine >= b.max_pending:
-                print(
-                    f"error: tenant {args.tenant!r} at max_pending="
-                    f"{b.max_pending} ({mine} queued) — drain or raise "
-                    f"the cap in tenants.json",
-                    file=sys.stderr,
-                )
+            try:
+                budgets = load_tenant_budgets(q.tenants_path)
+            except (OSError, ValueError) as e:
+                print(f"error: bad tenants.json: {e}", file=sys.stderr)
                 return 2
+            b = budget_for_tenant(budgets, args.tenant)
+            if b is not None and b.max_pending is not None:
+                mine = q.pending_for_tenant(
+                    args.tenant, stop_at=b.max_pending
+                )
+                if mine >= b.max_pending:
+                    print(
+                        f"error: tenant {args.tenant!r} at max_pending="
+                        f"{b.max_pending} ({mine} queued) — drain or raise "
+                        f"the cap in tenants.json",
+                        file=sys.stderr,
+                    )
+                    return 2
         kernel_source = (
             "emitted" if args.emitted else "hand" if args.hand else "auto"
         )
@@ -1493,7 +1679,7 @@ def _run_service_client(args) -> int:
             # (EAGAIN/EIO/ESTALE — network filesystems) with bounded
             # backoff inside JobQueue.submit; only a PERSISTENT failure
             # reaches here, rendered cleanly instead of as a traceback
-            spec = q.submit(
+            spec = (router or q).submit(
                 cfg_text,
                 module,
                 tenant=args.tenant,
@@ -1504,27 +1690,46 @@ def _run_service_client(args) -> int:
                 fault=args.fault,
             )
         except OSError as e:
+            where = router.dir if router is not None else q.dir
             print(
-                f"error: cannot publish job to {q.dir!r} after retries: "
+                f"error: cannot publish job to {where!r} after retries: "
                 f"{e}",
                 file=sys.stderr,
             )
             return 2
+        except RuntimeError as e:
+            # AdmissionDenied: the router's fleet-wide tenant cap
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        where = (
+            f"host{spec['host']} ({router.hosts[spec['host']]})"
+            if router is not None
+            else q.dir
+        )
         if args.json and not args.wait:
-            print(json.dumps({"job_id": spec["job_id"],
-                              "service_dir": q.dir}))
+            out = {"job_id": spec["job_id"]}
+            if router is not None:
+                out["host"] = spec["host"]
+                out["service_dir"] = router.hosts[spec["host"]]
+            else:
+                out["service_dir"] = q.dir
+            print(json.dumps(out))
         else:
             print(f"submitted {spec['job_id']} (tenant {args.tenant}) -> "
-                  f"{q.dir}", file=sys.stderr)
+                  f"{where}", file=sys.stderr)
         if not args.wait:
             if not args.json:
                 print(spec["job_id"])
             return 0
-        rec = q.wait_result(spec["job_id"], timeout=args.timeout)
+        rec = (router or q).wait_result(spec["job_id"], timeout=args.timeout)
         if rec is None:
+            hint = (
+                f"`cli route {router.dir} --status`" if router is not None
+                else f"`cli serve {q.dir}`"
+            )
             print(
                 f"error: no verdict for {spec['job_id']} within "
-                f"{args.timeout}s (is the daemon up?  `cli serve {q.dir}`)",
+                f"{args.timeout}s (is the daemon up?  {hint})",
                 file=sys.stderr,
             )
             return 2
@@ -1533,9 +1738,19 @@ def _run_service_client(args) -> int:
 
     if args.cmd == "status":
         if args.job_id is None:
-            ov = q.overview()
+            ov = (router or q).overview()
             if args.json:
                 print(json.dumps(ov))
+            elif router is not None:
+                print(
+                    f"router {ov['dir']}: {len(ov['hosts'])} hosts, "
+                    f"{ov['routes']} routed jobs"
+                )
+                for h in ov["hosts"]:
+                    print(
+                        f"  host{h['host']} [{h['state']:>6}] {h['dir']}: "
+                        f"{h['pending']} pending, {h['claimed']} in flight"
+                    )
             else:
                 c = ov["counts"]
                 print(
@@ -1546,11 +1761,13 @@ def _run_service_client(args) -> int:
                     rec = q.result(jid) or {}
                     print(f"  {jid}  {rec.get('status', '?')}")
             return 0
-        st = q.status(args.job_id)
+        st = (router or q).status(args.job_id)
         if args.json:
             print(json.dumps(st))
         else:
             line = f"{st['job_id']}: {st['state']}"
+            if st.get("host") is not None:
+                line += f" @ host{st['host']}"
             rec = st.get("result")
             if rec:
                 line += f" ({rec.get('status', '?')})"
@@ -1559,9 +1776,9 @@ def _run_service_client(args) -> int:
 
     # result
     rec = (
-        q.wait_result(args.job_id, timeout=args.timeout)
+        (router or q).wait_result(args.job_id, timeout=args.timeout)
         if args.wait
-        else q.result(args.job_id)
+        else (router or q).result(args.job_id)
     )
     if rec is None:
         print(

@@ -348,7 +348,8 @@ def test_state_cache_rejects_corrupt_artifact(tmp_path):
                         event=lambda k, **f: events.append((k, f)))
     key, _ = _toy_entry(c)
     d = c._entry_dir(key)
-    corrupt_file(os.path.join(d, "visited.run"), 8)
+    art = json.load(open(os.path.join(d, "entry.json")))["artifact"]
+    corrupt_file(os.path.join(d, art["visited"]["name"]), 8)
     assert c.lookup(key) is None
     fb = [f for k, f in events if k == "cache-fallback"]
     assert fb and "artifact-corrupt" in fb[0]["reason"]
@@ -359,7 +360,9 @@ def test_state_cache_rejects_corrupt_artifact(tmp_path):
                                          events.append((k, f))))
     c2 = StateSpaceCache(str(tmp_path / "sc2"),
                          event=lambda k, **f: events.append((k, f)))
-    corrupt_file(os.path.join(c2._entry_dir(key2), "boundary.npy"), 4)
+    d2 = c2._entry_dir(key2)
+    art2 = json.load(open(os.path.join(d2, "entry.json")))["artifact"]
+    corrupt_file(os.path.join(d2, art2["boundary"]["name"]), 4)
     assert c2.lookup(key2) is None
     assert any("artifact-corrupt" in f["reason"]
                for k, f in events if k == "cache-fallback")
@@ -595,7 +598,7 @@ def test_daemon_corrupted_artifact_falls_back_to_bit_identical_cold(
         os.path.join(dp, f)
         for dp, _dn, fs in os.walk(base)
         for f in fs
-        if f == "visited.run"
+        if f.startswith("visited-") and f.endswith(".run")
     ]
     assert runs
     corrupt_file(runs[0], 8)

@@ -235,7 +235,7 @@ def test_fault_registry_enumerates_every_kind():
     kinds = {e["kind"] for e in entries}
     assert kinds == {
         "crash", "corrupt_ckpt", "compile_oom", "transient_device_err",
-        "enospc", "stall", "flip",
+        "enospc", "stall", "flip", "kill", "partition", "skew",
     }
     flip = next(e for e in entries if e["kind"] == "flip")
     assert set(flip["sites"]) == {

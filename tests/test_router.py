@@ -1,0 +1,807 @@
+"""Cross-host routed fleet (service/router.py; docs/service.md
+§ Cross-host deployment).
+
+Fast tier (`router` marker).  Units cover the host-fault grammar
+(kill@host / partition@host / skew@host), the KSPEC_CLOCK_SKEW lease
+allowance, the full-jitter retry envelope, federated state-cache
+concurrent-publish races + GC, and the router itself (health taxonomy,
+placement, fleet-wide admission, exactly-once dead-host re-routing).
+The acceptance e2e runs two in-process "hosts" over one shared cache
+namespace under kill@host0:1 + partition@host1 + flip@cache:1 — every
+job completes exactly once, verdicts bit-identical to solo cold
+answers, including a cross-host chain-verified cache hit served after
+the publishing host is dead.  (Real-subprocess host death is covered by
+test_fleet's chaos e2e; this one drills the CROSS-host protocol.)
+"""
+
+import errno
+import json
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kafka_specification_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedCrash,
+    injected_skew_s,
+)
+from kafka_specification_tpu.service.daemon import Daemon, ServeConfig
+from kafka_specification_tpu.service.queue import (
+    JobQueue,
+    RETRY_CAP_S,
+    clock_skew_s,
+    retry_transient,
+)
+from kafka_specification_tpu.service.router import (
+    AdmissionDenied,
+    Router,
+    classify_host,
+)
+from kafka_specification_tpu.service.state_cache import (
+    CacheHit,
+    CacheKey,
+    StateSpaceCache,
+)
+from kafka_specification_tpu.utils.cli import main as cli_main
+
+pytestmark = pytest.mark.router
+
+ID_CFG = """
+SPECIFICATION Spec
+CONSTANTS
+    MaxId = 6
+INVARIANTS TypeOk
+CHECK_DEADLOCK FALSE
+"""
+
+TTW_CFG = """
+SPECIFICATION Spec
+CONSTANTS
+    Replicas = {b1, b2}
+    LogSize = 2
+    MaxRecords = 1
+    MaxLeaderEpoch = 1
+INVARIANTS TypeOk
+CHECK_DEADLOCK FALSE
+"""
+
+
+def _events(svc, path="service/events.jsonl"):
+    try:
+        with open(os.path.join(str(svc), path)) as fh:
+            return [json.loads(line) for line in fh]
+    except OSError:
+        return []
+
+
+def _hb(host_dir, t=None):
+    """Stamp one live heartbeat into a host's service dir (what a
+    serving daemon does every poll)."""
+    svc = os.path.join(str(host_dir), "service")
+    os.makedirs(svc, exist_ok=True)
+    with open(os.path.join(svc, "heartbeat.jsonl"), "a") as fh:
+        fh.write(json.dumps(
+            {"kind": "service-heartbeat",
+             "unix": round(time.time() if t is None else t, 3)}
+        ) + "\n")
+
+
+def _wait(pred, timeout=20.0, poll=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+# --- host fault grammar ---------------------------------------------------
+
+
+def test_host_fault_grammar_parses_and_scopes():
+    p = FaultPlan("kill@host0:2,partition@host1:3,skew@host0:-2.5")
+    p.set_host(0)
+    # skew targets host 0; partition targets host 1 — inert here
+    assert p.skew_s() == -2.5
+    assert p.host_partition() == 0
+    # kill fires on job ordinal 2, not 1, and consumes its budget
+    p.host_kill(1, 1)
+    with pytest.raises(InjectedCrash):
+        p.host_kill(2, 2)
+    p.host_kill(2, 2)  # budget spent: a restarted host converges
+
+    p1 = FaultPlan("kill@host0:2,partition@host1:3,skew@host0:-2.5")
+    p1.set_host(1)
+    assert p1.skew_s() == 0.0
+    assert p1.host_partition() == 3  # once...
+    assert p1.host_partition() == 0  # ...then 0
+    p1.host_kill(1, 10)  # kill targets host 0: silent here
+
+    # without set_host (a non-fleet process) every host fault is inert
+    p2 = FaultPlan("kill@host0:1,partition@host0,skew@host0:4")
+    assert p2.skew_s() == 0.0
+    assert p2.host_partition() == 0
+    p2.host_kill(1, 100)
+
+
+def test_host_fault_typos_rejected_loudly():
+    for bad in ("kill@host0", "kill@host:1", "kill@hostx:1",
+                "partition@host0:0", "skew@host0", "skew@host0:abc",
+                "kill@host0:0"):
+        with pytest.raises(ValueError):
+            FaultPlan(bad)
+
+
+def test_faults_registry_lists_host_sites(capsys):
+    assert cli_main(["faults", "--json"]) == 0
+    entries = json.loads(capsys.readouterr().out)
+    grammars = {e["grammar"] for e in entries}
+    assert "kill@host<i>:N" in grammars
+    assert "partition@host<i>[:N]" in grammars
+    assert "skew@host<i>:SECS" in grammars
+
+
+def test_injected_skew_module_helper(monkeypatch):
+    monkeypatch.setenv("KSPEC_FAULT", "skew@host0:-3,skew@host1:7")
+    monkeypatch.setenv("KSPEC_HOST_INSTANCE", "0")
+    assert injected_skew_s() == -3.0
+    monkeypatch.setenv("KSPEC_HOST_INSTANCE", "1")
+    assert injected_skew_s() == 7.0
+    # no host identity / no plan -> no shift
+    monkeypatch.delenv("KSPEC_HOST_INSTANCE")
+    assert injected_skew_s() == 0.0
+    monkeypatch.setenv("KSPEC_HOST_INSTANCE", "0")
+    monkeypatch.delenv("KSPEC_FAULT")
+    assert injected_skew_s() == 0.0
+
+
+# --- clock skew allowance (satellite 1) -----------------------------------
+
+
+def test_clock_skew_env_default_override_clamp(monkeypatch):
+    monkeypatch.delenv("KSPEC_CLOCK_SKEW", raising=False)
+    assert clock_skew_s() == 5.0
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "2.5")
+    assert clock_skew_s() == 2.5
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "-4")  # clamped: never narrows
+    assert clock_skew_s() == 0.0
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "bogus")
+    assert clock_skew_s() == 5.0
+
+
+def test_skew_fault_shifts_lease_stamp(tmp_path, monkeypatch):
+    monkeypatch.setenv("KSPEC_FAULT", "skew@host0:-3")
+    monkeypatch.setenv("KSPEC_HOST_INSTANCE", "0")
+    q = JobQueue(str(tmp_path / "svc"))
+    jid = q.submit(ID_CFG, "IdSequence", kernel_source="hand")["job_id"]
+    q.claim_pending()
+    lease = q.read_lease(jid)
+    assert lease is not None
+    # the lease stamp reads ~3s behind this process's wall clock
+    assert 2.0 < time.time() - lease["lease_unix"] < 4.0
+
+
+def test_skewed_but_live_claim_never_stolen(tmp_path, monkeypatch):
+    """THE skew regression: a live claimer whose clock runs a few
+    seconds behind writes lease stamps that LOOK expired to a sibling
+    with an aggressive TTL.  The KSPEC_CLOCK_SKEW allowance in lease
+    expiry is what keeps its claim un-stolen — drop the allowance and
+    the same lease is (wrongly) requeued."""
+    q = JobQueue(str(tmp_path / "svc"))
+    jid = q.submit(ID_CFG, "IdSequence", kernel_source="hand")["job_id"]
+    q.claim_pending()
+    # a live foreign claimer (pid 1 never dies) 3s behind our clock
+    with open(q._lease_path(jid), "w") as fh:
+        json.dump({"pid": 1, "token": "foreign-host",
+                   "lease_unix": round(time.time() - 3.0, 3)}, fh)
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "5")
+    sibling = JobQueue(str(tmp_path / "svc"))
+    assert sibling.requeue_orphans(lease_ttl=1.0) == []
+    assert q.status(jid)["state"] == "claimed"
+    # same lease, allowance off: the apparent age now exceeds the TTL
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "0")
+    assert sibling.requeue_orphans(lease_ttl=1.0) == [jid]
+    assert q.status(jid)["state"] == "pending"
+
+
+def test_router_tolerates_skewed_heartbeats(tmp_path, monkeypatch):
+    """A host whose heartbeat stamps run AHEAD or behind by less than
+    the allowance still reads as alive; beyond dead_after + allowance it
+    is dead."""
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "5")
+    h0 = tmp_path / "h0"
+    JobQueue(str(h0))
+    r = Router(str(tmp_path / "rt"), hosts=[str(h0)], dead_after_s=2.0)
+    _hb(h0, t=time.time() - 6.0)  # 6s stale < 2 + 5 allowance
+    assert r.host_health(0)["state"] == "ok"
+    _hb(h0, t=time.time() + 4.0)  # a fast clock is just as alive
+    assert r.host_health(0)["state"] == "ok"
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "0")
+    r2 = Router(str(tmp_path / "rt2"), hosts=[str(h0)], dead_after_s=2.0)
+    # newest stamp is the +4s one: still fresh even without allowance
+    assert r2.host_health(0)["state"] == "ok"
+
+
+# --- full-jitter retry backoff (satellite 2) ------------------------------
+
+
+def test_retry_full_jitter_envelope(monkeypatch):
+    """The backoff is full jitter: every sleep ~ U[0, min(cap, base*2^i)]
+    — deterministic under a seeded rng, never above the envelope, and
+    actually jittered (not the old fixed ladder)."""
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+
+    def always():
+        raise OSError(errno.EAGAIN, "again")
+
+    base, attempts = 0.05, 6
+    with pytest.raises(OSError):
+        retry_transient(always, attempts=attempts, base=base,
+                        rng=random.Random(42))
+    assert len(sleeps) == attempts - 1
+    for i, s in enumerate(sleeps):
+        assert 0.0 <= s <= min(RETRY_CAP_S, base * (2.0 ** i)), (i, s)
+    # same seed -> same schedule (tests can pin retry timing exactly)
+    replay = []
+    monkeypatch.setattr(time, "sleep", lambda s: replay.append(s))
+    with pytest.raises(OSError):
+        retry_transient(always, attempts=attempts, base=base,
+                        rng=random.Random(42))
+    assert replay == sleeps
+    # different seed -> different schedule: the jitter is real
+    other = []
+    monkeypatch.setattr(time, "sleep", lambda s: other.append(s))
+    with pytest.raises(OSError):
+        retry_transient(always, attempts=attempts, base=base,
+                        rng=random.Random(7))
+    assert other != sleeps
+
+
+# --- federated state cache: concurrent same-key publishes (satellite 3) ---
+
+
+def _entry_key(max_depth=2):
+    return CacheKey("M", False, (("MaxId", 6),), ("TypeOk",), (), False,
+                    max_depth=max_depth)
+
+
+def _publish_toy(cache, key, seed, n_levels=3):
+    rng = np.random.RandomState(seed)
+    counts = [1, 3, 5][:n_levels]
+    rows = [rng.randint(0, 50, size=(n, 2)).astype(np.uint32)
+            for n in counts]
+    verdict = {"model": "M", "distinct_states": sum(counts),
+               "diameter": n_levels - 1, "levels": counts,
+               "violation": None, "exit_code": 0,
+               "states_per_sec": 1.0, "seconds": 0.1}
+    assert cache.publish(key, verdict, exact64=True, lanes=2,
+                         level_rows=rows, diameter=n_levels - 1)
+    return verdict
+
+
+def test_concurrent_same_key_publish_last_promote_wins(tmp_path):
+    """Two publishers (two hosts of a federation) race the same key:
+    whichever entry.json promote lands last wins, the surviving entry
+    chain-verifies, and the loser's nonce-named artifacts are invisible
+    to readers and collected by GC."""
+    events = []
+    c = StateSpaceCache(str(tmp_path / "sc"),
+                        event=lambda k, **f: events.append((k, f)))
+    key = _entry_key()
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def publisher(seed):
+        try:
+            barrier.wait(timeout=10)
+            _publish_toy(c, key, seed)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=publisher, args=(s,)) for s in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs
+    # the surviving entry chain-verifies end to end
+    hit = c.lookup(key)
+    assert isinstance(hit, CacheHit)
+    assert hit.verdict["distinct_states"] == 9
+    # exactly one entry's artifacts referenced; GC (grace 0: the race is
+    # over) removes the loser's files, never the winner's
+    d = c._entry_dir(key)
+    art = json.load(open(os.path.join(d, "entry.json")))["artifact"]
+    referenced = {art["visited"]["name"], art["boundary"]["name"]}
+    collected = set(c.collect_garbage(key, grace_s=0.0))
+    assert not (collected & referenced)
+    left = {f for f in os.listdir(d)
+            if f.endswith((".run", ".npy"))}
+    assert left == referenced
+    # the winner still verifies after the sweep
+    assert isinstance(c.lookup(key), CacheHit)
+
+
+def test_reader_mid_race_verified_hit_or_typed_fallback(tmp_path):
+    """A reader racing publishers gets a chain-verified hit or a typed
+    miss/fallback — never a torn artifact surfaced as an answer."""
+    events = []
+    c = StateSpaceCache(str(tmp_path / "sc"),
+                        event=lambda k, **f: events.append((k, f)))
+    key = _entry_key()
+    stop = threading.Event()
+    errs = []
+
+    def hammer(seed):
+        try:
+            while not stop.is_set():
+                _publish_toy(c, key, seed)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    writers = [threading.Thread(target=hammer, args=(s,))
+               for s in (0, 1)]
+    for t in writers:
+        t.start()
+    try:
+        verified = 0
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            out = c.lookup(key)  # must never raise or return garbage
+            if isinstance(out, CacheHit):
+                assert out.verdict["distinct_states"] == 9
+                verified += 1
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=30)
+    assert not errs
+    assert verified  # the race window actually served verified hits
+
+
+def test_gc_grace_protects_concurrent_publishers(tmp_path):
+    c = StateSpaceCache(str(tmp_path / "sc"))
+    key = _entry_key()
+    _publish_toy(c, key, 0)
+    d = c._entry_dir(key)
+    # a concurrent publisher's half-written artifact, seconds old
+    in_flight = os.path.join(d, "visited-dead-beef.run")
+    with open(in_flight, "wb") as fh:
+        fh.write(b"partial")
+    assert c.collect_garbage(key, grace_s=120.0) == []
+    assert os.path.exists(in_flight)
+    # past the grace it is garbage (its publisher died mid-flight)
+    old = time.time() - 600
+    os.utime(in_flight, (old, old))
+    assert c.collect_garbage(key, grace_s=120.0) == [
+        "visited-dead-beef.run"
+    ]
+    assert not os.path.exists(in_flight)
+
+
+# --- the router: health, placement, admission, re-route -------------------
+
+
+def test_classify_host_table():
+    assert classify_host(False, False) == "unseen"
+    assert classify_host(True, True) == "ok"
+    assert classify_host(True, False) == "dead"
+
+
+def _two_hosts(tmp_path, dead_after=2.0):
+    h0, h1 = str(tmp_path / "h0"), str(tmp_path / "h1")
+    JobQueue(h0)
+    JobQueue(h1)
+    r = Router(str(tmp_path / "rt"), hosts=[h0, h1],
+               dead_after_s=dead_after)
+    return r, h0, h1
+
+
+def test_router_persists_and_rejects_non_router_dir(tmp_path):
+    r, h0, h1 = _two_hosts(tmp_path)
+    # reopen without hosts: the persisted config carries them
+    r2 = Router(r.dir)
+    assert r2.hosts == [h0, h1]
+    assert r2.dead_after_s == 2.0
+    with pytest.raises(FileNotFoundError):
+        Router(str(tmp_path / "h0"))  # a service dir is not a router
+
+
+def test_placement_prefers_live_then_least_loaded(tmp_path):
+    r, h0, h1 = _two_hosts(tmp_path)
+    _hb(h1)  # only host 1 has ever heartbeat
+    s = r.submit(ID_CFG, "IdSequence", tenant="t",
+                 kernel_source="hand")
+    assert s["host"] == 1
+    _hb(h0)
+    # both alive now, host 0 shallower — but the SAME module sticks to
+    # its affinity host (the daemons batch same-shape pending jobs into
+    # one engine group; co-location is what makes that group large)
+    s2 = r.submit(ID_CFG, "IdSequence", tenant="t",
+                  kernel_source="hand")
+    assert s2["host"] == 1
+    # a DIFFERENT module has no affinity yet: least-loaded wins
+    s3 = r.submit(TTW_CFG, "KafkaTruncateToHighWatermark", tenant="t",
+                  kernel_source="hand")
+    assert s3["host"] == 0
+    # route records written for all
+    assert r.read_route(s["job_id"])["host"] == 1
+    assert r.read_route(s2["job_id"])["history"][0]["why"] == "submit"
+
+
+def test_placement_affinity_releases_on_lag_and_death(tmp_path):
+    from kafka_specification_tpu.service.router import AFFINITY_SLACK_JOBS
+
+    r, h0, h1 = _two_hosts(tmp_path)
+    _hb(h0)
+    _hb(h1)
+    assert r.submit(ID_CFG, "IdSequence", tenant="t",
+                    kernel_source="hand")["host"] == 0
+    # push the affinity host past the slack: the module re-sticks to
+    # the least-loaded host instead of deepening the imbalance
+    r._affinity["IdSequence"] = 0
+    healths = [
+        {"host": 0, "state": "ok", "pending": AFFINITY_SLACK_JOBS + 2,
+         "claimed": 0},
+        {"host": 1, "state": "ok", "pending": 1, "claimed": 0},
+    ]
+    assert r._choose_host(healths, module="IdSequence") == 1
+    assert r._affinity["IdSequence"] == 1
+    # an affinity host that leaves the routable pool releases too
+    healths = [
+        {"host": 0, "state": "ok", "pending": 0, "claimed": 0},
+        {"host": 1, "state": "dead", "pending": 0, "claimed": 0},
+    ]
+    assert r._choose_host(healths, module="IdSequence") == 0
+    assert r._affinity["IdSequence"] == 0
+
+
+def test_fleet_wide_admission(tmp_path):
+    r, h0, h1 = _two_hosts(tmp_path)
+    _hb(h0)
+    _hb(h1)
+    with open(r.tenants_path, "w") as fh:
+        json.dump({"capped": {"max_pending": 2}}, fh)
+    # the cap counts pending across BOTH hosts, not per host
+    r.submit(ID_CFG, "IdSequence", tenant="capped", kernel_source="hand")
+    r.submit(ID_CFG, "IdSequence", tenant="capped", kernel_source="hand")
+    with pytest.raises(AdmissionDenied):
+        r.submit(ID_CFG, "IdSequence", tenant="capped",
+                 kernel_source="hand")
+    # other tenants unaffected
+    r.submit(ID_CFG, "IdSequence", tenant="other", kernel_source="hand")
+
+
+def test_dead_host_pending_rerouted_exactly_once(tmp_path):
+    r, h0, h1 = _two_hosts(tmp_path)
+    _hb(h0)
+    _hb(h1)
+    jid = r.submit(ID_CFG, "IdSequence", tenant="t",
+                   kernel_source="hand", host=0)["job_id"]
+    # host 0 goes quiet past the threshold; host 1 stays fresh.  The
+    # stale STAMP is what matters: freshness reads the heartbeat's own
+    # `unix` field, never file mtime (mtime would dodge the skew drill)
+    hb = os.path.join(h0, "service", "heartbeat.jsonl")
+    with open(hb, "w") as fh:
+        fh.write(json.dumps({"kind": "service-heartbeat",
+                             "unix": round(time.time() - 60, 3)}) + "\n")
+    _hb(h1)
+    assert r.host_health(0)["state"] == "dead"
+    out = r.sweep()
+    assert out["rerouted"] == {0: [jid]}
+    q0, q1 = JobQueue(h0, create=False), JobQueue(h1, create=False)
+    assert q0.pending_count() == 0
+    assert q1.pending_count() == 1
+    # attribution: the spec carries the hop, the route record the path
+    spec = json.load(open(q1._job_path("pending", jid)))
+    assert spec["reroutes"][0]["from_host"] == 0
+    assert spec["reroutes"][0]["to_host"] == 1
+    assert spec["reroutes"][0]["reason"] == "host-dead"
+    rec = r.read_route(jid)
+    assert rec["host"] == 1
+    assert [h["why"] for h in rec["history"]] == [
+        "submit", "reroute:host-dead"
+    ]
+    # idempotent: a second sweep finds nothing to move
+    assert r.sweep()["rerouted"] == {}
+    # tenant admission markers moved with the job
+    assert q1.pending_for_tenant("t") == 1
+    assert q0.pending_for_tenant("t") == 0
+
+
+def test_reroute_retires_verdict_bearing_pending_in_place(tmp_path):
+    """A pending file whose verdict already published (the takeover
+    protocol's exactly-once edge) is retired to done/ on the dead host,
+    never re-routed into a duplicate run."""
+    r, h0, h1 = _two_hosts(tmp_path)
+    q0 = JobQueue(h0, create=False)
+    jid = r.submit(ID_CFG, "IdSequence", tenant="t",
+                   kernel_source="hand", host=0)["job_id"]
+    os.makedirs(os.path.dirname(q0.result_path(jid)), exist_ok=True)
+    with open(q0.result_path(jid), "w") as fh:
+        json.dump({"schema": "kspec-verdict/1", "job_id": jid,
+                   "status": "complete", "exit_code": 0}, fh)
+    _hb(h1)
+    # unseen hosts are never swept (they may simply not have started
+    # yet): forge a stale heartbeat so host 0 reads dead, not unseen
+    _hb(h0, t=time.time() - 60)
+    out = r.sweep()
+    assert out["rerouted"] == {}
+    assert q0.status(jid)["state"] == "done"
+    assert JobQueue(h1, create=False).pending_count() == 0
+
+
+def test_adopt_stale_reroutes(tmp_path):
+    """A router that dies mid-re-route leaves a private .reroute-<pid>
+    file; the next sweep adopts it — republishing when the copy never
+    landed, retiring when it did (stamped intent decides)."""
+    r, h0, h1 = _two_hosts(tmp_path)
+    q0, q1 = JobQueue(h0, create=False), JobQueue(h1, create=False)
+    jid = r.submit(ID_CFG, "IdSequence", tenant="t",
+                   kernel_source="hand", host=0)["job_id"]
+    src = q0._job_path("pending", jid)
+    spec = json.load(open(src))
+    spec["reroutes"] = [{"from_host": 0, "to_host": 1,
+                         "by_pid": 999999999, "reason": "host-dead",
+                         "at": time.time()}]
+    private = src + ".reroute-999999999"  # a dead router's pid
+    with open(private, "w") as fh:
+        json.dump(spec, fh)
+    os.unlink(src)
+    # case 1: the copy never landed -> adopted back to pending on host 0
+    _hb(h0)
+    _hb(h1)
+    r.sweep()
+    assert q0.status(jid)["state"] == "pending"
+    # case 2: the copy DID land on the target -> the private file is
+    # retired, no duplicate pending left behind
+    os.rename(src, private)
+    with open(q1._job_path("pending", jid), "w") as fh:
+        json.dump(spec, fh)
+    r.sweep()
+    assert not os.path.exists(private)
+    assert q0.status(jid)["state"] != "pending"
+    assert q1.status(jid)["state"] == "pending"
+
+
+def test_router_cli_surface(tmp_path, capsys, monkeypatch):
+    """`cli route` + `submit/status/result --router` stay jax-free and
+    speak the same records as the library (the tenant contract)."""
+    monkeypatch.chdir(tmp_path)
+    h0, h1 = str(tmp_path / "h0"), str(tmp_path / "h1")
+    JobQueue(h0)
+    JobQueue(h1)
+    assert cli_main(["route", "rt", "--hosts", h0, h1,
+                     "--dead-after", "2", "--status"]) == 0
+    assert "2 hosts" in capsys.readouterr().out
+    cfg = tmp_path / "id.cfg"
+    cfg.write_text(ID_CFG)
+    _hb(h0)
+    _hb(h1)
+    assert cli_main(["submit", str(cfg), "--module", "IdSequence",
+                     "--router", "rt", "--json"]) == 0
+    sub = json.loads(capsys.readouterr().out)
+    assert sub["service_dir"] in (h0, h1)
+    assert cli_main(["status", sub["job_id"], "--router", "rt",
+                     "--json"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["state"] == "pending" and st["host"] == sub["host"]
+    # a sweep pass via the CLI
+    assert cli_main(["route", "rt", "--once"]) == 0
+    assert "0 claims taken over" in capsys.readouterr().out
+    # fleet-wide admission denial exits 2 like the single-dir client
+    with open(os.path.join("rt", "tenants.json"), "w") as fh:
+        json.dump({"default": {"max_pending": 1}}, fh)
+    assert cli_main(["submit", str(cfg), "--module", "IdSequence",
+                     "--router", "rt"]) == 2
+    assert "max_pending" in capsys.readouterr().err
+    # verdict resolution: finish the job on its host, read via router
+    q = JobQueue(sub["service_dir"], create=False)
+    q.claim_pending()
+    q.finish(sub["job_id"], {"schema": "kspec-verdict/1",
+                             "job_id": sub["job_id"],
+                             "status": "complete", "exit_code": 0})
+    assert cli_main(["result", sub["job_id"], "--router", "rt",
+                     "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["status"] == "complete"
+    # `cli report <router_dir>` renders the cross-host rollup
+    assert cli_main(["report", "rt"]) == 0
+    assert "Router rt" in capsys.readouterr().out
+
+
+# --- partition fault through the daemon (in-process) ----------------------
+
+
+def test_partition_fault_degrades_defers_then_heals(tmp_path, monkeypatch):
+    """partition@host0:1 on a serving daemon: the in-window job's cache
+    consult degrades to a typed cold run, its publish is deferred, and
+    the heal re-publishes — after which the entry serves hits."""
+    monkeypatch.setenv("KSPEC_FAULT", "partition@host0:1")
+    monkeypatch.setenv("KSPEC_HOST_INSTANCE", "0")
+    svc = tmp_path / "svc"
+    q = JobQueue(str(svc))
+    d = Daemon(ServeConfig(service_dir=str(svc), linger_s=0.0,
+                           min_bucket=32))
+    j1 = q.submit(ID_CFG, "IdSequence", kernel_source="hand")["job_id"]
+    assert d.drain_once() == 1
+    r1 = q.result(j1)
+    assert r1["status"] == "complete"
+    assert r1["distinct_states"] == 8
+    assert r1.get("cache") is None  # partitioned: cold, not a hit
+    ev = _events(svc)
+    assert any(e.get("event") == "cache-partition-injected"
+               for e in ev)
+    assert any(e.get("event") == "cache-fallback"
+               and e.get("reason") == "partition" for e in ev)
+    assert any(e.get("event") == "cache-publish-deferred" for e in ev)
+    heal = [e for e in ev if e.get("event") == "cache-partition-heal"]
+    assert heal and heal[0]["republished"] == 1
+    # durable marker: a restarted daemon does NOT re-partition
+    assert os.path.exists(os.path.join(
+        str(svc), "service", "faults-fired", "partition-daemon0"
+    ))
+    d2 = Daemon(ServeConfig(service_dir=str(svc), linger_s=0.0,
+                            min_bucket=32))
+    j2 = q.submit(ID_CFG, "IdSequence", kernel_source="hand")["job_id"]
+    assert d2.drain_once() == 1
+    r2 = q.result(j2)
+    # the healed re-publish serves: chain-verified hit, same answer
+    assert r2["cache"]["state_cache"] == "hit"
+    assert r2["distinct_states"] == 8
+
+
+# --- the two-host chaos e2e (acceptance) ----------------------------------
+
+
+def test_cross_host_chaos_e2e(tmp_path, monkeypatch):
+    """Two 'hosts' (separate service dirs + daemons, one shared cache
+    namespace, one router) under kill@host0:1 + partition@host1:1 +
+    flip@cache:1 — one composed plan string drives the whole drill:
+
+    - host 0's daemon is killed mid-job-1; its 'restart' converges
+      (durable fired-marker), the claim returns via lease-expiry
+      takeover, and the verdict publishes exactly once, cold.
+    - that publish is bit-flipped (flip@cache): job 2 on host 0 rejects
+      the corrupt entry with a typed fallback, recomputes cold
+      bit-identically, and re-publishes clean.
+    - host 1's first job lands inside its partition window: typed
+      'partition' fallback, deferred publish, heal re-publish.
+    - host 0 then DIES for good.  A fresh TTW job routes to host 1 and
+      is served as a cross-host chain-verified cache hit of the entry
+      host 0 published — after host 0's publisher is gone.
+    - a job stranded pending on dead host 0 is re-routed to host 1 by
+      the sweep, exactly once, with attribution.
+    """
+    monkeypatch.setenv("KSPEC_CLAIM_LEASE_TTL", "1")
+    monkeypatch.setenv("KSPEC_CLOCK_SKEW", "0.5")
+    monkeypatch.setenv(
+        "KSPEC_FAULT", "kill@host0:1,partition@host1:1,flip@cache:1"
+    )
+    import kafka_specification_tpu.service.state_cache as sc_mod
+    sc_mod._publish_ordinal["n"] = 0  # per-process ordinal: pin for test
+    h0, h1 = str(tmp_path / "h0"), str(tmp_path / "h1")
+    cache_dir = str(tmp_path / "shared-cache")
+    q0, q1 = JobQueue(h0), JobQueue(h1)
+    router = Router(str(tmp_path / "rt"), hosts=[h0, h1],
+                    dead_after_s=2.0)
+
+    def make_daemon(host, svc):
+        monkeypatch.setenv("KSPEC_HOST_INSTANCE", str(host))
+        return Daemon(ServeConfig(service_dir=svc, linger_s=0.0,
+                                  min_bucket=32,
+                                  state_cache_dir=cache_dir))
+
+    # phase 1: job 1 -> host 0; the kill fires before any verdict
+    d0 = make_daemon(0, h0)
+    _hb(h0)
+    _hb(h1)
+    j1 = router.submit(TTW_CFG, "KafkaTruncateToHighWatermark",
+                       kernel_source="hand", host=0)["job_id"]
+    with pytest.raises(InjectedCrash):
+        d0.drain_once()
+    assert q0.result(j1) is None  # died before deriving a verdict
+    assert q0.status(j1)["state"] == "claimed"  # the orphaned claim
+    # the 'restarted' daemon converges (durable kill marker) and its
+    # janitor takes the expired claim over — exactly-once via the
+    # takeover protocol
+    d0b = make_daemon(0, h0)
+    time.sleep(1.6)  # ttl 1s + skew 0.5s: the lease is now expired
+    assert q0.requeue_orphans() == [j1]  # the startup janitor's takeover
+    assert d0b.drain_once() == 1
+    r1 = q0.result(j1)
+    assert r1["status"] == "complete"
+    assert r1["distinct_states"] == 353  # bit-identical to solo cold
+    assert r1["takeover"]["reason"] in ("lease-expired", "dead-pid")
+    assert os.path.exists(os.path.join(
+        h0, "service", "faults-fired", "kill-daemon0"))
+
+    # phase 2: job 2 -> host 0.  flip@cache corrupted d0b's publish of
+    # job 1, so the lookup must reject it and recompute cold.
+    j2 = router.submit(TTW_CFG, "KafkaTruncateToHighWatermark",
+                       kernel_source="hand", host=0)["job_id"]
+    assert d0b.drain_once() == 1
+    r2 = q0.result(j2)
+    assert r2["status"] == "complete"
+    assert r2.get("cache") is None  # corrupt entry -> cold, not a hit
+    for k in ("distinct_states", "diameter", "levels", "violation",
+              "exit_code"):
+        assert r2[k] == r1[k], k
+    assert any(e.get("event") == "cache-fallback"
+               and "artifact-corrupt" in str(e.get("reason"))
+               for e in _events(h0))
+
+    # phase 3: host 1's first job runs inside its partition window
+    d1 = make_daemon(1, h1)
+    jx = router.submit(ID_CFG, "IdSequence", kernel_source="hand",
+                       host=1)["job_id"]
+    assert d1.drain_once() == 1
+    rx = q1.result(jx)
+    assert rx["status"] == "complete"
+    assert rx["distinct_states"] == 8
+    ev1 = _events(h1)
+    assert any(e.get("event") == "cache-fallback"
+               and e.get("reason") == "partition" for e in ev1)
+    assert any(e.get("event") == "cache-partition-heal" for e in ev1)
+
+    # phase 4: host 0 dies for good — heartbeats stop, the router sees
+    # it dead, and a fresh TTW job placed by HEALTH lands on host 1,
+    # served as a cross-host chain-verified hit of host 0's entry
+    # (published by a process that no longer exists).
+    d0 = d0b = None  # the host-0 daemons are gone
+    _hb(h1)
+    assert _wait(lambda: router.host_health(0)["state"] == "dead",
+                 timeout=30, poll=0.25)
+    assert router.host_health(1)["state"] == "ok"
+    j3 = router.submit(TTW_CFG, "KafkaTruncateToHighWatermark",
+                       kernel_source="hand")["job_id"]
+    assert router.read_route(j3)["host"] == 1
+    assert d1.drain_once() == 1
+    r3 = q1.result(j3)
+    assert r3["status"] == "complete"
+    assert r3["cache"]["state_cache"] == "hit"  # THE cross-host hit
+    for k in ("distinct_states", "diameter", "levels", "violation",
+              "exit_code"):
+        assert r3[k] == r1[k], k
+    assert any(e.get("event") == "state-cache-hit"
+               for e in _events(h1))
+
+    # phase 5: a job stranded pending on the dead host re-routes to the
+    # survivor, exactly once, and completes there
+    j4 = router.submit(ID_CFG, "IdSequence", kernel_source="hand",
+                       host=0)["job_id"]
+    out = router.sweep()
+    assert out["rerouted"] == {0: [j4]}
+    assert d1.drain_once() == 1
+    r4 = router.result(j4)
+    assert r4["status"] == "complete"
+    assert r4["distinct_states"] == 8
+    assert [h["why"] for h in router.read_route(j4)["history"]] == [
+        "submit", "reroute:host-dead"
+    ]
+
+    # exactly once, everywhere: both queues drained, one verdict per
+    # job, every verdict bit-identical to the solo cold answer
+    for q in (q0, q1):
+        ov = q.overview()
+        assert ov["counts"]["pending"] == 0
+        assert ov["counts"]["claimed"] == 0
+    assert q0.overview()["counts"]["done"] == 2  # j1, j2
+    assert q1.overview()["counts"]["done"] == 3  # jx, j3, j4
+    for jid, states in ((j1, 353), (j2, 353), (jx, 8), (j3, 353),
+                        (j4, 8)):
+        homes = [q for q in (q0, q1) if q.result(jid) is not None]
+        assert len(homes) == 1, jid
+        rec = homes[0].result(jid)
+        assert rec["status"] == "complete" and rec["exit_code"] == 0
+        assert rec["distinct_states"] == states
+    # and the router can render the aftermath (jax-free rollup)
+    from kafka_specification_tpu.obs.report import router_report_data
+
+    data = router_report_data(router.dir)
+    assert {h["state"] for h in data["hosts"]} == {"dead", "ok"}
+    assert data["events"].get("route-reroute") == 1
