@@ -247,7 +247,14 @@ def eta(levels: list, window: int = 5) -> dict:
             else 1
         )
         if rate:
-            out["eta_seconds"] = round(remaining / rate, 1)
+            # THE shared flat-throughput estimator (sweep/cost.py): the
+            # per-run ETA and the sweep cost model's per-point wall
+            # predictions compute remaining/rate in exactly one place,
+            # so the two prediction paths cannot drift (same rounding,
+            # same None-handling).  Output shape unchanged.
+            from ..sweep.cost import flat_time_estimate
+
+            out["eta_seconds"] = flat_time_estimate(remaining, rate)
     else:
         out["note"] = "frontier not yet decaying; ETA unbounded"
     return out
@@ -729,6 +736,178 @@ def render_router_report(data: dict) -> str:
         f"{t.get('kspec_svc_state_cache_hits_total', 0):.0f} cache hits, "
         f"{t.get('kspec_svc_takeovers_total', 0):.0f} takeovers"
     )
+    return "\n".join(out)
+
+
+def sweep_report_data(sweep_dir: str) -> dict:
+    """The sweep rollup for a sweep directory (``sweep.json``,
+    kspec-sweep/1): coverage, the per-invariant minimal-violating-config
+    frontier, scaling-law curves (states vs axis value), and estimator
+    accuracy.  Jax-free like everything in obs."""
+    from ..sweep.bisect import frontier_from_manifest
+    from ..sweep.portfolio import load_manifest
+
+    man = load_manifest(sweep_dir)
+    points = man.get("points", {})
+    counts = {"done": 0, "skipped": 0, "error": 0, "pending": 0,
+              "submitted": 0, "hit": 0, "seeded": 0, "violations": 0}
+    skipped_rows = []
+    residuals = []
+    ratios = []
+    for row in points.values():
+        st = row.get("status", "pending")
+        counts[st] = counts.get(st, 0) + 1
+        cache = row.get("cache") or {}
+        if cache.get("state_cache") == "hit":
+            counts["hit"] += 1
+        elif cache.get("state_cache") == "seed":
+            counts["seeded"] += 1
+        if (row.get("verdict") or {}).get("violation"):
+            counts["violations"] += 1
+        if st == "skipped":
+            skipped_rows.append({
+                "point_id": row.get("point_id"),
+                "coords": row.get("coords"),
+                "skip": row.get("skip"),
+            })
+        if row.get("residual") is not None:
+            residuals.append(float(row["residual"]))
+            pred = (row.get("predicted") or {}).get("states")
+            act = (row.get("actual") or {}).get("states")
+            if pred and act:
+                ratios.append(act / pred)
+    # scaling laws: for each axis, median states among DONE clean rows
+    # per axis value (in declared order) — the states-vs-config-size
+    # curve the lattice exists to measure
+    curves: dict = {}
+    axis_order: dict = {}
+    for sheet in (man.get("lattice") or {}).get("sheets", []):
+        for axis in sheet.get("axes", []):
+            axis_order.setdefault(axis["name"], list(axis["values"]))
+    for name, values in axis_order.items():
+        per_value: dict = {}
+        for row in points.values():
+            v = row.get("verdict") or {}
+            if row.get("status") != "done" or v.get("violation"):
+                continue
+            if v.get("distinct_states") is None:
+                continue
+            for cname, cval in row.get("coords", []):
+                if cname == name:
+                    key = json.dumps(cval)
+                    per_value.setdefault(key, []).append(
+                        int(v["distinct_states"])
+                    )
+        curve = []
+        for val in values:
+            samples = sorted(per_value.get(json.dumps(val), []))
+            if samples:
+                curve.append({
+                    "value": val,
+                    "median_states": samples[len(samples) // 2],
+                    "n": len(samples),
+                })
+        if len(curve) >= 2:
+            curves[name] = curve
+    acc = None
+    if residuals:
+        mean = sum(residuals) / len(residuals)
+        acc = {
+            "n": len(residuals),
+            "mean_log_residual": round(mean, 3),
+            "mean_abs_log_residual": round(
+                sum(abs(r) for r in residuals) / len(residuals), 3
+            ),
+            # the operator-facing phrasing: actual = predicted * factor
+            "median_actual_over_predicted": round(
+                sorted(ratios)[len(ratios) // 2], 2
+            ) if ratios else None,
+        }
+    return {
+        "dir": sweep_dir,
+        "schema": man.get("schema"),
+        "sweep_id": man.get("sweep_id"),
+        "name": man.get("name"),
+        "points": len(points),
+        "counts": counts,
+        "skipped": skipped_rows,
+        "frontiers": {
+            inv: [
+                {
+                    "point_id": r.get("point_id"),
+                    "coords": r.get("coords"),
+                    "indices": r.get("_indices"),
+                    "depth": (
+                        (r.get("verdict") or {}).get("violation") or {}
+                    ).get("depth"),
+                }
+                for r in rows
+            ]
+            for inv, rows in frontier_from_manifest(man).items()
+        },
+        "curves": curves,
+        "estimator": acc,
+        "cost_model": man.get("cost_model"),
+    }
+
+
+def render_sweep_report(data: dict) -> str:
+    c = data["counts"]
+    out = [
+        f"Sweep {data['name']} ({data['sweep_id']}) — {data['points']} "
+        f"points: {c['done']} done ({c['hit']} cache hits, "
+        f"{c['seeded']} seeded), {c['skipped']} skipped, "
+        f"{c['error']} errors, {c['pending'] + c['submitted']} pending, "
+        f"{c['violations']} violations"
+    ]
+    if data["skipped"]:
+        out.append("  skipped (statically vacuous — auditable, typed):")
+        for row in data["skipped"][:8]:
+            finds = (row.get("skip") or {}).get("findings") or []
+            acts = ", ".join(
+                f.get("target", "?") for f in finds[:3]
+            )
+            out.append(
+                f"    {dict(row.get('coords') or [])}: "
+                f"skipped: vacuous [{acts}]"
+            )
+        if len(data["skipped"]) > 8:
+            out.append(f"    ... and {len(data['skipped']) - 8} more")
+    for inv, rows in sorted((data.get("frontiers") or {}).items()):
+        out.append(f"  minimal violating configs — {inv}:")
+        for r in rows:
+            out.append(
+                f"    {dict(r.get('coords') or [])}"
+                + (
+                    f" (violates at depth {r['depth']})"
+                    if r.get("depth") is not None
+                    else ""
+                )
+            )
+    for name, curve in sorted((data.get("curves") or {}).items()):
+        states = [pt["median_states"] for pt in curve]
+        out.append(
+            f"  scaling law — states vs {name}: "
+            f"{_spark(states)}  "
+            + " ".join(
+                f"{pt['value']}→{pt['median_states']}" for pt in curve
+            )
+        )
+    acc = data.get("estimator")
+    if acc:
+        out.append(
+            f"  estimator: {acc['n']} residuals, mean log error "
+            f"{acc['mean_log_residual']:+.3f} (abs "
+            f"{acc['mean_abs_log_residual']:.3f}), median actual/"
+            f"predicted {acc['median_actual_over_predicted']}"
+        )
+    cm = data.get("cost_model") or {}
+    if cm.get("n_records"):
+        out.append(
+            f"  cost model: fit over {cm['n_records']} corpus records, "
+            f"throughput {cm.get('states_per_sec')}/s, recalibration "
+            f"shift {cm.get('residual_shift', 0):+.3f}"
+        )
     return "\n".join(out)
 
 

@@ -164,6 +164,23 @@ def shared_bounds(members: list) -> tuple:
     return md, ms
 
 
+def pack_members(group: list, max_group: int) -> list:
+    """Split one planned group into submit-order packs of at most
+    ``max_group`` members — the group-width planning hook the scheduler
+    (plan_groups max_group=) and the sweep portfolio share.  The shared
+    record holds every level of the ENVELOPE exploration in RAM and a
+    group runs at the max of its members' bounds, so a thousand-member
+    sweep group must be width-capped; contiguous submit-order packs keep
+    the bounds of a sorted sweep (shallow..deep) clustered, which keeps
+    each pack's envelope near its members' own bounds."""
+    if max_group <= 0 or len(group) <= max_group:
+        return [list(group)]
+    return [
+        list(group[i:i + max_group])
+        for i in range(0, len(group), max_group)
+    ]
+
+
 def explore_shared(
     model,
     members: list,

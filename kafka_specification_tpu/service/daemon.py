@@ -90,6 +90,12 @@ class ServeConfig:
     visited_backend: str = "device"
     cache_entries: int = 32
     batching: bool = True
+    # group-width cap (scheduler.plan_groups max_group=): a sweep can
+    # legitimately queue hundreds of same-shape jobs in one drain, and
+    # the batch runner holds the whole envelope exploration in RAM —
+    # cap how many coalesce per engine run.  None/0 = unlimited (the
+    # historical behavior); KSPEC_MAX_GROUP is the env twin.
+    max_group: Optional[int] = None
     # fleet identity (service/fleet.py): instance i writes its OWN
     # heartbeat/metrics files (heartbeat-<i>.jsonl) so the fleet
     # supervisor can watch each daemon separately, answers to the
@@ -304,9 +310,17 @@ class Daemon:
                 jobs.append((spec, cfg, emitted))
             except Exception as e:  # noqa: BLE001 — tenant input
                 done += self._fail_jobs([spec], f"cannot parse job cfg: {e}")
-        groups = plan_groups(jobs) if self.cfg.batching else [
-            [j] for j in jobs
-        ]
+        max_group = self.cfg.max_group
+        if max_group is None and os.environ.get("KSPEC_MAX_GROUP"):
+            try:
+                max_group = int(os.environ["KSPEC_MAX_GROUP"])
+            except ValueError:
+                max_group = None
+        groups = (
+            plan_groups(jobs, max_group=max_group)
+            if self.cfg.batching
+            else [[j] for j in jobs]
+        )
         self._sweep_jobs = [
             spec["job_id"] for group in groups for spec, _c, _e in group
         ]
@@ -643,7 +657,7 @@ class Daemon:
         self.metrics.inc("kspec_svc_groups_total")
         if len(group) > 1:
             self.metrics.inc("kspec_svc_batched_jobs_total", len(group))
-        for (spec, _cfg, _e), member in zip(group, members):
+        for (spec, mcfg, memitted), member in zip(group, members):
             # per-member guard: a derivation/publication failure (a
             # predicate erroring on a decoded state, an OSError on a
             # member run dir) must cost THAT member an error verdict, not
@@ -693,6 +707,18 @@ class Daemon:
                     rec["run_id"] = ctx.run_id
                     ctx.finish(rec["status"], **_summary(rec))
                 self._finish_job(spec, rec)
+                if not solo and self.state_cache is not None:
+                    # batched members publish VERDICT-ONLY entries (their
+                    # per-level rows live only in the shared record, so
+                    # there is no seedable artifact) — a repeat sweep of
+                    # the same lattice then O(verify)-hits every member
+                    # instead of re-running the whole group.  Publication
+                    # failure is a typed cache-fallback, never the job's.
+                    self._publish_state_cache(
+                        spec, mcfg, memitted,
+                        {"model": shared.model}, res,
+                        level_rows=None,
+                    )
             except Exception as e:  # noqa: BLE001 — keep the daemon alive
                 self._event(
                     "job-error", tenant=spec.get("tenant", "default"),

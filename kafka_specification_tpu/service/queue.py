@@ -252,8 +252,13 @@ class JobQueue:
         max_states: Optional[int] = None,
         fault: Optional[str] = None,
         job_id: Optional[str] = None,
+        solo: bool = False,
     ) -> dict:
-        """Atomically publish one job spec into pending/; returns it."""
+        """Atomically publish one job spec into pending/; returns it.
+        ``solo=True`` stamps the spec so the scheduler never coalesces
+        this job into a batched group (the sweep portfolio marks
+        predicted-expensive points this way — one huge member would drag
+        its group's shared exploration out to ITS bounds envelope)."""
         if kernel_source not in ("auto", "emitted", "hand"):
             raise ValueError(f"bad kernel_source {kernel_source!r}")
         spec = {
@@ -269,6 +274,10 @@ class JobQueue:
             "submitted_unix": round(time.time(), 3),
             "fault": fault,
         }
+        if solo:
+            # optional stamp (absent on non-solo specs): old daemons that
+            # predate it just ignore the key — kspec-job/1 stays one schema
+            spec["solo"] = True
         # marker BEFORE the spec publish: the admission index may briefly
         # overcount a submit that dies here (lazily cleaned on the next
         # count), but can never undercount a published job.  The whole

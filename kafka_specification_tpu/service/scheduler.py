@@ -58,17 +58,27 @@ def group_key(spec: dict, cfg, emitted: bool) -> tuple:
 def solo_only(spec: dict, cfg) -> bool:
     """True when this job must run alone (see module docstring).  A
     state-cache-seeded job (daemon._consult_state_cache) also runs solo:
-    the engine seed plugs into check(), not the batched runner."""
+    the engine seed plugs into check(), not the batched runner.  A job
+    submitted with ``solo: true`` (queue.submit(solo=True) — the sweep
+    portfolio marks predicted-expensive points this way) is honored too:
+    one huge member would otherwise drag its whole group's shared
+    exploration out to ITS bounds envelope."""
     return (
         bool(cfg.check_deadlock)
         or bool(spec.get("fault"))
+        or bool(spec.get("solo"))
         or bool(spec.get("_state_cache_seed"))
     )
 
 
-def plan_groups(jobs: list) -> list:
+def plan_groups(jobs: list, max_group: Optional[int] = None) -> list:
     """claimed [(spec, cfg, emitted)] -> list of groups (lists of those
-    triples), submit-order preserved within and across groups."""
+    triples), submit-order preserved within and across groups.
+    ``max_group`` caps group width by splitting an oversized group into
+    submit-order packs (batch.pack_members): a thousand-point sweep
+    sharing one schema shape must not force one exploration to the
+    envelope of ALL thousand bounds — packs keep the memory-resident
+    shared record (batch.py holds every level in RAM) bounded."""
     groups: dict = {}
     order: list = []
     for item in jobs:
@@ -82,6 +92,13 @@ def plan_groups(jobs: list) -> list:
             g = groups[key] = []
             order.append(g)
         g.append(item)
+    if max_group is not None and max_group > 0:
+        from .batch import pack_members
+
+        packed: list = []
+        for g in order:
+            packed.extend(pack_members(g, max_group))
+        return packed
     return order
 
 

@@ -623,6 +623,45 @@ class StateSpaceCache:
                 continue
         return removed
 
+    def iter_entries(self):
+        """Corpus view of this cache (see :func:`iter_corpus`)."""
+        return iter_corpus(self.root)
+
+
+def iter_corpus(root: str):
+    """Yield every readable, self-consistent entry record under a cache
+    root — the STANDING CORPUS view (sweep/cost.py trains on it; `cli
+    sweep` reports over it).  Light validation only: schema + self-digest
+    (the cheap metadata checks); artifact chain verification is lookup's
+    job, not a corpus scan's.  Bad entries are skipped, never fatal —
+    this walks a live cache that concurrent daemons are promoting into.
+    Each yielded dict gains ``_base``/``_bounds`` (its directory
+    coordinates) for callers that need the on-disk address."""
+    try:
+        bases = sorted(os.listdir(root))
+    except OSError:
+        return
+    for base in bases:
+        base_dir = os.path.join(root, base)
+        try:
+            bounds_dirs = sorted(os.listdir(base_dir))
+        except (OSError, NotADirectoryError):
+            continue
+        for bounds in bounds_dirs:
+            path = os.path.join(base_dir, bounds, "entry.json")
+            try:
+                with open(path) as fh:
+                    entry = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if entry.get("schema") != CACHE_SCHEMA:
+                continue
+            if entry_self_digest(entry) != entry.get("self_digest"):
+                continue
+            entry["_base"] = base
+            entry["_bounds"] = bounds
+            yield entry
+
 
 def entry_self_digest(entry: dict) -> str:
     """sha256 over the entry's canonical JSON minus the digest field —

@@ -800,6 +800,87 @@ def main(argv=None):
     )
     proute.add_argument("--json", action="store_true")
 
+    psw = sub.add_parser(
+        "sweep",
+        help="coverage sweeps over a config lattice (kspec-sweep-lattice/1"
+        "): enumerate canonical points, skip statically-vacuous configs, "
+        "predict cost from the standing corpus, schedule the portfolio "
+        "through the service queue or a router (cheap points batch, "
+        "expensive points run solo, repeats are cache hits), and report "
+        "coverage / violation frontiers / scaling laws — never imports "
+        "jax (docs/sweep.md)",
+    )
+    swsub = psw.add_subparsers(dest="sweep_cmd", required=True)
+    swp = swsub.add_parser(
+        "plan",
+        help="enumerate + annotate + predict, dispatch nothing: the "
+        "dry-run view of what a sweep would do (point count, vacuous "
+        "skips with their findings, predicted cost, solo/batch split)",
+    )
+    swp.add_argument("lattice", help="kspec-sweep-lattice/1 JSON file")
+    swp.add_argument("--state-cache-dir", metavar="DIR",
+                     help="corpus root for the cost-model fit (default: "
+                     "$KSPEC_STATE_CACHE_DIR or <service>/state-cache)")
+    swp.add_argument("--service-dir", help=svc_help)
+    swp.add_argument("--json", action="store_true")
+    swr = swsub.add_parser(
+        "run",
+        help="run (or crash-resume — only incomplete points re-submit) "
+        "one sweep to completion against a live daemon/fleet; the "
+        "durable kspec-sweep/1 manifest lands in --sweep-dir",
+    )
+    swr.add_argument("lattice", help="kspec-sweep-lattice/1 JSON file")
+    swr.add_argument("--sweep-dir", required=True,
+                     help="sweep state directory (sweep.json manifest; "
+                     "reuse to crash-resume, use a fresh one to re-run)")
+    swr.add_argument("--service-dir", help=svc_help)
+    swr.add_argument(
+        "--router", metavar="DIR",
+        help="dispatch through a cross-host router directory instead of "
+        "one service dir",
+    )
+    swr.add_argument("--tenant", default="sweep")
+    swr.add_argument("--max-inflight", type=int, default=64,
+                     help="portfolio submit-window width (default 64; "
+                     "clamped under the tenant's max_pending cap)")
+    swr.add_argument(
+        "--solo-threshold", type=int, default=200_000,
+        help="predicted distinct-states at/past which a point submits "
+        "solo instead of joining a batched group (default 200000)",
+    )
+    swr.add_argument("--timeout", type=float, default=900.0,
+                     help="give up after this many seconds without a "
+                     "verdict landing (default 900; resume later)")
+    swr.add_argument("--state-cache-dir", metavar="DIR")
+    swr.add_argument("--json", action="store_true",
+                     help="print the final manifest record")
+    swrep = swsub.add_parser(
+        "report",
+        help="render a sweep directory's manifest: coverage (done/hit/"
+        "seeded/skipped/pending), the typed vacuous-skip rows, the "
+        "minimal-violating-config frontier per invariant, scaling-law "
+        "curves (states vs axis value), estimator accuracy",
+    )
+    swrep.add_argument("sweep_dir")
+    swrep.add_argument("--json", action="store_true")
+    swb = swsub.add_parser(
+        "bisect",
+        help="witness the minimal-violating-config frontier: check every "
+        "frontier point's lower neighbors from the manifest, and "
+        "(with --service-dir/--router) actually RUN the neighbors the "
+        "sweep never ran — the frontier is witnessed, not guessed",
+    )
+    swb.add_argument("sweep_dir")
+    swb.add_argument("--invariant", help="restrict to one invariant")
+    swb.add_argument("--service-dir", help=svc_help)
+    swb.add_argument("--router", metavar="DIR")
+    swb.add_argument("--tenant", default="sweep")
+    swb.add_argument("--max-probes", type=int, default=64,
+                     help="budget of neighbor runs (default 64)")
+    swb.add_argument("--timeout", type=float, default=300.0,
+                     help="per-probe verdict timeout (default 300)")
+    swb.add_argument("--json", action="store_true")
+
     po = sub.add_parser("oracle", help="run the Python reference interpreter")
     po.add_argument("cfg")
     po.add_argument("--module")
@@ -948,6 +1029,17 @@ def main(argv=None):
             print(json.dumps(data) if args.json
                   else render_router_report(data))
             return 0
+        if run_dir is not None and os.path.isfile(
+            os.path.join(run_dir, "sweep.json")
+        ):
+            # a sweep directory (kspec-sweep/1 manifest): render the
+            # sweep beat — same detection pattern as router.json above
+            from ..obs.report import render_sweep_report, sweep_report_data
+
+            data = sweep_report_data(run_dir)
+            print(json.dumps(data) if args.json
+                  else render_sweep_report(data))
+            return 0
         if run_dir is None:
             root = args.root or os.environ.get("KSPEC_RUNS_ROOT", "runs")
             if args.latest:
@@ -973,6 +1065,12 @@ def main(argv=None):
         # the router is operator infrastructure for a degraded fleet:
         # jax-free by contract, like the clients it fronts
         return _run_router(args)
+
+    if args.cmd == "sweep":
+        # sweep planning/dispatch/reporting is a queue/router CLIENT:
+        # jax-free by contract — the only engine work a sweep causes
+        # happens inside serving daemons
+        return _run_sweep(args)
 
     if args.cmd in ("submit", "status", "result"):
         # the tenant side of the service: MUST stay jax-free — clients
@@ -1580,6 +1678,188 @@ def _run_router(args) -> int:
         router.serve(poll_s=args.poll)
     except KeyboardInterrupt:
         pass
+    return 0
+
+
+def _run_sweep(args) -> int:
+    """`cli sweep plan|run|report|bisect`: the coverage-sweep subsystem
+    (sweep/ package, docs/sweep.md).  Jax-free by contract — a sweep is
+    a queue/router client; daemons do the engine work."""
+    from ..sweep import (
+        SweepConfig,
+        load_lattice,
+        load_manifest,
+        plan_sweep,
+        run_sweep,
+    )
+
+    if args.sweep_cmd == "plan":
+        try:
+            lattice = load_lattice(args.lattice)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        cfg = SweepConfig(
+            sweep_dir=".",  # plan never writes
+            service_dir=_service_dir(getattr(args, "service_dir", None)),
+            state_cache_dir=args.state_cache_dir,
+        )
+        plan = plan_sweep(lattice, cfg)
+        if args.json:
+            print(json.dumps({
+                "lattice": lattice.record(),
+                "points": len(plan["points"]),
+                "runnable": len(plan["runnable"]),
+                "deferred": len(plan["deferred"]),
+                "skipped": [
+                    {"point": p.record(), "findings": p.vacuous}
+                    for p in plan["skipped"]
+                ],
+                "cost_model": plan["model"].to_dict(),
+                "predictions": plan["predictions"],
+            }))
+            return 0
+        m = plan["model"]
+        total_states = sum(
+            plan["predictions"][p.point_id]["states"]
+            for p in plan["runnable"] + plan["deferred"]
+        )
+        total_s = sum(
+            plan["predictions"][p.point_id]["seconds"] or 0.0
+            for p in plan["runnable"] + plan["deferred"]
+        )
+        print(
+            f"lattice {lattice.name}: {len(plan['points'])} points "
+            f"({len(plan['runnable'])} runnable, "
+            f"{len(plan['deferred'])} deferred, "
+            f"{len(plan['skipped'])} skipped as statically vacuous)"
+        )
+        print(
+            f"cost model: {m.n_records} corpus records, predicted "
+            f"~{total_states} states, ~{total_s:.1f}s engine wall "
+            "(flat-throughput; honesty limits in docs/sweep.md)"
+        )
+        for p in plan["skipped"][:8]:
+            acts = ", ".join(
+                f.get("target", "?") for f in p.vacuous[:3]
+            )
+            print(f"  skipped: vacuous {dict(p.coords)} [{acts}]")
+        if len(plan["skipped"]) > 8:
+            print(f"  ... and {len(plan['skipped']) - 8} more")
+        return 0
+
+    if args.sweep_cmd == "run":
+        try:
+            lattice = load_lattice(args.lattice)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        cfg = SweepConfig(
+            sweep_dir=args.sweep_dir,
+            service_dir=(
+                None if args.router
+                else _service_dir(args.service_dir)
+            ),
+            router_dir=args.router,
+            tenant=args.tenant,
+            max_inflight=args.max_inflight,
+            solo_threshold_states=args.solo_threshold,
+            wait_timeout_s=args.timeout,
+            state_cache_dir=args.state_cache_dir,
+        )
+        rec = run_sweep(lattice, cfg, log=lambda s: print(s))
+        if args.json:
+            print(json.dumps(rec))
+        incomplete = sum(
+            1 for row in rec["points"].values()
+            if row.get("status") in ("pending", "submitted")
+        )
+        errors = sum(
+            1 for row in rec["points"].values()
+            if row.get("status") == "error"
+        )
+        return 1 if errors else (75 if incomplete else 0)
+
+    if args.sweep_cmd == "report":
+        from ..obs.report import render_sweep_report, sweep_report_data
+
+        try:
+            data = sweep_report_data(args.sweep_dir)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(data) if args.json else render_sweep_report(data))
+        return 0
+
+    # bisect: witness the frontier (runs neighbors through the service)
+    from ..sweep.bisect import refine_frontier
+    from ..sweep.lattice import enumerate_points
+    from ..sweep.portfolio import Dispatcher
+
+    try:
+        man = load_manifest(args.sweep_dir)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    runner = None
+    if args.service_dir or args.router:
+        cfg = SweepConfig(
+            sweep_dir=args.sweep_dir,
+            service_dir=(
+                None if args.router
+                else _service_dir(args.service_dir)
+            ),
+            router_dir=args.router,
+            tenant=args.tenant,
+        )
+        dispatch = Dispatcher(cfg)
+
+        def runner(coords):
+            # synthesize the probe point by re-enumerating the lattice
+            # restricted to these coordinates: same canonical keys, so
+            # the probe may itself be a state-cache hit
+            from ..sweep.lattice import load_lattice as _ll
+
+            spec = _ll(dict(man["lattice"]))
+            want = dict(coords)
+            for p in enumerate_points(spec):
+                if dict(p.coords) == want:
+                    import os as _os
+
+                    jid = (
+                        f"probe-{man['sweep_id']}-"
+                        f"{p.point_id.replace(':', '-')}-"
+                        f"{_os.urandom(2).hex()}"
+                    )
+                    dispatch.submit(p, jid, solo=True)
+                    rec = dispatch.backend.wait_result(
+                        jid, timeout=args.timeout
+                    )
+                    return rec or {}
+            return {}
+    else:
+
+        def runner(coords):
+            return {}  # manifest-only mode: unknown neighbors stay unrun
+
+    out = refine_frontier(
+        man, runner, log=lambda s: print(s, file=sys.stderr),
+        invariant=args.invariant, max_probes=args.max_probes,
+    )
+    if args.json:
+        print(json.dumps(out))
+        return 0
+    if not out:
+        print("no violating points in the manifest — nothing to bisect")
+        return 0
+    for inv in sorted(out):
+        rep = out[inv]
+        print(f"{inv}: frontier of {len(rep['frontier'])} minimal "
+              f"violating configs ({len(rep['witnesses'])} neighbors "
+              f"witnessed, {len(rep['demoted'])} claims demoted)")
+        for r in rep["frontier"]:
+            coords = r.get("coords")
+            print(f"  {dict(coords) if coords else r.get('_indices')}")
     return 0
 
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env bash
+# Nightly coverage sweep: a small real lattice through the full
+# CLI surface (`cli sweep plan|run|report` + `cli report` sweep
+# detection), validating that the kspec-sweep/1 manifest ROUND-TRIPS:
+#
+#   1. plan is pure (no sweep dir side effects);
+#   2. a cold `cli sweep run` completes every point against a live
+#      `cli serve` daemon and promotes a schema-valid manifest;
+#   3. re-running the SAME sweep dir is a no-op resume (exit 0, no new
+#      job ids — every point exactly once per sweep instance);
+#   4. a fresh repeat sweep against the same service is all state-cache
+#      hits (the cache-incremental contract);
+#   5. `cli sweep report` renders coverage + scaling laws from nothing
+#      but the manifest on disk.
+#
+# Usage: scripts/nightly_sweep.sh [workdir]   (default: mktemp -d)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+KSPEC="${PYTHON:-python} -m kafka_specification_tpu.utils.cli"
+
+WORK="${1:-$(mktemp -d /tmp/kspec-nightly-sweep.XXXXXX)}"
+SVC="$WORK/svc"
+LATTICE="$WORK/lattice.json"
+echo "# nightly sweep in $WORK"
+
+cat > "$LATTICE" <<'EOF'
+{
+  "schema": "kspec-sweep-lattice/1",
+  "name": "nightly",
+  "on_vacuous": "skip",
+  "sheets": [
+    {
+      "module": "IdSequence",
+      "cfg_text": "SPECIFICATION Spec\nCONSTANTS\n    MaxId = 6\nINVARIANTS TypeOk\nCHECK_DEADLOCK FALSE\n",
+      "axes": [
+        {"name": "MaxId", "values": [3, 4, 5, 6]},
+        {"name": "max_depth", "kind": "bound", "values": [3, null]}
+      ]
+    },
+    {
+      "module": "KafkaTruncateToHighWatermark",
+      "cfg_text": "SPECIFICATION Spec\nCONSTANTS\n    Replicas = {b1, b2}\n    LogSize = 2\n    MaxRecords = 1\n    MaxLeaderEpoch = 1\nINVARIANTS TypeOk WeakIsr\nCHECK_DEADLOCK FALSE\n",
+      "axes": [
+        {"name": "MaxRecords", "values": [0, 1]}
+      ]
+    }
+  ]
+}
+EOF
+
+# 1. plan: jax-free dry run, must not create a sweep dir
+$KSPEC sweep plan "$LATTICE" --state-cache-dir "$SVC/state-cache"
+test ! -e "$WORK/sweep1" || { echo "FAIL: plan had side effects"; exit 1; }
+
+# a serving daemon that exits once the queue stays idle
+$KSPEC serve "$SVC" --idle-exit 120 --min-bucket 32 \
+    --visited-backend host &
+DAEMON=$!
+trap 'kill "$DAEMON" 2>/dev/null || true' EXIT
+
+# 2. cold sweep
+$KSPEC sweep run "$LATTICE" --sweep-dir "$WORK/sweep1" \
+    --service-dir "$SVC" --timeout 600
+python - "$WORK/sweep1" <<'EOF'
+import json, sys
+from kafka_specification_tpu.sweep import load_manifest
+man = load_manifest(sys.argv[1])
+assert man["schema"] == "kspec-sweep/1", man["schema"]
+rows = man["points"].values()
+bad = [r["point_id"] for r in rows
+       if r["status"] not in ("done", "skipped")]
+assert not bad, f"incomplete points: {bad}"
+skipped = [r for r in rows if r["status"] == "skipped"]
+assert skipped and all(
+    r["skip"]["reason"] == "vacuous" and r["skip"]["findings"]
+    for r in skipped
+), "expected a typed skipped:vacuous row (MaxRecords=0)"
+# the manifest round-trips through plain json
+assert json.loads(json.dumps(man)) == man
+print(f"# cold ok: {len(man['points'])} points, "
+      f"{len(skipped)} typed vacuous skips")
+EOF
+
+# 3. resume no-op: same dir, same sweep instance, zero new jobs
+JOBS_BEFORE=$(ls "$SVC/results" | wc -l)
+$KSPEC sweep run "$LATTICE" --sweep-dir "$WORK/sweep1" \
+    --service-dir "$SVC" --timeout 60
+JOBS_AFTER=$(ls "$SVC/results" | wc -l)
+test "$JOBS_BEFORE" = "$JOBS_AFTER" \
+    || { echo "FAIL: resume resubmitted ($JOBS_BEFORE -> $JOBS_AFTER)"; exit 1; }
+
+# 4. fresh repeat sweep: every run point is a state-cache hit
+$KSPEC sweep run "$LATTICE" --sweep-dir "$WORK/sweep2" \
+    --service-dir "$SVC" --timeout 600
+python - "$WORK/sweep2" <<'EOF'
+import sys
+from kafka_specification_tpu.sweep import load_manifest
+man = load_manifest(sys.argv[1])
+run = [r for r in man["points"].values() if r["status"] == "done"]
+miss = [r["point_id"] for r in run
+        if (r.get("cache") or {}).get("state_cache") != "hit"]
+assert not miss, f"repeat sweep missed the cache: {miss}"
+print(f"# repeat ok: {len(run)}/{len(run)} cache hits")
+EOF
+
+# 5. reporting renders from the manifest alone
+$KSPEC sweep report "$WORK/sweep1"
+REPORT=$($KSPEC report "$WORK/sweep1")
+echo "$REPORT" | grep -q "Sweep nightly" \
+    || { echo "FAIL: cli report did not detect the sweep dir"; exit 1; }
+
+echo "# nightly sweep OK"

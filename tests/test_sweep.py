@@ -1,0 +1,644 @@
+"""Coverage sweep subsystem: lattice, cost model, portfolio, bisection.
+
+Fast tier (`sweep` marker).  The serving daemon runs IN-PROCESS (its
+public Daemon.drain_once wired as SweepConfig.drive) so the suite pays
+jax/XLA compiles once per model shape; the lattice/cost/bisect units and
+the jax-free contract need no engine at all.
+
+The load-bearing checks (ISSUE 17 acceptance):
+- sweep verdicts are BIT-IDENTICAL to solo engine runs, including one
+  violating point (KafkaTruncateToHighWatermark WeakIsr) and one
+  cache-seeded deeper-bound point;
+- a repeat sweep is all state-cache hits (the cache-incremental win);
+- a crash-resumed sweep re-attaches to its deterministic job ids and
+  runs every point exactly once;
+- a statically-vacuous point lands as a TYPED, machine-readable
+  ``skipped: vacuous`` manifest row with the finding attached.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kafka_specification_tpu.engine.bfs import check
+from kafka_specification_tpu.models import id_sequence, variants
+from kafka_specification_tpu.models.kafka_replication import Config
+from kafka_specification_tpu.service.daemon import Daemon, ServeConfig
+from kafka_specification_tpu.service.verdict import verdict_from_result
+from kafka_specification_tpu.sweep import (
+    CostModel,
+    SweepConfig,
+    bisect_line,
+    enumerate_points,
+    flat_time_estimate,
+    job_id_for,
+    load_lattice,
+    load_manifest,
+    plan_sweep,
+    refine_frontier,
+    run_sweep,
+    vacuous_findings,
+)
+from kafka_specification_tpu.sweep.cost import features_from
+from kafka_specification_tpu.utils.cfg import parse_cfg, resolved_invariants
+from kafka_specification_tpu.utils.cli import main as cli_main
+
+pytestmark = pytest.mark.sweep
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ID_CFG = """
+SPECIFICATION Spec
+CONSTANTS
+    MaxId = 6
+INVARIANTS TypeOk
+CHECK_DEADLOCK FALSE
+"""
+
+# the smallest real violation workload (tests/test_service.py): 353
+# states, WeakIsr violated at depth 8 — so a max_depth axis [2, 8] gives
+# one clean bounded point and one violating point from the same shape
+TTW_TINY = Config(n_replicas=2, log_size=2, max_records=1,
+                  max_leader_epoch=1)
+TTW_CFG_WEAK = """
+SPECIFICATION Spec
+CONSTANTS
+    Replicas = {b1, b2}
+    LogSize = 2
+    MaxRecords = 1
+    MaxLeaderEpoch = 1
+INVARIANTS TypeOk WeakIsr
+CHECK_DEADLOCK FALSE
+"""
+
+# MaxRecords = 0 statically disables LeaderWrite (its `nrid < MaxRecords`
+# guard is unsatisfiable) — a REAL vacuous-action shape, no mocking
+TTW_CFG_MR0 = TTW_CFG_WEAK.replace("MaxRecords = 1", "MaxRecords = 0")
+
+
+def _e2e_lattice() -> dict:
+    return {
+        "schema": "kspec-sweep-lattice/1",
+        "name": "e2e",
+        "on_vacuous": "skip",
+        "sheets": [
+            {"module": "KafkaTruncateToHighWatermark",
+             "cfg_text": TTW_CFG_WEAK,
+             "axes": [{"name": "max_depth", "kind": "bound",
+                       "values": [2, 8]}]},
+            {"module": "IdSequence", "cfg_text": ID_CFG,
+             "axes": [{"name": "MaxId", "values": [4, 6]}]},
+        ],
+    }
+
+
+def _daemon(svc_dir) -> Daemon:
+    # state cache ON (the default): the sweep's cache-incremental
+    # contract is the thing under test here
+    return Daemon(ServeConfig(service_dir=str(svc_dir), linger_s=0.0,
+                              min_bucket=32))
+
+
+def _sweep_cfg(sweep_dir, svc_dir, daemon=None, **kw) -> SweepConfig:
+    kw.setdefault("wait_timeout_s", 300.0)
+    return SweepConfig(
+        sweep_dir=str(sweep_dir),
+        service_dir=str(svc_dir),
+        drive=(daemon.drain_once if daemon is not None else None),
+        **kw,
+    )
+
+
+# --- lattice units --------------------------------------------------------
+
+
+def test_lattice_enumeration_and_canonical_keys():
+    lat = load_lattice(_e2e_lattice())
+    pts = enumerate_points(lat)
+    assert len(pts) == 4
+    assert len({p.point_id for p in pts}) == 4
+    ttw = [p for p in pts if p.module == "KafkaTruncateToHighWatermark"]
+    assert [p.max_depth for p in ttw] == [2, 8]
+    # same shape, different bounds: same base digest, distinct point ids
+    assert ttw[0].key.base_digest() == ttw[1].key.base_digest()
+    assert ttw[0].point_id != ttw[1].point_id
+    ideq = [p for p in pts if p.module == "IdSequence"]
+    assert "MaxId = 4" in ideq[0].cfg_text
+    assert dict(ideq[1].coords) == {"MaxId": 6}
+    # every point is a complete standalone unit of work
+    for p in pts:
+        assert "SPECIFICATION" in p.cfg_text
+        assert p.point_id == (
+            f"{p.key.base_digest()}:{p.key.bounds_name()}"
+        )
+
+
+def test_lattice_constants_order_canonicalization():
+    """Permuting the base cfg's CONSTANTS order must not change the
+    point id — the sweep keys the state-space cache's namespace."""
+    def one_point(cfg_text):
+        lat = load_lattice({
+            "schema": "kspec-sweep-lattice/1", "name": "perm",
+            "module": "KafkaTruncateToHighWatermark",
+            "cfg_text": cfg_text, "axes": [],
+        })
+        (p,) = enumerate_points(lat)
+        return p
+
+    permuted = TTW_CFG_WEAK.replace(
+        "    Replicas = {b1, b2}\n    LogSize = 2\n",
+        "    LogSize = 2\n    Replicas = {b1, b2}\n",
+    )
+    assert permuted != TTW_CFG_WEAK
+    assert one_point(TTW_CFG_WEAK).point_id == one_point(permuted).point_id
+
+
+def test_lattice_dedupes_coinciding_axis_paths():
+    """Two sheets that synthesize the same config are ONE point."""
+    lat = load_lattice({
+        "schema": "kspec-sweep-lattice/1", "name": "dedupe",
+        "sheets": [
+            {"module": "IdSequence", "cfg_text": ID_CFG,
+             "axes": [{"name": "MaxId", "values": [6]}]},
+            {"module": "IdSequence", "cfg_text": ID_CFG, "axes": []},
+        ],
+    })
+    assert len(enumerate_points(lat)) == 1
+
+
+def test_lattice_replica_set_axis_scales_cardinality():
+    """An int value on a model-value-set constant means 'a set of N
+    values' — only the SIZE is semantic to the engine."""
+    frl = """
+SPECIFICATION Spec
+CONSTANTS
+    Replicas = {r1, r2}
+    LogSize = 1
+    LogRecords = {a}
+    Nil = Nil
+INVARIANTS TypeOk
+CHECK_DEADLOCK FALSE
+"""
+    lat = load_lattice({
+        "schema": "kspec-sweep-lattice/1", "name": "frl",
+        "module": "FiniteReplicatedLog", "cfg_text": frl,
+        "axes": [{"name": "Replicas", "values": [1, 3]}],
+    })
+    pts = enumerate_points(lat)
+    assert "Replicas = {r1}" in pts[0].cfg_text
+    assert "Replicas = {r1, r2, r3}" in pts[1].cfg_text
+
+
+def test_vacuous_findings_real_dead_action():
+    """MaxRecords = 0 kills LeaderWrite's guard statically; the finding
+    is the analyzer's own record, not a sweep-side guess."""
+    fs = vacuous_findings("KafkaTruncateToHighWatermark", TTW_CFG_MR0)
+    assert [f["kind"] for f in fs] == ["vacuous-action"]
+    assert fs[0]["target"] == "action:LeaderWrite"
+    assert vacuous_findings(
+        "KafkaTruncateToHighWatermark", TTW_CFG_WEAK
+    ) == []
+
+
+# --- cost model units -----------------------------------------------------
+
+
+def test_flat_time_estimate_contract():
+    assert flat_time_estimate(None, 100.0) is None
+    assert flat_time_estimate(100, None) is None
+    assert flat_time_estimate(100, 0) is None
+    assert flat_time_estimate(1234, 100.0) == 12.3
+
+
+def test_cost_model_fit_predict_roundtrip():
+    # synthetic geometric corpus: states = 2^MaxId at 100 states/s
+    recs = [
+        {"features": features_from({"MaxId": n}), "states": 2 ** n,
+         "seconds": (2 ** n) / 100.0}
+        for n in range(2, 9)
+    ]
+    m = CostModel.fit(recs)
+    assert m.n_records == 7
+    p4 = m.predict(features_from({"MaxId": 4}))
+    p8 = m.predict(features_from({"MaxId": 8}))
+    assert p8["states"] > p4["states"] > 0
+    # wall predictions go through THE shared estimator
+    assert p4["seconds"] == flat_time_estimate(
+        p4["states"], m.states_per_sec
+    )
+    assert m.states_per_sec == pytest.approx(100.0)
+    # (de)serialization rides the manifest unchanged
+    m2 = CostModel.from_dict(m.to_dict())
+    assert m2.predict(features_from({"MaxId": 5})) == m.predict(
+        features_from({"MaxId": 5})
+    )
+
+
+def test_cost_model_residual_recalibration():
+    m = CostModel.fit([
+        {"features": features_from({"N": n}), "states": 10 * n}
+        for n in (1, 2, 4, 8)
+    ])
+    feats = features_from({"N": 4})
+    # a +1.0 mean log residual shifts every later prediction up by 1.0
+    m2 = m.recalibrated([0.5, 1.5])
+    assert m2.residual_shift == pytest.approx(1.0)
+    assert m2.predict_log_states(feats) == pytest.approx(
+        m.predict_log_states(feats) + 1.0
+    )
+    # after recalibration the same actual leaves a 1.0-smaller residual
+    actual = 1000
+    assert m2.residual(feats, actual) == pytest.approx(
+        m.residual(feats, actual) - 1.0
+    )
+    # empty residual list is the identity
+    assert m.recalibrated([]) is m
+
+
+def test_eta_delegates_to_shared_estimator(monkeypatch):
+    """Satellite 1: `cli report`'s per-run ETA computes its seconds via
+    sweep/cost.flat_time_estimate — one estimator, two callers."""
+    import kafka_specification_tpu.sweep.cost as cost
+    from kafka_specification_tpu.obs.report import eta
+
+    monkeypatch.setattr(cost, "flat_time_estimate",
+                        lambda states, rate: 123.4)
+    levels = [
+        {"depth": d, "new": max(1, 1000 >> d), "level_ms": 10.0}
+        for d in range(6)
+    ]
+    out = eta(levels)
+    assert out["status"] == "fit"
+    assert out["eta_seconds"] == 123.4
+
+
+# --- scheduler packing (the sweep's batching lever) -----------------------
+
+
+def test_pack_members_splits_oversize_groups():
+    from kafka_specification_tpu.service.batch import pack_members
+
+    g = list(range(5))
+    assert pack_members(g, 0) == [g]
+    assert pack_members(g, 8) == [g]
+    assert pack_members(g, 2) == [[0, 1], [2, 3], [4]]
+
+
+# --- portfolio end-to-end -------------------------------------------------
+
+
+def _solo_verdict(point) -> dict:
+    """The reference verdict: a direct engine run of the same config."""
+    if point.module == "IdSequence":
+        model = id_sequence.make_model(dict(point.coords).get("MaxId", 6))
+    else:
+        invs = resolved_invariants(point.module, parse_cfg(point.cfg_text))
+        model = variants.make_model(point.module, TTW_TINY, invs)
+    res = check(model, max_depth=point.max_depth,
+                max_states=point.max_states, min_bucket=32)
+    return verdict_from_result(res)
+
+
+def test_sweep_end_to_end_bit_identity_and_repeat(tmp_path, capsys):
+    svc = tmp_path / "svc"
+    d = _daemon(svc)
+    lat = load_lattice(_e2e_lattice())
+
+    rec = run_sweep(lat, _sweep_cfg(tmp_path / "sweep1", svc, d))
+    rows = list(rec["points"].values())
+    assert len(rows) == 4
+    assert all(r["status"] == "done" for r in rows)
+
+    # --- bit-identity: every sweep verdict == the solo engine verdict,
+    # including the violating point
+    for p in enumerate_points(lat):
+        solo = _solo_verdict(p)
+        v = rec["points"][p.point_id]["verdict"]
+        for k in ("distinct_states", "diameter", "violation",
+                  "exit_code"):
+            assert v[k] == solo[k], (p.point_id, k, v[k], solo[k])
+    viol = [r for r in rows if (r["verdict"] or {}).get("violation")]
+    assert len(viol) == 1
+    assert viol[0]["verdict"]["violation"]["invariant"] == "WeakIsr"
+    assert dict(viol[0]["coords"]) == {"max_depth": 8}
+    # every completed clean point banked a prediction residual
+    assert sum(1 for r in rows if r.get("residual") is not None) == 3
+    assert rec["cost_model"] is not None
+
+    # --- repeat sweep (fresh sweep dir, same service): every point is a
+    # state-cache hit — the cache-incremental win
+    rec2 = run_sweep(lat, _sweep_cfg(tmp_path / "sweep2", svc, d))
+    assert rec2["sweep_id"] != rec["sweep_id"]
+    for r in rec2["points"].values():
+        assert r["status"] == "done"
+        assert (r.get("cache") or {}).get("state_cache") == "hit", r
+        # hits are bit-identical to the first sweep's verdicts
+        first = rec["points"][r["point_id"]]["verdict"]
+        for k in ("distinct_states", "diameter", "violation"):
+            assert r["verdict"][k] == first[k]
+
+    # --- sweep report: frontier + scaling law + estimator accuracy
+    from kafka_specification_tpu.obs.report import (
+        render_sweep_report,
+        sweep_report_data,
+    )
+
+    data = sweep_report_data(str(tmp_path / "sweep1"))
+    assert data["counts"]["done"] == 4
+    assert data["counts"]["violations"] == 1
+    (fr,) = data["frontiers"]["WeakIsr"]
+    assert dict(fr["coords"]) == {"max_depth": 8}
+    # IdSequence states = MaxId + 2: the curve the lattice measures
+    assert [pt["median_states"] for pt in data["curves"]["MaxId"]] \
+        == [6, 8]
+    assert data["estimator"]["n"] == 3
+    text = render_sweep_report(data)
+    assert "minimal violating configs — WeakIsr" in text
+    assert "scaling law — states vs MaxId" in text
+    assert "estimator:" in text
+
+    # --- the frontier is witnessed from manifest rows alone: the
+    # depth-8 claim's lower neighbor (depth 2) already ran clean
+    ref = refine_frontier(load_manifest(str(tmp_path / "sweep1")),
+                          runner=lambda coords: {})
+    r = ref["WeakIsr"]
+    assert [w["violates"] for w in r["witnesses"]] == [False]
+    assert r["demoted"] == []
+    assert dict(r["frontier"][0]["coords"]) == {"max_depth": 8}
+
+    # --- `cli report` auto-detects a sweep dir (like router dirs)
+    capsys.readouterr()
+    assert cli_main(["report", str(tmp_path / "sweep1")]) == 0
+    out = capsys.readouterr().out
+    assert "Sweep e2e" in out
+    # --- and `cli sweep report --json` is the machine-readable twin
+    assert cli_main(
+        ["sweep", "report", str(tmp_path / "sweep1"), "--json"]
+    ) == 0
+    j = json.loads(capsys.readouterr().out)
+    assert j["counts"]["done"] == 4
+
+
+def test_sweep_cache_seed_deeper_bound(tmp_path):
+    """A deeper-bound repeat point boundary-seeds from the shallow solo
+    run's cached artifact, and its verdict is bit-identical to a cold
+    solo engine run at the deeper bound."""
+    svc = tmp_path / "svc"
+    d = _daemon(svc)
+
+    def lat(depth_values):
+        return load_lattice({
+            "schema": "kspec-sweep-lattice/1", "name": "seed",
+            "module": "IdSequence", "cfg_text": ID_CFG,
+            "axes": [{"name": "max_depth", "kind": "bound",
+                      "values": depth_values}],
+        })
+
+    # solo_threshold 0: the shallow point runs SOLO and publishes the
+    # full seedable artifact (batched members publish verdict-only)
+    rec1 = run_sweep(lat([3]), _sweep_cfg(tmp_path / "s1", svc, d,
+                                          solo_threshold_states=0))
+    (row1,) = rec1["points"].values()
+    assert row1["status"] == "done" and row1["solo"] is True
+    assert row1["verdict"]["distinct_states"] == 4  # nextId 0..3
+
+    rec2 = run_sweep(lat([None]), _sweep_cfg(tmp_path / "s2", svc, d))
+    (row2,) = rec2["points"].values()
+    assert row2["status"] == "done"
+    assert (row2.get("cache") or {}).get("state_cache") == "seed", row2
+    # bit-identity of the seeded run vs a cold unbounded check
+    res = check(id_sequence.make_model(6), min_bucket=32)
+    solo = verdict_from_result(res)
+    for k in ("distinct_states", "diameter", "violation", "exit_code"):
+        assert row2["verdict"][k] == solo[k]
+
+
+def test_sweep_crash_resume_exactly_once(tmp_path):
+    """Phase 1 submits and 'crashes' (timeout with no daemon); phase 2
+    resumes the same sweep dir: same sweep id, same deterministic job
+    ids, every point run exactly once."""
+    svc = tmp_path / "svc"
+    sw = tmp_path / "sweep"
+    lat = load_lattice({
+        "schema": "kspec-sweep-lattice/1", "name": "resume",
+        "module": "IdSequence", "cfg_text": ID_CFG,
+        "axes": [{"name": "MaxId", "values": [4, 6]}],
+    })
+
+    rec1 = run_sweep(lat, _sweep_cfg(sw, svc, wait_timeout_s=0.0))
+    assert all(r["status"] == "submitted"
+               for r in rec1["points"].values())
+    ids1 = {r["job_id"] for r in rec1["points"].values()}
+    assert ids1 == {
+        job_id_for(rec1["sweep_id"], pid) for pid in rec1["points"]
+    }
+    # the manifest is durable across the "crash"
+    assert load_manifest(str(sw))["sweep_id"] == rec1["sweep_id"]
+
+    d = _daemon(svc)
+    rec2 = run_sweep(lat, _sweep_cfg(sw, svc, d))
+    assert rec2["sweep_id"] == rec1["sweep_id"]
+    assert all(r["status"] == "done" for r in rec2["points"].values())
+    assert {r["job_id"] for r in rec2["points"].values()} == ids1
+    # exactly one queue job and one verdict per point — never resubmitted
+    results = os.listdir(svc / "results")
+    assert len(results) == len(rec2["points"])
+    assert {f[:-len(".json")] for f in results
+            if f.endswith(".json")} == ids1
+
+
+def test_sweep_vacuous_point_skipped_typed(tmp_path, capsys):
+    """Satellite 2: a statically-vacuous point never reaches the queue;
+    its manifest row is typed `skipped: vacuous` with the analyzer
+    finding attached, and the report renders it."""
+    svc = tmp_path / "svc"
+    lat = load_lattice({
+        "schema": "kspec-sweep-lattice/1", "name": "vac",
+        "on_vacuous": "skip",
+        "module": "KafkaTruncateToHighWatermark",
+        "cfg_text": TTW_CFG_MR0, "axes": [],
+    })
+    rec = run_sweep(lat, _sweep_cfg(tmp_path / "sweep", svc,
+                                    wait_timeout_s=1.0))
+    (row,) = rec["points"].values()
+    assert row["status"] == "skipped"
+    assert row["job_id"] is None  # never submitted
+    assert row["skip"]["reason"] == "vacuous"
+    (f,) = row["skip"]["findings"]
+    assert f["kind"] == "vacuous-action"
+    assert f["target"] == "action:LeaderWrite"
+    # nothing ever hit the queue
+    assert not os.path.isdir(svc / "results") \
+        or not os.listdir(svc / "results")
+
+    from kafka_specification_tpu.obs.report import (
+        render_sweep_report,
+        sweep_report_data,
+    )
+
+    data = sweep_report_data(str(tmp_path / "sweep"))
+    assert data["counts"]["skipped"] == 1
+    assert data["skipped"][0]["skip"]["findings"][0]["target"] \
+        == "action:LeaderWrite"
+    assert "skipped: vacuous" in render_sweep_report(data)
+
+    # `defer` policy: the same point plans as deferred, not skipped
+    lat_defer = load_lattice({
+        "schema": "kspec-sweep-lattice/1", "name": "vac",
+        "on_vacuous": "defer",
+        "module": "KafkaTruncateToHighWatermark",
+        "cfg_text": TTW_CFG_MR0, "axes": [],
+    })
+    plan = plan_sweep(lat_defer, _sweep_cfg(tmp_path / "p", svc))
+    assert len(plan["deferred"]) == 1 and not plan["skipped"]
+
+
+def test_cli_sweep_plan_json(tmp_path, capsys):
+    """`cli sweep plan --json`: points, vacuous skips with findings, and
+    the cost model — all without touching a queue."""
+    lat_path = tmp_path / "lat.json"
+    lat_path.write_text(json.dumps({
+        "schema": "kspec-sweep-lattice/1", "name": "plan",
+        "module": "KafkaTruncateToHighWatermark",
+        "cfg_text": TTW_CFG_WEAK,
+        "axes": [{"name": "MaxRecords", "values": [0, 1]}],
+    }))
+    assert cli_main([
+        "sweep", "plan", str(lat_path), "--json",
+        "--state-cache-dir", str(tmp_path / "no-cache"),
+    ]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["points"] == 2 and out["runnable"] == 1
+    (sk,) = out["skipped"]
+    assert sk["findings"][0]["target"] == "action:LeaderWrite"
+    assert "cost_model" in out
+
+
+# --- bisection ------------------------------------------------------------
+
+
+def test_bisect_line_is_logarithmic():
+    calls = []
+
+    def is_violating(v):
+        calls.append(v)
+        return v >= 6
+
+    values = [1, 2, 3, 4, 5, 6, 7, 8]
+    assert bisect_line(values, is_violating) == 5  # index of value 6
+    assert len(calls) <= 4  # 1 endpoint probe + ceil(log2(8)) splits
+    assert bisect_line([1, 2, 3], lambda v: False) is None
+    assert bisect_line([], lambda v: True) is None
+
+
+def _synthetic_manifest(statuses: dict) -> dict:
+    """One-axis manifest: N in [1, 2, 3]; `statuses` maps N value ->
+    violation-or-None for the rows that 'ran'."""
+    points = {}
+    for n, viol in statuses.items():
+        points[f"p{n}"] = {
+            "point_id": f"p{n}", "coords": [["N", n]], "status": "done",
+            "verdict": {
+                "violation": viol, "distinct_states": 10 * n,
+                "exit_code": 1 if viol else 0,
+            },
+        }
+    return {
+        "schema": "kspec-sweep/1", "sweep_id": "syn", "name": "syn",
+        "lattice": {"sheets": [{"axes": [
+            {"name": "N", "kind": "constant", "values": [1, 2, 3]},
+        ]}]},
+        "points": points,
+    }
+
+
+def test_refine_frontier_demotes_refuted_minimality():
+    """The sweep only ran N=3 (violating).  The witness pass probes N=2
+    — which VIOLATES — demoting the N=3 claim and chasing N=1 (clean):
+    the reported frontier is the witnessed minimum, N=2."""
+    man = _synthetic_manifest(
+        {3: {"invariant": "Inv", "depth": 2, "trace_len": 3}}
+    )
+    probed = []
+
+    def runner(coords):
+        (n,) = [v for k, v in coords if k == "N"]
+        probed.append(n)
+        if n == 2:
+            return {"violation": {"invariant": "Inv", "depth": 1,
+                                  "trace_len": 2},
+                    "distinct_states": 20}
+        return {"violation": None, "distinct_states": 10}
+
+    out = refine_frontier(man, runner)["Inv"]
+    assert probed == [2, 1]
+    assert out["demoted"] == ["p3"]
+    (final,) = out["frontier"]
+    assert final["_indices"] == [["N", 1]]  # N=2 is index 1
+    assert {tuple(w["neighbor"][0]): w["violates"]
+            for w in out["witnesses"]} == {("N", 1): True, ("N", 0): False}
+
+
+def test_refine_frontier_unwitnessed_edges_are_typed():
+    """No runner verdict => the edge is violates=None (unwitnessed),
+    NEVER silently counted clean, and the claim is not demoted."""
+    man = _synthetic_manifest(
+        {3: {"invariant": "Inv", "depth": 2, "trace_len": 3}}
+    )
+    out = refine_frontier(man, runner=lambda coords: {})["Inv"]
+    (w,) = out["witnesses"]
+    assert w["violates"] is None and w["verdict"] is None
+    assert out["demoted"] == []
+    assert [r["point_id"] for r in out["frontier"]] == ["p3"]
+
+
+def test_refine_frontier_uses_manifest_rows_without_probing():
+    """Lower neighbors the sweep already ran are checked from their
+    manifest rows — zero probes."""
+    man = _synthetic_manifest({
+        3: {"invariant": "Inv", "depth": 2, "trace_len": 3},
+        2: None,
+    })
+
+    def runner(coords):  # pragma: no cover - must not be called
+        raise AssertionError("probe fired for an already-run neighbor")
+
+    out = refine_frontier(man, runner)["Inv"]
+    assert out["demoted"] == []
+    assert [w["violates"] for w in out["witnesses"]] == [False]
+
+
+# --- jax-free contract ----------------------------------------------------
+
+
+def test_sweep_package_is_jax_free():
+    """Planning, fitting, bisection: importable and usable on an
+    operator box that never pays the accelerator cold start."""
+    code = (
+        "import sys\n"
+        "import kafka_specification_tpu.sweep as s\n"
+        "assert 'jax' not in sys.modules, 'import pulled in jax'\n"
+        "m = s.CostModel.fit([\n"
+        "    {'features': {'c:N': 1.0}, 'states': 10, 'seconds': 0.1}])\n"
+        "assert m.n_records == 1\n"
+        "lat = s.load_lattice({'schema': 'kspec-sweep-lattice/1',\n"
+        "    'name': 'jf', 'module': 'IdSequence',\n"
+        "    'cfg_text': 'SPECIFICATION Spec\\nCONSTANTS\\n"
+        "  MaxId = 2\\nINVARIANTS TypeOk\\n',\n"
+        "    'axes': [{'name': 'MaxId', 'values': [2, 3]}]})\n"
+        "pts = s.enumerate_points(lat)\n"
+        "assert len(pts) == 2 and pts[0].point_id\n"
+        "assert s.bisect_line([1, 2], lambda v: v > 1) == 1\n"
+        "assert 'jax' not in sys.modules, 'usage pulled in jax'\n"
+        "print('jaxfree-ok')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=_REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "jaxfree-ok" in out.stdout
