@@ -31,8 +31,17 @@ resilience reach the active tracer/registry through the module-level
 ``tracer.span/event`` and ``metrics.inc/set_gauge`` helpers, imported
 lazily at the call site to keep the obs <-> resilience import graph
 acyclic.
+
+Beyond the per-run boundary, :mod:`obs.fleettrace` carries one trace per
+*job* across the whole serving fleet (submit -> placement -> claim ->
+run -> publish; ``cli trace`` / ``top`` / ``fleet-report``), and
+:mod:`obs.atomicio` holds the shared atomic-publication idiom every
+side-channel writer (manifests, specs, verdicts, routes, metrics.prom,
+sweep manifests) rides.
 """
 
+from . import fleettrace
+from .atomicio import atomic_write_json, atomic_write_text
 from .metrics import MetricsRegistry
 from .observer import RunObserver
 from .report import render_report, report_data
@@ -44,7 +53,10 @@ __all__ = [
     "RunContext",
     "RunObserver",
     "SpanTracer",
+    "atomic_write_json",
+    "atomic_write_text",
     "default_run_dir",
+    "fleettrace",
     "new_run_id",
     "read_jsonl_tolerant",
     "render_report",

@@ -1,0 +1,55 @@
+"""Atomic file publication for the jax-free observability/serving plane.
+
+One tmp + flush + (optional fsync) + ``os.replace`` sequence, shared by
+every side-channel publisher that must never expose a torn file: run
+manifests (obs/runctx.py), job specs and verdicts (service/queue.py),
+route records and host tables (service/router.py), sweep manifests
+(sweep/portfolio.py), and the ``metrics.prom`` textfile export
+(obs/metrics.py).
+
+This is a deliberate copy of ``storage.atomic.atomic_write``'s sequence:
+importing the storage package would pull the native C++ FpSet into
+jax-free supervisor parents, so the serving plane keeps its own leaf
+module with zero intra-package imports.
+
+``fsync=True`` is for records whose loss would sever a lineage (a power
+loss publishing an empty manifest mints a new run_id on reopen).
+``fsync=False`` is for scrape artifacts and per-job dirs whose durable
+record lives elsewhere — at ~15ms per fsync on CI disks, five fsyncs per
+job was the serving warm path's latency floor.
+
+Must stay jax-free (imported by the router/queue/daemon import chain).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
+    """Publish ``text`` at ``path`` atomically (tmp + replace).
+
+    A reader re-opening ``path`` mid-write never sees a torn file; a
+    failed write (ENOSPC mid-dump, KeyboardInterrupt) never leaves a
+    stray ``.tmp`` behind."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(text)
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj: dict, fsync: bool = True) -> None:
+    """Publish ``obj`` as JSON at ``path`` atomically (tmp + replace)."""
+    atomic_write_text(path, json.dumps(obj, indent=1, default=str),
+                      fsync=fsync)

@@ -26,11 +26,11 @@ Must stay jax-free.
 from __future__ import annotations
 
 import json
-import os
 import threading
 from typing import Optional
 
 from ..resilience.heartbeat import heartbeat_record
+from .atomicio import atomic_write_text
 
 # histogram default buckets: per-level wall times span 4ms toy levels to
 # multi-minute deep-product levels (RUNPROD464_r5.log)
@@ -45,8 +45,13 @@ def _key(name: str, labels: dict) -> str:
 
 
 class MetricsRegistry:
-    def __init__(self, run_id: str = ""):
+    def __init__(self, run_id: str = "",
+                 const_labels: Optional[dict] = None):
+        """``const_labels`` ride on every exported sample alongside
+        ``run_id`` — the serving daemon stamps ``instance``/``host`` so N
+        fleet daemons' scraped series never collide on one name."""
         self.run_id = run_id
+        self.const_labels = dict(const_labels or {})
         self.counters: dict = {}
         self.gauges: dict = {}
         self.hists: dict = {}  # name -> {buckets, counts[], sum, count}
@@ -106,6 +111,8 @@ class MetricsRegistry:
 
     def write_jsonl(self, path: str) -> None:
         rec = heartbeat_record("metrics", run_id=self.run_id,
+                               **({"labels": self.const_labels}
+                                  if self.const_labels else {}),
                                **self.snapshot())
         with open(path, "a") as fh:
             fh.write(json.dumps(rec) + "\n")
@@ -113,7 +120,11 @@ class MetricsRegistry:
     def write_prom(self, path: str) -> None:
         """Atomic Prometheus textfile export (tmp + rename: a scraper
         re-reading the path mid-write never sees a torn file)."""
-        rid = f'run_id="{self.run_id}"'
+        rid = ",".join(
+            [f'run_id="{self.run_id}"']
+            + [f'{k}="{self.const_labels[k]}"'
+               for k in sorted(self.const_labels)]
+        )
         with self._lock:  # consistent copies: no size-change mid-iteration
             counters = dict(self.counters)
             gauges = dict(self.gauges)
@@ -156,14 +167,9 @@ class MetricsRegistry:
                 lines.append(sample(f'{n}_bucket{{le="{le}"}}', c))
             lines.append(sample(f"{n}_sum", round(h["sum"], 3)))
             lines.append(sample(f"{n}_count", h["count"]))
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            fh.write("\n".join(lines) + "\n")
-            fh.flush()
-        # atomicity (the scraper's guarantee) comes from the rename; no
-        # fsync — a scrape artifact needs no power-loss durability, and
-        # the serving daemon exports per verdict (bench.py --serve)
-        os.replace(tmp, path)
+        # no fsync — a scrape artifact needs no power-loss durability,
+        # and the serving daemon exports per verdict (bench.py --serve)
+        atomic_write_text(path, "\n".join(lines) + "\n", fsync=False)
 
 
 def _cum(counts):

@@ -607,9 +607,11 @@ def render_run_index(root: str, rows: list) -> str:
 def _parse_prom(path: str) -> dict:
     """Parse one Prometheus textfile export into ``{key: value}``.
 
-    The key is the metric name plus its labels with ``run_id`` stripped
-    (every daemon stamps its own run id; a cross-daemon rollup must sum
-    ACROSS restarts, not treat each incarnation as a new series).
+    The key is the metric name plus its labels with the daemon-identity
+    labels (``run_id``, ``instance``, ``host``) stripped: every daemon
+    stamps its own identity so scraped series never collide, but a
+    cross-daemon rollup must sum ACROSS restarts and instances, not
+    treat each incarnation as a new series.
     Histogram series are skipped — the rollup wants counters/gauges."""
     out: dict = {}
     try:
@@ -630,7 +632,9 @@ def _parse_prom(path: str) -> dict:
             continue
         kept = [
             part for part in labels.rstrip("}").split(",")
-            if part and not part.startswith("run_id=")
+            if part and not part.startswith(
+                ("run_id=", "instance=", "host=")
+            )
         ]
         if kept:
             out["{}{{{}}}".format(base, ",".join(sorted(kept)))] = value

@@ -38,6 +38,7 @@ import time
 from typing import Optional
 
 from ..resilience.heartbeat import heartbeat_record
+from .atomicio import atomic_write_json
 from .metrics import MetricsRegistry, set_registry
 from .tracer import SpanTracer, set_tracer
 
@@ -89,31 +90,9 @@ def git_describe(cwd: Optional[str] = None) -> Optional[str]:
     return out
 
 
-def _atomic_write_json(path: str, obj: dict, fsync: bool = True) -> None:
-    # same tmp+fsync+replace sequence as storage.atomic.atomic_write; a
-    # local copy because importing the storage package would pull the
-    # native C++ FpSet into jax-free supervisor parents.  fsync matters
-    # here: a power loss publishing an empty manifest would mint a new
-    # run_id on reopen and sever the restart lineage.  fsync=False is for
-    # run dirs whose durable record lives elsewhere (the serving daemon's
-    # per-job dirs: the VERDICT file is the contract; at ~15ms per fsync
-    # on CI disks, 5 fsyncs per job was the warm path's latency floor)
-    tmp = path + ".tmp"
-    try:
-        with open(tmp, "w") as fh:
-            json.dump(obj, fh, indent=1, default=str)
-            fh.flush()
-            if fsync:
-                os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        # same tmp-unlink-on-failure contract as storage.atomic: a failed
-        # write (ENOSPC mid-dump) must not leave a stray .tmp behind
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+# back-compat alias: the manifest writer moved to the public
+# obs.atomicio.atomic_write_json (fsync rationale lives there)
+_atomic_write_json = atomic_write_json
 
 
 class RunContext:

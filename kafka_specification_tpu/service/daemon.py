@@ -39,7 +39,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..engine.bfs import check
-from ..obs import RunContext
+from ..obs import RunContext, fleettrace
 from ..obs.metrics import MetricsRegistry
 from ..resilience.faults import FaultPlan, InjectedCrash, injected_skew_s
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
@@ -185,7 +185,18 @@ class Daemon:
         self._seeds: dict = {}  # job_id -> engine seed dict (cache delta)
         self._trace_buf: list = []  # solo runs' trace store (publication)
         self._janitor_last = 0.0
-        self.metrics = MetricsRegistry(run_id="service")
+        # metrics identity: the run_id distinguishes daemon INSTANCES and
+        # the const labels carry instance + host, so N fleet daemons'
+        # scraped series (which share one metric namespace) never collide
+        # on a bare run_id="service"
+        labels = {}
+        if self.instance is not None:
+            labels["instance"] = str(self.instance)
+        if os.environ.get("KSPEC_HOST_INSTANCE"):
+            labels["host"] = os.environ["KSPEC_HOST_INSTANCE"]
+        self.metrics = MetricsRegistry(
+            run_id="service" + self.metrics_suffix, const_labels=labels
+        )
         self.jobs_done = 0
         self.groups_run = 0
         self._stop = False
@@ -316,11 +327,21 @@ class Daemon:
                 max_group = int(os.environ["KSPEC_MAX_GROUP"])
             except ValueError:
                 max_group = None
+        t_plan = fleettrace.now()
         groups = (
             plan_groups(jobs, max_group=max_group)
             if self.cfg.batching
             else [[j] for j in jobs]
         )
+        for group in groups:
+            for spec, _c, _e in group:
+                fleettrace.emit_span(
+                    self.queue.dir, spec.get("trace"), "sched-group",
+                    t_plan, fleettrace.now(), job_id=spec["job_id"],
+                    group_size=len(group),
+                    leader=group[0][0]["job_id"],
+                    instance=self.instance,
+                )
         self._sweep_jobs = [
             spec["job_id"] for group in groups for spec, _c, _e in group
         ]
@@ -608,7 +629,7 @@ class Daemon:
         n = self._publish_group(
             group, members, specs, leader_spec, leader_ctx,
             solo, solo_res if solo else None, shared, t0,
-            seed_depth=seed_depth,
+            seed_depth=seed_depth, cache_entry=entry,
         )
         if solo and self.state_cache is not None and not fault:
             # completed solo run: publish it as a state-space-cache entry
@@ -643,7 +664,7 @@ class Daemon:
 
     def _publish_group(self, group, members, specs, leader_spec,
                        leader_ctx, solo, solo_res, shared, t0,
-                       seed_depth=None) -> int:
+                       seed_depth=None, cache_entry=None) -> int:
         """Derive + publish every member's verdict.  Runs with
         ``_busy_jobs`` still set (cleared by drain_once): derive_member
         jit-compiles per-(invariant, level-bucket) predicates and walks
@@ -657,6 +678,21 @@ class Daemon:
         self.metrics.inc("kspec_svc_groups_total")
         if len(group) > 1:
             self.metrics.inc("kspec_svc_batched_jobs_total", len(group))
+        # fleet-trace run window + stage histograms: the wall window is
+        # reconstructed backward from the run's end so the span's clock
+        # and the engine's perf_counter duration agree
+        t_run_end = fleettrace.now()
+        cache_hit = bool(cache_entry.get("hit")) if cache_entry else None
+        compile_ms = (
+            0.0 if cache_entry is None or cache_hit
+            else round(float(cache_entry.get("build_s") or 0.0) * 1e3, 1)
+        )
+        if compile_ms:
+            self.metrics.observe("kspec_svc_stage_compile_ms", compile_ms)
+        self.metrics.observe(
+            "kspec_svc_stage_explore_ms",
+            max(0.0, wall_s * 1e3 - compile_ms),
+        )
         for (spec, mcfg, memitted), member in zip(group, members):
             # per-member guard: a derivation/publication failure (a
             # predicate erroring on a decoded state, an OSError on a
@@ -707,6 +743,16 @@ class Daemon:
                     rec["run_id"] = ctx.run_id
                     ctx.finish(rec["status"], **_summary(rec))
                 self._finish_job(spec, rec)
+                fleettrace.emit_span(
+                    self.queue.dir, spec.get("trace"), "svc-run",
+                    t_run_end - wall_s, t_run_end,
+                    job_id=spec["job_id"],
+                    run_id=rec.get("run_id") or leader_ctx.run_id,
+                    group_size=len(group), solo=bool(solo),
+                    cache_hit=cache_hit, compile_ms=compile_ms,
+                    verdict=rec["status"], seed_depth=seed_depth,
+                    instance=self.instance,
+                )
                 if not solo and self.state_cache is not None:
                     # batched members publish VERDICT-ONLY entries (their
                     # per-level rows live only in the shared record, so
@@ -741,6 +787,21 @@ class Daemon:
         problem is a typed cache-fallback (inside lookup) + False."""
         if self.state_cache is None or spec.get("fault"):
             return False
+        t_lk = fleettrace.now()
+
+        def _trace_lookup(outcome: str, **attrs) -> None:
+            # verify stage = the chain-verify/lookup window of the shared
+            # state cache, whatever the outcome
+            t1 = fleettrace.now()
+            self.metrics.observe(
+                "kspec_svc_stage_verify_ms", max(0.0, (t1 - t_lk) * 1e3)
+            )
+            fleettrace.emit_span(
+                self.queue.dir, spec.get("trace"), "cache-lookup",
+                t_lk, t1, job_id=spec["job_id"], outcome=outcome,
+                instance=self.instance, **attrs,
+            )
+
         if self._partition_check(spec):
             # partition@host<i>: the shared cache namespace is GONE for
             # this window — degrade to a local-cold run with the typed
@@ -751,6 +812,7 @@ class Daemon:
                 jobs=[spec["job_id"]],
             )
             self.metrics.inc("kspec_svc_state_cache_fallbacks_total")
+            _trace_lookup("fallback", reason="partition")
             return False
         from .state_cache import CacheHit, CacheSeed, key_for_job
         from .verdict import VERDICT_SCHEMA
@@ -768,6 +830,7 @@ class Daemon:
                 jobs=[spec["job_id"]],
             )
             self.metrics.inc("kspec_svc_state_cache_fallbacks_total")
+            _trace_lookup("fallback", reason="lookup-error")
             return False
         if isinstance(found, CacheHit):
             rec = dict(found.verdict)
@@ -784,6 +847,7 @@ class Daemon:
             }
             self._finish_job(spec, rec)
             self.metrics.inc("kspec_svc_state_cache_hits_total")
+            _trace_lookup("hit", reason=found.reason)
             return True
         if isinstance(found, CacheSeed):
             self._seeds[spec["job_id"]] = found.seed
@@ -791,8 +855,10 @@ class Daemon:
             # plugs into check(), not the batched runner)
             spec["_state_cache_seed"] = True
             self.metrics.inc("kspec_svc_state_cache_seeds_total")
+            _trace_lookup("seed", from_depth=int(found.from_depth))
             return False
         self.metrics.inc("kspec_svc_state_cache_misses_total")
+        _trace_lookup("miss")
         return False
 
     def _partition_check(self, spec: dict) -> bool:
@@ -844,6 +910,8 @@ class Daemon:
             if self._partition_left == 0:
                 self._heal_partition()
             return
+        t_pub = fleettrace.now()
+        published = False
         try:
             key = key_for_job(
                 spec, cfg, emitted, job_invariants(spec["module"], cfg)
@@ -868,12 +936,19 @@ class Daemon:
                 diameter=res.diameter,
             ):
                 self.metrics.inc("kspec_svc_state_cache_publish_total")
+                published = True
         except Exception as e:  # noqa: BLE001 — publication is an
             # optimization: its failure must never fail the job
             self._event(
                 "cache-fallback", reason=f"publish-error: {str(e)[:200]}",
             )
             self.metrics.inc("kspec_svc_state_cache_fallbacks_total")
+        fleettrace.emit_span(
+            self.queue.dir, spec.get("trace"), "cache-publish",
+            t_pub, fleettrace.now(), job_id=jid,
+            published=published, verdict_only=level_rows is None,
+            instance=self.instance,
+        )
 
     # --- helpers ----------------------------------------------------------
     def _stamp(self, spec: dict, rec: dict, status: str,
@@ -903,10 +978,27 @@ class Daemon:
             self.metrics.observe(
                 "kspec_svc_latency_ms", rec["timing"]["latency_s"] * 1e3
             )
+        if rec["timing"]["wait_s"] is not None:
+            self.metrics.observe(
+                "kspec_svc_stage_queue_wait_ms",
+                max(0.0, rec["timing"]["wait_s"] * 1e3),
+            )
         return rec
 
     def _finish_job(self, spec: dict, rec: dict) -> None:
+        t_fin = fleettrace.now()
         self.queue.finish(spec["job_id"], rec)
+        t_done = fleettrace.now()
+        self.metrics.observe(
+            "kspec_svc_stage_publish_ms", max(0.0, (t_done - t_fin) * 1e3)
+        )
+        fleettrace.emit_span(
+            self.queue.dir, spec.get("trace"), "verdict-publish",
+            t_fin, t_done, job_id=spec["job_id"],
+            status=rec.get("status", "?"),
+            cache=(rec.get("cache") or {}).get("state_cache"),
+            instance=self.instance,
+        )
         try:  # finished jobs leave the lease-renewal set immediately
             self._sweep_jobs.remove(spec["job_id"])
         except ValueError:

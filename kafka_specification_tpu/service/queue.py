@@ -62,7 +62,8 @@ from typing import Optional
 
 # one shared tmp-write+fsync+replace idiom (jax-free like this module);
 # job/result paths are unique per writer so the fixed .tmp suffix is safe
-from ..obs.runctx import _atomic_write_json
+from ..obs import fleettrace
+from ..obs.atomicio import atomic_write_json
 
 JOB_SCHEMA = "kspec-job/1"
 
@@ -261,6 +262,11 @@ class JobQueue:
         its group's shared exploration out to ITS bounds envelope)."""
         if kernel_source not in ("auto", "emitted", "hand"):
             raise ValueError(f"bad kernel_source {kernel_source!r}")
+        # the submit span's window must come from ONE clock (the trace
+        # clock, which a skew fault shifts wholesale) — mixing the wall
+        # anchor with a skewed close stamp would tear the root span
+        # across two clock domains in a single record
+        t_sub = fleettrace.now()
         spec = {
             "schema": JOB_SCHEMA,
             "job_id": job_id or new_job_id(),
@@ -278,6 +284,13 @@ class JobQueue:
             # optional stamp (absent on non-solo specs): old daemons that
             # predate it just ignore the key — kspec-job/1 stays one schema
             spec["solo"] = True
+        # the fleet trace context rides INSIDE the spec (same optional-key
+        # contract as "solo"): it survives re-route, crash takeover, and
+        # sweep batching with zero side channels, and components that
+        # predate it no-op their stamp sites (obs/fleettrace.py)
+        spec["trace"] = fleettrace.mint_trace(
+            spec["job_id"], spec["submitted_unix"]
+        )
         # marker BEFORE the spec publish: the admission index may briefly
         # overcount a submit that dies here (lazily cleaned on the next
         # count), but can never undercount a published job.  The whole
@@ -291,9 +304,17 @@ class JobQueue:
             marker = os.path.join(tdir, spec["job_id"])
             with open(marker, "w"):
                 pass
-            _atomic_write_json(self._job_path(PENDING, spec["job_id"]), spec)
+            atomic_write_json(self._job_path(PENDING, spec["job_id"]), spec)
 
         retry_transient(publish)
+        # the trace root: anchored at submitted_unix, closing when the
+        # spec is durably visible in pending/
+        fleettrace.emit_span(
+            self.dir, spec["trace"], "job-submit",
+            t_sub, fleettrace.now(),
+            job_id=spec["job_id"], span_id=spec["trace"]["span_id"],
+            tenant=tenant, module=module,
+        )
         return spec
 
     def status(self, job_id: str) -> dict:
@@ -434,6 +455,7 @@ class JobQueue:
                 break
             src = self._job_path(PENDING, job_id)
             dst = self._job_path(CLAIMED, job_id)
+            t_claim = fleettrace.now()
             try:
                 os.rename(src, dst)
             except OSError:
@@ -455,6 +477,11 @@ class JobQueue:
                         f"unsupported job schema {spec.get('schema')!r}"
                     )
                 spec["claimed_unix"] = round(time.time(), 3)
+                fleettrace.emit_span(
+                    self.dir, spec.get("trace"), "queue-claim",
+                    t_claim, fleettrace.now(), job_id=job_id,
+                    claimer_pid=os.getpid(),
+                )
                 out.append(spec)
             except FileNotFoundError:
                 # the claim vanished after we won the rename — a sibling
@@ -610,30 +637,30 @@ class JobQueue:
                 except OSError:
                     pass
                 continue
+            spec = None
+            takeover = {
+                "from_pid": lease.get("pid") if lease else None,
+                "by_pid": os.getpid(),
+                "reason": (
+                    "no-lease" if lease is None else "lease-expired"
+                    if time.time() - float(lease.get("lease_unix", 0))
+                    >= float(
+                        lease_ttl
+                        if lease_ttl is not None
+                        else os.environ.get(
+                            "KSPEC_CLAIM_LEASE_TTL",
+                            DEFAULT_LEASE_TTL,
+                        )
+                    ) + clock_skew_s()
+                    else "dead-pid"
+                ),
+                "at": round(time.time(), 3),
+            }
             try:
                 with open(private) as fh:
                     spec = json.load(fh)
-                spec.setdefault("takeovers", []).append(
-                    {
-                        "from_pid": lease.get("pid") if lease else None,
-                        "by_pid": os.getpid(),
-                        "reason": (
-                            "no-lease" if lease is None else "lease-expired"
-                            if time.time() - float(lease.get("lease_unix", 0))
-                            >= float(
-                                lease_ttl
-                                if lease_ttl is not None
-                                else os.environ.get(
-                                    "KSPEC_CLAIM_LEASE_TTL",
-                                    DEFAULT_LEASE_TTL,
-                                )
-                            ) + clock_skew_s()
-                            else "dead-pid"
-                        ),
-                        "at": round(time.time(), 3),
-                    }
-                )
-                _atomic_write_json(private, spec)
+                spec.setdefault("takeovers", []).append(takeover)
+                atomic_write_json(private, spec)
             except (OSError, ValueError):
                 pass  # attribution is best-effort; the requeue is not
             try:
@@ -642,6 +669,17 @@ class JobQueue:
                 moved.append(job_id)
             except OSError:
                 pass
+            else:
+                # crash adoption is an ANNOTATION on the job's one trace,
+                # not a new trace: the context rode inside the spec
+                fleettrace.emit_event(
+                    self.dir,
+                    spec.get("trace") if isinstance(spec, dict) else None,
+                    "queue-requeue", job_id=job_id,
+                    from_pid=takeover["from_pid"],
+                    by_pid=takeover["by_pid"],
+                    reason=takeover["reason"],
+                )
         # dangling leases (spec vanished mid-claim, or retired without
         # cleanup by an older daemon) are dead weight: sweep them
         try:
@@ -696,7 +734,7 @@ class JobQueue:
 
             verdict = error_verdict(error or "unknown failure")
             verdict["job_id"] = job_id
-        _atomic_write_json(self.result_path(job_id), verdict)
+        atomic_write_json(self.result_path(job_id), verdict)
         claimed = self._job_path(CLAIMED, job_id)
         if os.path.isfile(claimed):
             try:

@@ -56,7 +56,8 @@ import os
 import time
 from typing import Optional
 
-from ..obs.runctx import _atomic_write_json
+from ..obs import fleettrace
+from ..obs.atomicio import atomic_write_json
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
 from ..resilience.resources import budget_for_tenant, load_tenant_budgets
 from .queue import (
@@ -151,7 +152,7 @@ class Router:
         if cfg is None or cfg.get("hosts") != self.hosts or (
             float(cfg.get("dead_after_s", -1.0)) != self.dead_after_s
         ):
-            _atomic_write_json(
+            atomic_write_json(
                 self.config_path,
                 {
                     "schema": ROUTER_SCHEMA,
@@ -318,7 +319,9 @@ class Router:
         placement (or an explicit ``host`` pin — the operator escape
         hatch), then the chosen host queue's own atomic submit.  Returns
         the published spec with ``spec['host']`` set."""
+        t_place = fleettrace.now()
         self._check_admission(tenant)
+        pinned = host is not None
         if host is None:
             host = self._choose_host(self.healths(), module=module)
         elif not (0 <= host < len(self.queues)):
@@ -331,6 +334,13 @@ class Router:
         self._write_route(spec["job_id"], host, why="submit")
         self._event(
             "route-submit", job=spec["job_id"], host=host, tenant=tenant,
+        )
+        # placement span lands under the ROUTER dir: the router is its
+        # own clock domain, and `cli trace` unions it with the hosts'
+        fleettrace.emit_span(
+            self.dir, spec.get("trace"), "route-place",
+            t_place, fleettrace.now(), job_id=spec["job_id"],
+            to_host=host, why="pinned" if pinned else "health",
         )
         spec["host"] = host
         return spec
@@ -351,7 +361,7 @@ class Router:
             {"host": host, "why": why, "at": round(time.time(), 3)}
         )
         try:
-            _atomic_write_json(self._route_path(job_id), rec)
+            atomic_write_json(self._route_path(job_id), rec)
         except OSError:
             pass  # resolution falls back to the all-hosts scan
 
@@ -526,13 +536,13 @@ class Router:
                         "at": round(time.time(), 3),
                     }
                 )
-                _atomic_write_json(private, spec)
+                atomic_write_json(private, spec)
                 tq = self.queues[target]
                 tdir = tq._tenant_dir(spec.get("tenant", "default"))
                 os.makedirs(tdir, exist_ok=True)
                 with open(os.path.join(tdir, job_id), "w"):
                     pass
-                _atomic_write_json(tq._job_path(PENDING, job_id), spec)
+                atomic_write_json(tq._job_path(PENDING, job_id), spec)
             except (OSError, ValueError):
                 # cannot complete the move: put the job back where one
                 # actor-at-a-time recovery can retry it
@@ -549,6 +559,13 @@ class Router:
             self._event(
                 "route-reroute", job=job_id, from_host=dead,
                 to_host=target,
+            )
+            # the re-route is a typed annotation on the job's ONE trace
+            # (the context rode inside the spec), never a gap in it
+            fleettrace.emit_event(
+                self.dir, spec.get("trace"), "route-reroute",
+                job_id=job_id, from_host=dead, to_host=target,
+                reason="host-dead",
             )
             depths[target] += 1
             moved.append(job_id)

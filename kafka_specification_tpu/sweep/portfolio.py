@@ -45,7 +45,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..obs.runctx import _atomic_write_json
+from ..obs import fleettrace
+from ..obs.atomicio import atomic_write_json
 from .cost import CostModel, features_from, fit_from_corpus
 from .lattice import LatticeSpec, annotate_vacuous, enumerate_points
 
@@ -176,7 +177,7 @@ class Manifest:
 
     def promote(self) -> None:
         self.rec["updated_unix"] = round(time.time(), 3)
-        _atomic_write_json(self.path, self.rec)
+        atomic_write_json(self.path, self.rec)
 
     def row(self, point_id: str) -> Optional[dict]:
         return self.rec["points"].get(point_id)
@@ -360,7 +361,17 @@ def run_sweep(lattice: LatticeSpec, cfg: SweepConfig,
                 p, row, _d = fresh[idx]
                 idx += 1
                 jid = job_id_for(sweep_id, p.point_id)
-                dispatch.submit(p, jid, solo=bool(row.get("solo")))
+                spec = dispatch.submit(p, jid, solo=bool(row.get("solo")))
+                # portfolio membership is a trace annotation: `cli trace`
+                # on any sweep job names its sweep without a side lookup
+                fleettrace.emit_event(
+                    dispatch.backend.dir, spec.get("trace"),
+                    "sweep-member", job_id=jid, sweep_id=sweep_id,
+                    point_id=p.point_id, solo=bool(row.get("solo")),
+                    predicted_states=(row.get("predicted") or {}).get(
+                        "states"
+                    ),
+                )
                 row["status"] = "submitted"
                 row["job_id"] = jid
                 outstanding[jid] = row

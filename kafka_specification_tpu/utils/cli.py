@@ -800,6 +800,59 @@ def main(argv=None):
     )
     proute.add_argument("--json", action="store_true")
 
+    ptr = sub.add_parser(
+        "trace",
+        help="render one job's fleet-wide distributed trace "
+        "(submit -> placement -> claim -> run -> publish) as a "
+        "skew-normalized cross-host span waterfall with the typed "
+        "stage decomposition — never imports jax "
+        "(docs/observability.md § Fleet traces)",
+    )
+    ptr.add_argument("job_id")
+    ptr.add_argument(
+        "--service-dir", action="append", metavar="DIR",
+        help="service root(s) whose traces/ to read (repeatable; "
+        "default: $KSPEC_SERVICE_DIR or ./service)",
+    )
+    ptr.add_argument(
+        "--router", metavar="DIR",
+        help="read the router dir's traces/ plus every fronted host's "
+        "(a re-routed job's spans live on both sides)",
+    )
+    ptr.add_argument("--json", action="store_true")
+
+    ptop = sub.add_parser(
+        "top",
+        help="live fleet view from on-disk state only: queue depths, "
+        "daemon heartbeats, per-stage p50/p95, cache hit ratio, sweep "
+        "progress — never imports jax",
+    )
+    ptop.add_argument("--service-dir", action="append", metavar="DIR",
+                      help="service root(s) to watch (repeatable)")
+    ptop.add_argument("--router", metavar="DIR",
+                      help="watch every host behind a router directory")
+    ptop.add_argument("--once", action="store_true",
+                      help="print one frame and exit")
+    ptop.add_argument("--interval", type=float, default=2.0,
+                      help="refresh seconds (default 2.0)")
+    ptop.add_argument("--json", action="store_true",
+                      help="print one JSON frame and exit (implies --once)")
+
+    pfr = sub.add_parser(
+        "fleet-report",
+        help="SLO artifact over every completed trace: per-stage "
+        "latency histograms (p50/p95), cache hit ratio, slowest-job "
+        "exemplars, chaos annotations (re-routes, requeues) — never "
+        "imports jax; nightly_sweep.sh banks it per night",
+    )
+    pfr.add_argument("--service-dir", action="append", metavar="DIR",
+                     help="service root(s) whose traces/ to aggregate")
+    pfr.add_argument("--router", metavar="DIR",
+                     help="aggregate the router dir plus every fronted host")
+    pfr.add_argument("--exemplars", type=int, default=5,
+                     help="slowest-job exemplar count (default 5)")
+    pfr.add_argument("--json", action="store_true")
+
     psw = sub.add_parser(
         "sweep",
         help="coverage sweeps over a config lattice (kspec-sweep-lattice/1"
@@ -1065,6 +1118,12 @@ def main(argv=None):
         # the router is operator infrastructure for a degraded fleet:
         # jax-free by contract, like the clients it fronts
         return _run_router(args)
+
+    if args.cmd in ("trace", "top", "fleet-report"):
+        # fleet observability reads side-channel files only (traces/,
+        # heartbeats, metrics.prom): jax-free by contract — it is the
+        # view an operator opens WHILE the fleet is degraded
+        return _run_fleet_obs(args)
 
     if args.cmd == "sweep":
         # sweep planning/dispatch/reporting is a queue/router CLIENT:
@@ -1583,6 +1642,22 @@ def _run_analyze(args) -> int:
     if not args.no_engine:
         targets.append("engine sources (ownership + purity)")
         findings.extend(analyze_engine_sources())
+        # span-kind vocabulary lint (obs/fleettrace registries): every
+        # span/event emitted anywhere in the package must name a
+        # registered kind, and every registered kind must appear in
+        # docs/observability.md — an undocumented or typo'd kind would
+        # silently vanish from `cli trace`'s stage decomposition
+        targets.append("trace vocabulary (obs/fleettrace registries)")
+        from ..analysis import Finding
+        from ..obs.fleettrace import lint_trace_vocabulary
+
+        for prob in lint_trace_vocabulary():
+            findings.append(Finding(
+                kind="trace-vocab", severity="HIGH",
+                target=f"{prob['path']}:{prob['line']}",
+                message=prob["problem"],
+                data=dict(prob),
+            ))
 
     rec = analysis_record(findings, targets=targets)
     if args.json:
@@ -1679,6 +1754,76 @@ def _run_router(args) -> int:
     except KeyboardInterrupt:
         pass
     return 0
+
+
+def _run_fleet_obs(args) -> int:
+    """`cli trace|top|fleet-report`: the fleet trace plane's read side
+    (obs/fleettrace.py, docs/observability.md § Fleet traces).  Jax-free
+    by contract — everything renders from side-channel files."""
+    from ..obs import fleettrace as ft
+
+    router_dir = getattr(args, "router", None)
+    if router_dir:
+        from ..service.router import Router
+
+        try:
+            router = Router(router_dir)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        roots = [router.dir] + [q.dir for q in router.queues]
+        host_roots = [q.dir for q in router.queues]
+    else:
+        host_roots = [
+            os.path.normpath(d)
+            for d in (getattr(args, "service_dir", None)
+                      or [_service_dir(None)])
+        ]
+        roots = host_roots
+
+    if args.cmd == "trace":
+        recs = ft.load_trace(roots, args.job_id)
+        if not recs:
+            print(
+                f"no trace for job {args.job_id} under "
+                + ", ".join(roots),
+                file=sys.stderr,
+            )
+            return 1
+        data = ft.assemble(recs, job_id=args.job_id)
+        print(json.dumps(data, default=str) if args.json
+              else ft.render_trace(data))
+        return 0
+
+    if args.cmd == "fleet-report":
+        data = ft.fleet_report_data(roots, exemplars=args.exemplars)
+        if args.json:
+            print(json.dumps(data, default=str))
+        else:
+            print(ft.render_fleet_report(data))
+        return 0
+
+    # top: one frame under --once/--json, else redraw until interrupted
+    if args.json:
+        print(json.dumps(
+            ft.top_data(host_roots, router_dir=router_dir), default=str
+        ))
+        return 0
+    try:
+        while True:
+            frame = ft.render_top(
+                ft.top_data(host_roots, router_dir=router_dir)
+            )
+            if args.once:
+                print(frame)
+                return 0
+            # whole-frame redraw: clear + home, then the frame (the
+            # watch(1) idiom; no curses dependency)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
 
 
 def _run_sweep(args) -> int:

@@ -11,7 +11,10 @@
 #   4. a fresh repeat sweep against the same service is all state-cache
 #      hits (the cache-incremental contract);
 #   5. `cli sweep report` renders coverage + scaling laws from nothing
-#      but the manifest on disk.
+#      but the manifest on disk;
+#   6. the fleet trace plane saw every job: `cli fleet-report` banks a
+#      per-stage latency SLO artifact with a sane cache-hit ratio, and
+#      `cli trace` renders a complete waterfall for a sweep job.
 #
 # Usage: scripts/nightly_sweep.sh [workdir]   (default: mktemp -d)
 set -euo pipefail
@@ -21,6 +24,7 @@ export JAX_PLATFORMS=cpu
 KSPEC="${PYTHON:-python} -m kafka_specification_tpu.utils.cli"
 
 WORK="${1:-$(mktemp -d /tmp/kspec-nightly-sweep.XXXXXX)}"
+mkdir -p "$WORK"
 SVC="$WORK/svc"
 LATTICE="$WORK/lattice.json"
 echo "# nightly sweep in $WORK"
@@ -110,5 +114,32 @@ $KSPEC sweep report "$WORK/sweep1"
 REPORT=$($KSPEC report "$WORK/sweep1")
 echo "$REPORT" | grep -q "Sweep nightly" \
     || { echo "FAIL: cli report did not detect the sweep dir"; exit 1; }
+
+# 6. fleet traces: bank the nightly SLO artifact and sanity-check it —
+# every completed job left a trace, stages decompose, the repeat sweep
+# shows up as cache hits
+$KSPEC fleet-report --service-dir "$SVC" --json \
+    > "$WORK/fleet-report.json"
+$KSPEC fleet-report --service-dir "$SVC"
+python - "$WORK/fleet-report.json" "$SVC" <<'EOF'
+import json, os, sys
+rep = json.load(open(sys.argv[1]))
+svc = sys.argv[2]
+done = len(os.listdir(os.path.join(svc, "queue", "done")))
+assert rep["traces"] >= done > 0, (rep["traces"], done)
+assert rep["completed"] > 0, "no trace reached verdict-publish"
+st = rep["stages"]
+assert st.get("queue-wait", {}).get("p50_ms") is not None, st
+assert st.get("publish", {}).get("p50_ms") is not None, st
+cache = rep["cache"]
+assert cache["hit"] > 0 and cache["hit_ratio"] > 0, cache
+print(f"# fleet-report ok: {rep['traces']} traces, "
+      f"{rep['completed']} complete, "
+      f"hit ratio {cache['hit_ratio']}")
+EOF
+# a complete single-job waterfall renders for some done job
+JOB=$(ls "$SVC/queue/done" | head -1); JOB="${JOB%.json}"
+$KSPEC trace "$JOB" --service-dir "$SVC" | grep -q "verdict-publish" \
+    || { echo "FAIL: trace $JOB has no verdict-publish span"; exit 1; }
 
 echo "# nightly sweep OK"

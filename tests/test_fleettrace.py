@@ -1,0 +1,450 @@
+"""Fleet trace plane (obs/fleettrace.py; docs/observability.md § Fleet
+traces).
+
+One trace per job across the serving fleet: context minted at submit and
+carried inside the spec, untearable per-host span appends, cross-host
+reassembly with clock-skew normalization (no negative stage durations —
+ever), the typed stage decomposition, `cli trace`/`top`/`fleet-report`,
+the span-kind vocabulary lint, and the shared atomic-write helper
+(obs/atomicio.py) the side-channel writers ride.  All jax-free.  The
+cross-host chaos acceptance (kill@host + skew@host yielding one coherent
+trace) lives in test_router.py::test_cross_host_chaos_e2e.
+"""
+
+import json
+import os
+
+import pytest
+
+from kafka_specification_tpu.obs import fleettrace as ft
+from kafka_specification_tpu.obs.atomicio import (
+    atomic_write_json,
+    atomic_write_text,
+)
+from kafka_specification_tpu.obs.metrics import MetricsRegistry
+from kafka_specification_tpu.obs.tracer import read_jsonl_tolerant
+from kafka_specification_tpu.utils.cli import main as cli_main
+
+
+pytestmark = pytest.mark.obs
+
+
+# --- context + emission ----------------------------------------------------
+
+
+def test_mint_emit_load_roundtrip(tmp_path):
+    root = str(tmp_path)
+    trace = ft.mint_trace("job-1", 1000.0)
+    assert trace["trace_id"] == "tr-job-1"
+    assert trace["anchor_unix"] == 1000.0
+    sid = ft.emit_span(root, trace, "job-submit", 1000.0, 1000.5,
+                       job_id="job-1", span_id=trace["span_id"],
+                       tenant="default")
+    assert sid == trace["span_id"]
+    child = ft.emit_span(root, trace, "queue-claim", 1000.6, 1000.7,
+                         job_id="job-1", parent_id=sid)
+    assert child and child != sid
+    assert ft.emit_event(root, trace, "queue-requeue", job_id="job-1",
+                         reason="lease-expired")
+    recs = ft.load_trace([root], "job-1")
+    assert [r["kind"] for r in recs] == ["span", "span", "event"]
+    spans = [r for r in recs if r["kind"] == "span"]
+    assert spans[0]["span"] == "job-submit"
+    assert spans[0]["ms"] == 500.0
+    assert spans[1]["parent_id"] == sid
+    assert all(r["trace_id"] == "tr-job-1" for r in recs)
+    assert all(r["pid"] == os.getpid() for r in recs)
+    # one file per job under <root>/traces/
+    assert os.path.isfile(ft.trace_path(root, "job-1"))
+    assert ft.list_trace_jobs([root]) == ["job-1"]
+
+
+def test_stamps_noop_without_trace_context(tmp_path):
+    """Specs predating the trace plane (trace key absent) flow through
+    every stamp site unchanged — nothing raises, nothing is written."""
+    root = str(tmp_path)
+    for trace in (None, {}, {"span_id": "x"}):
+        assert ft.emit_span(root, trace, "job-submit", 0.0, 1.0,
+                            job_id="j") is None
+        assert ft.emit_event(root, trace, "queue-requeue",
+                             job_id="j") is False
+    assert not os.path.exists(os.path.join(root, "traces"))
+
+
+def test_unregistered_kind_is_loud(tmp_path):
+    trace = ft.mint_trace("j", 0.0)
+    with pytest.raises(ValueError, match="unregistered fleet span"):
+        ft.emit_span(str(tmp_path), trace, "made-up", 0.0, 1.0, job_id="j")
+    with pytest.raises(ValueError, match="unregistered fleet event"):
+        ft.emit_event(str(tmp_path), trace, "made-up", job_id="j")
+
+
+def test_fleet_span_contextmanager_crash_realism(tmp_path):
+    """The ctx-manager span is emitted on NORMAL exit only: an exception
+    propagates with nothing written — partial traces show what a dead
+    incarnation finished, never what it was mid-way through."""
+    root = str(tmp_path)
+    trace = ft.mint_trace("j", 0.0)
+    with pytest.raises(RuntimeError):
+        with ft.fleet_span(root, trace, "svc-run", job_id="j"):
+            raise RuntimeError("killed mid-run")
+    assert ft.load_trace([root], "j") == []
+    with ft.fleet_span(root, trace, "svc-run", job_id="j") as extra:
+        extra["verdict"] = "complete"
+    (rec,) = ft.load_trace([root], "j")
+    assert rec["span"] == "svc-run" and rec["verdict"] == "complete"
+
+
+def test_torn_final_line_never_breaks_reassembly(tmp_path):
+    """A host killed mid-append tears at most its own final line; the
+    reader skips exactly that and the trace still assembles."""
+    root = str(tmp_path)
+    trace = ft.mint_trace("j", 100.0)
+    ft.emit_span(root, trace, "job-submit", 100.0, 100.1, job_id="j",
+                 span_id=trace["span_id"])
+    ft.emit_span(root, trace, "queue-claim", 100.2, 100.3, job_id="j")
+    path = ft.trace_path(root, "j")
+    with open(path, "a") as fh:
+        # the kill-mid-write torn tail: a partial single write is a
+        # PREFIX of the newline-led payload
+        fh.write('\n{"kind": "span", "span": "svc-ru')
+    recs = ft.load_trace([root], "j")
+    assert len(recs) == 2
+    data = ft.assemble(recs, job_id="j")
+    assert [s["span"] for s in data["spans"]] == ["job-submit",
+                                                  "queue-claim"]
+    assert data["stages"]["queue-wait"] is not None
+    # appends after the tear still reassemble (O_APPEND keeps each
+    # write a whole line; only the torn line itself is lost)
+    ft.emit_span(root, trace, "verdict-publish", 100.4, 100.5, job_id="j")
+    data = ft.assemble(ft.load_trace([root], "j"), job_id="j")
+    assert data["complete"]
+
+
+def test_emit_survives_unwritable_root(tmp_path):
+    """Telemetry must never take a component down: an unwritable traces
+    dir reads as a dropped record, not an exception."""
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the root should be")
+    trace = ft.mint_trace("j", 0.0)
+    assert ft.emit_span(str(blocked), trace, "job-submit", 0.0, 1.0,
+                        job_id="j") is None
+    assert ft.emit_event(str(blocked), trace, "sweep-member",
+                         job_id="j") is False
+
+
+# --- skew normalization ----------------------------------------------------
+
+
+def _rec(kind, span, t0, ms, host, pid=1, anchor=1000.0, **extra):
+    rec = {"kind": kind, "trace_id": "tr-j", "job_id": "j",
+           "anchor_unix": anchor, "host": host, "pid": pid, **extra}
+    if kind == "span":
+        rec.update(span=span, t0=t0, ms=ms, unix=t0 + ms / 1e3)
+    else:
+        rec.update(event=span, unix=t0)
+    return rec
+
+
+def test_skew_normalization_no_negative_stages():
+    """A claimer host running BEHIND the submitter stamps its spans
+    before the submit anchor; normalization pulls that whole clock
+    domain forward and every derived stage is >= 0."""
+    anchor = 1000.0
+    records = [
+        _rec("span", "job-submit", 1000.0, 50.0, host="0"),
+        # host 1 runs 2s behind: raw claim stamp predates the anchor
+        _rec("span", "queue-claim", 998.5, 10.0, host="1"),
+        _rec("span", "svc-run", 998.6, 200.0, host="1", compile_ms=40.0),
+        _rec("span", "verdict-publish", 998.9, 5.0, host="1"),
+        _rec("event", "queue-requeue", 998.55, 0.0, host="1"),
+    ]
+    data = ft.assemble(records, job_id="j")
+    assert data["shifts"] == {"1:1": 1.5}
+    for s, v in data["stages"].items():
+        assert v is None or v >= 0, (s, v)
+    assert data["stages"]["queue-wait"] == 0.0  # clamped, not -1500
+    assert data["stages"]["compile"] == 40.0
+    assert data["stages"]["explore"] == 160.0
+    assert data["complete"]
+    assert data["hosts"] == ["0", "1"]
+    assert data["events"][0]["tn"] >= 0
+    # domains AHEAD of the anchor are left alone (stamps stay ordered)
+    ahead = ft.assemble([
+        _rec("span", "job-submit", 1000.0, 50.0, host="0"),
+        _rec("span", "queue-claim", 1003.0, 10.0, host="1"),
+    ], job_id="j")
+    assert ahead["shifts"] == {}
+    assert ahead["stages"]["queue-wait"] == 3000.0
+
+
+def test_skewed_emitter_end_to_end(tmp_path, monkeypatch):
+    """skew@host0 shifts the fleet-trace clock exactly like heartbeat
+    stamps; the assembled trace normalizes it away."""
+    monkeypatch.setenv("KSPEC_FAULT", "skew@host0:-3.0")
+    monkeypatch.setenv("KSPEC_HOST_INSTANCE", "0")
+    root = str(tmp_path)
+    anchor = ft.now() + 3.0  # the (unskewed) submitter's wall clock
+    trace = ft.mint_trace("j", anchor)
+    t0 = ft.now()
+    ft.emit_span(root, trace, "queue-claim", t0, t0 + 0.01, job_id="j")
+    (rec,) = ft.load_trace([root], "j")
+    assert rec["host"] == "0"
+    assert rec["t0"] < anchor  # raw stamp predates the submit instant
+    data = ft.assemble([rec], job_id="j")
+    assert data["stages"]["queue-wait"] == 0.0
+    assert data["spans"][0]["t0n"] >= 0
+
+
+# --- rendering + reports ---------------------------------------------------
+
+
+def _write_complete_trace(root, job_id, anchor, slow_ms=10.0):
+    trace = ft.mint_trace(job_id, anchor)
+    t = anchor
+    ft.emit_span(root, trace, "job-submit", t, t + 0.002, job_id=job_id,
+                 span_id=trace["span_id"])
+    ft.emit_span(root, trace, "queue-claim", t + 0.05, t + 0.051,
+                 job_id=job_id)
+    ft.emit_span(root, trace, "cache-lookup", t + 0.06, t + 0.061,
+                 job_id=job_id, outcome="miss")
+    ft.emit_span(root, trace, "svc-run", t + 0.07, t + 0.07 + slow_ms / 1e3,
+                 job_id=job_id, compile_ms=slow_ms / 2, verdict="complete")
+    ft.emit_span(root, trace, "verdict-publish", t + 0.2, t + 0.201,
+                 job_id=job_id)
+    return trace
+
+
+def test_render_trace_waterfall(tmp_path):
+    root = str(tmp_path)
+    trace = _write_complete_trace(root, "j1", 1000.0)
+    ft.emit_event(root, trace, "route-reroute", job_id="j1",
+                  from_host=0, to_host=1, reason="host-dead")
+    data = ft.assemble(ft.load_trace([root], "j1"), job_id="j1")
+    out = ft.render_trace(data)
+    for needle in ("tr-j1", "job-submit", "svc-run", "verdict-publish",
+                   "route-reroute", "queue-wait", "stages:"):
+        assert needle in out, needle
+    assert "incomplete" not in out
+
+
+def test_fleet_report_data_and_render(tmp_path):
+    root = str(tmp_path)
+    _write_complete_trace(root, "j1", 1000.0, slow_ms=10.0)
+    _write_complete_trace(root, "j2", 2000.0, slow_ms=400.0)
+    # an incomplete trace (no verdict) is counted but not in the SLOs
+    t3 = ft.mint_trace("j3", 3000.0)
+    ft.emit_span(root, t3, "job-submit", 3000.0, 3000.01, job_id="j3",
+                 span_id=t3["span_id"])
+    ft.emit_event(root, t3, "queue-requeue", job_id="j3", reason="dead-pid")
+    rep = ft.fleet_report_data([root, root])  # duplicate roots dedup
+    assert rep["roots"] == [root]
+    assert rep["traces"] == 3 and rep["completed"] == 2
+    assert rep["stages"]["queue-wait"]["n"] == 2
+    assert rep["stages"]["explore"]["p95_ms"] >= 195.0
+    assert rep["cache"] == {"lookups": 2, "hit": 0, "seed": 0,
+                            "miss": 2, "fallback": 0, "hit_ratio": 0.0}
+    assert rep["annotations"] == {"queue-requeue": 1}
+    assert rep["slowest"][0]["job_id"] == "j2"
+    out = ft.render_fleet_report(rep)
+    assert "2 completed" in out and "slowest j2" in out
+    assert "queue-requeue=1" in out
+
+
+def test_top_data_reads_fleet_state(tmp_path):
+    """`cli top` state comes from disk alone: queue dirs, heartbeat
+    tails, and the daemons' prom histograms/counters."""
+    root = str(tmp_path)
+    svc = os.path.join(root, "service")
+    os.makedirs(os.path.join(root, "queue", "pending"))
+    os.makedirs(os.path.join(root, "queue", "claimed"))
+    os.makedirs(os.path.join(root, "queue", "done"))
+    os.makedirs(svc)
+    for sub, names in (("pending", ["sw-a-p1", "j9"]),
+                       ("claimed", ["sw-a-p2"]), ("done", ["sw-a-p3"])):
+        for n in names:
+            with open(os.path.join(root, "queue", sub, n + ".json"), "w"):
+                pass
+    with open(os.path.join(svc, "heartbeat.jsonl"), "w") as fh:
+        fh.write(json.dumps({"kind": "service-heartbeat", "unix": 1.0,
+                             "state": "idle", "pid": 7}) + "\n")
+    m = MetricsRegistry(run_id="service", const_labels={"host": "0"})
+    m.inc("kspec_svc_state_cache_hits_total", 3)
+    m.inc("kspec_svc_state_cache_misses_total", 1)
+    m.observe("kspec_svc_stage_queue_wait_ms", 50.0)
+    m.observe("kspec_svc_stage_queue_wait_ms", 150.0)
+    m.write_prom(os.path.join(svc, "metrics.prom"))
+    data = ft.top_data([root])
+    (host,) = data["hosts"]
+    assert (host["pending"], host["claimed"], host["done"]) == (2, 1, 1)
+    assert host["daemons"][0]["state"] == "idle"
+    assert data["sweep"] == {"pending": 1, "claimed": 1, "done": 1}
+    assert data["cache"]["hit_ratio"] == 0.75
+    qw = data["stages"]["queue-wait"]
+    assert qw["n"] == 2 and qw["p50_ms"] is not None
+    assert "queue-wait" in ft.render_top(data)
+
+
+def test_cli_trace_top_fleet_report(tmp_path, capsys):
+    root = str(tmp_path / "svc")
+    os.makedirs(os.path.join(root, "queue", "pending"))
+    _write_complete_trace(root, "j1", 1000.0)
+    assert cli_main(["trace", "j1", "--service-dir", root]) == 0
+    assert "verdict-publish" in capsys.readouterr().out
+    assert cli_main(["trace", "j1", "--service-dir", root,
+                     "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["complete"] and data["job_id"] == "j1"
+    assert cli_main(["trace", "nope", "--service-dir", root]) == 1
+    assert "no trace" in capsys.readouterr().err
+    assert cli_main(["top", "--once", "--service-dir", root]) == 0
+    assert "kspec top" in capsys.readouterr().out
+    assert cli_main(["fleet-report", "--service-dir", root,
+                     "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["completed"] == 1
+
+
+# --- vocabulary registry lint ----------------------------------------------
+
+
+def test_trace_vocabulary_lint_is_clean():
+    """Tier-1 pin: every literal emit site names a registered kind and
+    every registered kind is documented — the docs cannot drift."""
+    assert ft.lint_trace_vocabulary() == []
+
+
+def test_trace_vocabulary_lint_catches_drift(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "x.py").write_text(
+        'def f(tracer):\n'
+        '    with tracer.span("not-a-kind", depth=1):\n'
+        '        pass\n'
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "\n".join(f"`{k}`" for reg in (
+            ft.SPAN_KINDS, ft.EVENT_KINDS,
+            ft.ENGINE_SPAN_KINDS, ft.ENGINE_EVENT_KINDS,
+        ) for k in reg)
+    )
+    probs = ft.lint_trace_vocabulary(
+        package_root=str(pkg),
+        docs_path=str(docs / "observability.md"),
+    )
+    assert [(p["kind"], p["line"]) for p in probs] == [("not-a-kind", 2)]
+    # an undocumented registered kind is the other failure mode
+    (docs / "observability.md").write_text("`level`")
+    probs = ft.lint_trace_vocabulary(
+        package_root=str(tmp_path / "empty"),
+        docs_path=str(docs / "observability.md"),
+    )
+    missing = {p["kind"] for p in probs}
+    assert "svc-run" in missing and "level" not in missing
+    assert all(p["problem"] == "registered kind missing from docs"
+               for p in probs)
+
+
+def test_analyze_reports_trace_vocab_findings(tmp_path, monkeypatch,
+                                              capsys):
+    """`cli analyze` carries the lint: an unregistered emit kind is a
+    HIGH trace-vocab finding (exit 1)."""
+    import kafka_specification_tpu.obs.fleettrace as mod
+
+    real = mod.lint_trace_vocabulary
+    monkeypatch.setattr(
+        mod, "lint_trace_vocabulary",
+        lambda *a, **k: [{"path": "x.py", "line": 3, "kind": "bogus",
+                          "problem": "unregistered fleet span kind"}],
+    )
+    assert cli_main(["analyze", "--no-models"]) == 1
+    out = capsys.readouterr().out
+    assert "trace-vocab" in out and "x.py:3" in out
+    monkeypatch.setattr(mod, "lint_trace_vocabulary", real)
+    assert cli_main(["analyze", "--no-models"]) == 0
+
+
+# --- atomic write helper (obs/atomicio.py) ---------------------------------
+
+
+def test_atomic_write_text_and_json(tmp_path):
+    p = str(tmp_path / "out.json")
+    atomic_write_json(p, {"a": 1})
+    assert json.load(open(p)) == {"a": 1}
+    atomic_write_json(p, {"a": 2}, fsync=False)
+    assert json.load(open(p)) == {"a": 2}
+    atomic_write_text(str(tmp_path / "t.txt"), "hello\n")
+    assert open(str(tmp_path / "t.txt")).read() == "hello\n"
+    # no tmp debris on the happy path
+    assert sorted(os.listdir(tmp_path)) == ["out.json", "t.txt"]
+
+
+def test_atomic_write_cleans_tmp_on_failure(tmp_path, monkeypatch):
+    """A failed publish must leave neither a torn target nor tmp debris
+    (the long-standing _atomic_write_json contract, now shared)."""
+    p = str(tmp_path / "out.json")
+    atomic_write_json(p, {"a": 1})
+
+    def no_replace(src, dst):
+        raise OSError("promote failed")
+
+    monkeypatch.setattr(os, "replace", no_replace)
+    with pytest.raises(OSError, match="promote failed"):
+        atomic_write_json(p, {"a": 2})
+    monkeypatch.undo()
+    assert json.load(open(p)) == {"a": 1}  # old value intact
+    assert os.listdir(tmp_path) == ["out.json"]  # tmp debris unlinked
+
+
+def test_runctx_alias_and_callsites_share_helper():
+    """The promoted helper IS the runctx private (back-compat alias),
+    and the migrated call sites import from atomicio."""
+    from kafka_specification_tpu.obs import atomicio, runctx
+
+    assert runctx._atomic_write_json is atomicio.atomic_write_json
+    import kafka_specification_tpu.service.queue as queue_mod
+    import kafka_specification_tpu.service.router as router_mod
+    import kafka_specification_tpu.sweep.portfolio as portfolio_mod
+
+    for mod in (queue_mod, router_mod, portfolio_mod):
+        assert mod.atomic_write_json is atomicio.atomic_write_json
+
+
+# --- metrics identity labels (satellite: registry collision fix) -----------
+
+
+def test_metrics_const_labels_in_prom_and_rollup(tmp_path):
+    """Two daemons on one host used to export colliding
+    run_id="service" series; const labels (instance, host) keep their
+    samples distinct while the report rollup still aggregates them."""
+    svc = str(tmp_path)
+    a = MetricsRegistry(run_id="service-0",
+                        const_labels={"instance": "0", "host": "1"})
+    b = MetricsRegistry(run_id="service-1",
+                        const_labels={"instance": "1", "host": "1"})
+    a.inc("kspec_svc_jobs_total", 2, status="complete")
+    b.inc("kspec_svc_jobs_total", 3, status="complete")
+    a.write_prom(os.path.join(svc, "metrics0.prom"))
+    b.write_prom(os.path.join(svc, "metrics1.prom"))
+    text = open(os.path.join(svc, "metrics0.prom")).read()
+    assert 'instance="0"' in text and 'host="1"' in text
+    from kafka_specification_tpu.obs.report import host_metrics_rollup
+
+    rolled = host_metrics_rollup(svc)
+    assert rolled.get('kspec_svc_jobs_total{status="complete"}') == 5.0
+
+
+def test_daemon_metrics_identity_no_collision(tmp_path, monkeypatch):
+    """The daemon's registry carries its instance + host identity
+    instead of the bare run_id="service" every sibling shared."""
+    monkeypatch.setenv("KSPEC_HOST_INSTANCE", "3")
+    from kafka_specification_tpu.service.daemon import Daemon, ServeConfig
+
+    d = Daemon(ServeConfig(service_dir=str(tmp_path / "svc"),
+                           instance=7, linger_s=0.0))
+    assert d.metrics.const_labels == {"instance": "7", "host": "3"}
+    d0 = Daemon(ServeConfig(service_dir=str(tmp_path / "svc2"),
+                            linger_s=0.0))
+    assert d0.metrics.const_labels == {"host": "3"}

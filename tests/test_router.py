@@ -658,10 +658,11 @@ def test_partition_fault_degrades_defers_then_heals(tmp_path, monkeypatch):
 # --- the two-host chaos e2e (acceptance) ----------------------------------
 
 
-def test_cross_host_chaos_e2e(tmp_path, monkeypatch):
+def test_cross_host_chaos_e2e(tmp_path, monkeypatch, capsys):
     """Two 'hosts' (separate service dirs + daemons, one shared cache
     namespace, one router) under kill@host0:1 + partition@host1:1 +
-    flip@cache:1 — one composed plan string drives the whole drill:
+    flip@cache:1 + skew@host1:-0.75 — one composed plan string drives
+    the whole drill:
 
     - host 0's daemon is killed mid-job-1; its 'restart' converges
       (durable fired-marker), the claim returns via lease-expiry
@@ -676,11 +677,15 @@ def test_cross_host_chaos_e2e(tmp_path, monkeypatch):
       host 0 published — after host 0's publisher is gone.
     - a job stranded pending on dead host 0 is re-routed to host 1 by
       the sweep, exactly once, with attribution.
+    - host 1's wall clock runs 0.75 s BEHIND the submitter's
+      (skew@host1:-0.75): every job still reassembles into one coherent
+      fleet trace with non-negative normalized stage durations.
     """
     monkeypatch.setenv("KSPEC_CLAIM_LEASE_TTL", "1")
     monkeypatch.setenv("KSPEC_CLOCK_SKEW", "0.5")
     monkeypatch.setenv(
-        "KSPEC_FAULT", "kill@host0:1,partition@host1:1,flip@cache:1"
+        "KSPEC_FAULT",
+        "kill@host0:1,partition@host1:1,flip@cache:1,skew@host1:-0.75",
     )
     import kafka_specification_tpu.service.state_cache as sc_mod
     sc_mod._publish_ordinal["n"] = 0  # per-process ordinal: pin for test
@@ -805,3 +810,60 @@ def test_cross_host_chaos_e2e(tmp_path, monkeypatch):
     data = router_report_data(router.dir)
     assert {h["state"] for h in data["hosts"]} == {"dead", "ok"}
     assert data["events"].get("route-reroute") == 1
+
+    # --- one coherent fleet trace per job, across hosts and deaths ----
+    from kafka_specification_tpu.obs import fleettrace as ft
+
+    roots = [router.dir, h0, h1]
+    # j4: submitted to the dead host, re-routed, completed on host 1 —
+    # ONE trace: submit root + placement + the re-route as a typed
+    # annotation + claim + run + publish, every normalized stage >= 0
+    # even though host 1's clock ran 0.75 s behind the submitter's
+    t4 = ft.assemble(ft.load_trace(roots, j4), job_id=j4)
+    kinds4 = [s["span"] for s in t4["spans"]]
+    for k in ("job-submit", "route-place", "queue-claim",
+              "verdict-publish"):
+        assert k in kinds4, (k, kinds4)
+    # the survivor served the re-routed job from the state cache (jx's
+    # healed publish): its run stage is a chain-verified cache-lookup
+    # hit, not an svc-run engine window — the trace says exactly that
+    lk4 = [s for s in t4["spans"] if s["span"] == "cache-lookup"]
+    assert lk4 and lk4[-1]["outcome"] == "hit", kinds4
+    assert t4["complete"]
+    assert [e["event"] for e in t4["events"]] == ["route-reroute"]
+    rr = t4["events"][0]
+    assert (rr["from_host"], rr["to_host"]) == (0, 1)
+    assert rr["reason"] == "host-dead"
+    bad = {k: v for k, v in t4["stages"].items()
+           if v is not None and v < 0}
+    assert not bad, f"negative normalized stage durations: {bad}"
+    assert t4["stages"]["queue-wait"] is not None
+    assert t4["stages"]["publish"] is not None
+    # both clock domains (submitter/host-1 process switched identity
+    # mid-test) contributed spans to the one trace file set
+    assert t4["duration_ms"] is not None and t4["duration_ms"] >= 0
+
+    # j1: killed mid-job on host 0 — the dead incarnation's partial
+    # spans (a claim with no run) coexist in the SAME trace with the
+    # takeover incarnation's completion; the takeover is an annotation
+    tj1 = ft.assemble(ft.load_trace(roots, j1), job_id=j1)
+    claims = [s for s in tj1["spans"] if s["span"] == "queue-claim"]
+    assert len(claims) >= 2, "expected dead + takeover claim spans"
+    assert sum(1 for s in tj1["spans"] if s["span"] == "svc-run") == 1
+    assert [e["event"] for e in tj1["events"]] == ["queue-requeue"]
+    assert tj1["events"][0]["reason"] in ("lease-expired", "dead-pid")
+    assert tj1["complete"]
+    neg1 = {k: v for k, v in tj1["stages"].items()
+            if v is not None and v < 0}
+    assert not neg1, f"negative normalized stage durations: {neg1}"
+
+    # the operator CLI renders the aftermath from disk alone (jax-free)
+    assert cli_main(["trace", j4, "--router", router.dir]) == 0
+    out = capsys.readouterr().out
+    assert "verdict-publish" in out and "route-reroute" in out
+    assert cli_main(["fleet-report", "--router", router.dir,
+                     "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["completed"] >= 5  # every job's trace reached a verdict
+    assert rep["stages"]["publish"]["p50_ms"] is not None
+    assert rep["cache"]["hit"] >= 1  # phase 4's cross-host hit

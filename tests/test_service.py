@@ -107,9 +107,9 @@ def test_queue_orphan_requeue_and_verdict_shortcircuit(tmp_path):
     q_result = {"schema": "kspec-verdict/1", "job_id": j1,
                 "status": "complete", "exit_code": 0,
                 "distinct_states": 8}
-    from kafka_specification_tpu.service.queue import _atomic_write_json
+    from kafka_specification_tpu.obs import atomic_write_json
 
-    _atomic_write_json(q.result_path(j1), q_result)
+    atomic_write_json(q.result_path(j1), q_result)
     # the claimer "died": stamp its leases with a dead pid (our own live
     # pid would read as a live sibling daemon and be left alone — see
     # test_janitor_spares_live_sibling_claims)
