@@ -1,22 +1,39 @@
 """Atomic file publication for the jax-free observability/serving plane.
 
-One tmp + flush + (optional fsync) + ``os.replace`` sequence, shared by
-every side-channel publisher that must never expose a torn file: run
-manifests (obs/runctx.py), job specs and verdicts (service/queue.py),
-route records and host tables (service/router.py), sweep manifests
-(sweep/portfolio.py), and the ``metrics.prom`` textfile export
-(obs/metrics.py).
+One tmp + flush + (optional fsync) + ``os.replace`` + parent-dir fsync
+sequence, shared by every side-channel publisher that must never expose
+a torn file: run manifests (obs/runctx.py), job specs and verdicts
+(service/queue.py), route records and host tables (service/router.py),
+sweep manifests (sweep/portfolio.py), and the ``metrics.prom`` textfile
+export (obs/metrics.py).
 
-This is a deliberate copy of ``storage.atomic.atomic_write``'s sequence:
-importing the storage package would pull the native C++ FpSet into
-jax-free supervisor parents, so the serving plane keeps its own leaf
-module with zero intra-package imports.
+This is a deliberate copy of ``storage.atomic.atomic_write``'s full
+sequence — including the parent-directory fsync after the promote, which
+this module historically omitted: without it a power loss after the
+``os.replace`` but before the directory entry hits disk reverts the
+rename, so an *acknowledged* publish (a job the client was told is in
+pending/) could silently vanish.  The crashcheck harness
+(``resilience/crashcheck``) enumerates exactly that state and keeps this
+fixed.  Importing the storage package would pull the native C++ FpSet
+into jax-free supervisor parents, so the serving plane keeps its own
+leaf; both twins now share their primitives through the stdlib-only
+``durable_io`` leaf (the crash-harness interposition point), which keeps
+the zero-heavy-import contract intact.
 
 ``fsync=True`` is for records whose loss would sever a lineage (a power
 loss publishing an empty manifest mints a new run_id on reopen).
 ``fsync=False`` is for scrape artifacts and per-job dirs whose durable
 record lives elsewhere — at ~15ms per fsync on CI disks, five fsyncs per
-job was the serving warm path's latency floor.
+job was the serving warm path's latency floor.  The parent-dir fsync is
+tied to the same flag: a caller that opted out of data durability gets
+no rename durability barrier either.
+
+``tmp_nonce`` privatises the tmp name (``path.<nonce>.tmp``) for callers
+whose writers race each other to the SAME final path (router route
+records, sweep manifests): with the default shared ``path.tmp`` one
+racer can replace/unlink the sibling's half-written tmp out from under
+it (the PR 16 torn-promote precedent).  Nonce'd names still match the
+startup janitor's ``sweep_tmp`` pattern.
 
 Must stay jax-free (imported by the router/queue/daemon import chain).
 """
@@ -26,30 +43,39 @@ from __future__ import annotations
 import json
 import os
 
+from .. import durable_io as _dio
 
-def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
-    """Publish ``text`` at ``path`` atomically (tmp + replace).
+
+def atomic_write_text(path: str, text: str, fsync: bool = True,
+                      tmp_nonce: str = None) -> None:
+    """Publish ``text`` at ``path`` atomically (tmp + replace + dir
+    fsync).
 
     A reader re-opening ``path`` mid-write never sees a torn file; a
     failed write (ENOSPC mid-dump, KeyboardInterrupt) never leaves a
-    stray ``.tmp`` behind."""
-    tmp = path + ".tmp"
+    stray ``.tmp`` behind; with ``fsync=True`` the publish survives a
+    power loss (data fsync before the promote, directory fsync after)."""
+    tmp = path + ".tmp" if tmp_nonce is None else f"{path}.{tmp_nonce}.tmp"
     try:
         with open(tmp, "w") as fh:
             fh.write(text)
             fh.flush()
             if fsync:
                 os.fsync(fh.fileno())
-        os.replace(tmp, path)
+        _dio.note_write(tmp, fsynced=fsync)
+        _dio.replace(tmp, path)
     except BaseException:
         try:
-            os.unlink(tmp)
+            _dio.unlink(tmp)
         except OSError:
             pass
         raise
+    if fsync:
+        _dio.fsync_dir(os.path.dirname(path))
 
 
-def atomic_write_json(path: str, obj: dict, fsync: bool = True) -> None:
+def atomic_write_json(path: str, obj: dict, fsync: bool = True,
+                      tmp_nonce: str = None) -> None:
     """Publish ``obj`` as JSON at ``path`` atomically (tmp + replace)."""
     atomic_write_text(path, json.dumps(obj, indent=1, default=str),
-                      fsync=fsync)
+                      fsync=fsync, tmp_nonce=tmp_nonce)

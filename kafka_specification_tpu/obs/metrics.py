@@ -29,6 +29,7 @@ import json
 import threading
 from typing import Optional
 
+from .. import durable_io as _dio
 from ..resilience.heartbeat import heartbeat_record
 from .atomicio import atomic_write_text
 
@@ -114,8 +115,7 @@ class MetricsRegistry:
                                **({"labels": self.const_labels}
                                   if self.const_labels else {}),
                                **self.snapshot())
-        with open(path, "a") as fh:
-            fh.write(json.dumps(rec) + "\n")
+        _dio.append_text(path, json.dumps(rec) + "\n")
 
     def write_prom(self, path: str) -> None:
         """Atomic Prometheus textfile export (tmp + rename: a scraper

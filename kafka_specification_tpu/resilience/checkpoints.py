@@ -42,6 +42,7 @@ from typing import Optional
 
 import numpy as np
 
+from .. import durable_io as _dio
 from .faults import FaultPlan
 
 MANIFEST_KEY = "__manifest__"
@@ -215,6 +216,7 @@ class CheckpointStore:
                     tmp, **{MANIFEST_KEY: json.dumps(build_manifest(arrays))},
                     **arrays,
                 )
+                _dio.note_write(tmp, fsynced=False)
                 if self.fault_plan is not None:
                     # torn-write rehearsal points: tmp written, nothing
                     # promoted (crash@ckpt:N and the full-disk twin
@@ -227,14 +229,14 @@ class CheckpointStore:
                 for g in range(self.keep - 1, 0, -1):
                     src = self.path(g - 1, part)
                     if os.path.exists(src):
-                        os.replace(src, self.path(g, part))
-                os.replace(tmp, path)
+                        _dio.replace(src, self.path(g, part))
+                _dio.replace(tmp, path)
         except BaseException:
             # a failed save (ENOSPC, injected fault, kill) must not leave
             # its tmp behind: the promoted generations are the durable
             # state and they are untouched
             try:
-                os.unlink(tmp)
+                _dio.unlink(tmp)
             except OSError:
                 pass
             raise
@@ -338,7 +340,7 @@ class CheckpointStore:
                 continue
             p = os.path.join(self.directory, name)
             try:
-                os.unlink(p)
+                _dio.unlink(p)
                 removed.append(p)
             except OSError:
                 pass

@@ -20,6 +20,8 @@ from __future__ import annotations
 import json
 import time
 
+from .. import durable_io as _dio
+
 
 def heartbeat_record(kind: str, t: float = None, **fields) -> dict:
     """Envelope a record; `t` overrides the stamped time (e.g. a consumer
@@ -35,5 +37,6 @@ def heartbeat_record(kind: str, t: float = None, **fields) -> dict:
 
 
 def append_jsonl(path: str, record: dict) -> None:
-    with open(path, "a") as fh:
-        fh.write(json.dumps(record) + "\n")
+    # routed through the durable-io leaf so the crashcheck harness sees
+    # heartbeat emits in its op-traces (same buffered-append semantics)
+    _dio.append_text(path, json.dumps(record) + "\n")

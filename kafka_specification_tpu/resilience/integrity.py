@@ -484,12 +484,28 @@ def readback_chain(path: str, depth=None) -> None:
     sails on trusting a poisoned newest generation.  ONE implementation
     for both engines — the read-back contract must not drift between
     them."""
-    with np.load(path, allow_pickle=False) as z:
-        small = {
-            k: z[k]
-            for k in ("digest_chain", "levels", "total", "depth")
-            if k in z.files
-        }
+    for _attempt in range(3):
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                small = {
+                    k: z[k]
+                    for k in ("digest_chain", "levels", "total", "depth")
+                    if k in z.files
+                }
+            break
+        except FileNotFoundError:
+            # the NEXT save's keep-K rotation window: generation 0 is
+            # briefly renamed to .1 before its replacement promotes
+            # (checkpoints.CheckpointStore.save).  The promote that
+            # triggered THIS readback already succeeded, so the path can
+            # only be missing because a newer generation superseded it
+            # mid-rotate — wait out the window, and if it stays gone the
+            # superseding save's own readback verifies the new newest.
+            import time
+
+            time.sleep(0.02)
+    else:
+        return
     count_check()
     errs = checkpoint_chain_errors(small)
     if errs:

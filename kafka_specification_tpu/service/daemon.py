@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from .. import durable_io as _dio
 from ..engine.bfs import check
 from ..obs import RunContext, fleettrace
 from ..obs.metrics import MetricsRegistry
@@ -1195,7 +1196,7 @@ class Daemon:
             tmp = self.heartbeat_path + ".tmp"
             with open(tmp, "w") as fh:
                 fh.writelines(tail)
-            os.replace(tmp, self.heartbeat_path)
+            _dio.replace(tmp, self.heartbeat_path)
         except OSError:
             pass  # rotation must never take the daemon down
 

@@ -62,6 +62,7 @@ from typing import Optional
 
 # one shared tmp-write+fsync+replace idiom (jax-free like this module);
 # job/result paths are unique per writer so the fixed .tmp suffix is safe
+from .. import durable_io as _dio
 from ..obs import fleettrace
 from ..obs.atomicio import atomic_write_json
 
@@ -215,6 +216,20 @@ class JobQueue:
             os.makedirs(self.tenant_index_dir, exist_ok=True)
             os.makedirs(self.results_dir, exist_ok=True)
             os.makedirs(self.runs_dir, exist_ok=True)
+            # startup-janitor parity (crashcheck `queue` scenario): a
+            # publisher killed mid-atomic-write leaves a `.tmp` sibling
+            # in a queue state dir that no except block will ever
+            # collect.  These dirs are MULTI-writer (every client
+            # constructs a JobQueue), so unlike the single-owner storage
+            # structures the sweep is grace-aged: only tmps old enough
+            # that no live writer can still be mid-promote are removed.
+            for d in (
+                os.path.join(self.queue_dir, PENDING),
+                os.path.join(self.queue_dir, CLAIMED),
+                os.path.join(self.queue_dir, DONE),
+                self.results_dir,
+            ):
+                _dio.sweep_tmp(d, min_age_s=_dio.TMP_SWEEP_GRACE_S)
         elif not os.path.isdir(self.queue_dir):
             raise FileNotFoundError(
                 f"no service directory at {self.dir!r} (queue/ missing — "
@@ -302,8 +317,7 @@ class JobQueue:
             tdir = self._tenant_dir(tenant)
             os.makedirs(tdir, exist_ok=True)
             marker = os.path.join(tdir, spec["job_id"])
-            with open(marker, "w"):
-                pass
+            _dio.write_text(marker, "")
             atomic_write_json(self._job_path(PENDING, spec["job_id"]), spec)
 
         retry_transient(publish)
@@ -437,7 +451,7 @@ class JobQueue:
                     return n
             else:
                 try:  # claimed or finished since: retire the marker
-                    os.unlink(os.path.join(tdir, job_id))
+                    _dio.unlink(os.path.join(tdir, job_id))
                 except OSError:
                     pass
         return n
@@ -457,7 +471,7 @@ class JobQueue:
             dst = self._job_path(CLAIMED, job_id)
             t_claim = fleettrace.now()
             try:
-                os.rename(src, dst)
+                _dio.rename(src, dst)
             except OSError:
                 continue  # another daemon won the claim, or it vanished
             try:
@@ -498,7 +512,7 @@ class JobQueue:
                 # If even the requeue fails, the claim stays for the
                 # next janitor.
                 try:
-                    os.rename(dst, src)
+                    _dio.rename(dst, src)
                     self._drop_lease(job_id)
                 except OSError:
                     pass
@@ -519,23 +533,24 @@ class JobQueue:
             # member whose clock drifted
             from ..resilience.faults import injected_skew_s
 
-            with open(self._lease_path(job_id), "w") as fh:
-                json.dump(
+            _dio.write_text(
+                self._lease_path(job_id),
+                json.dumps(
                     {
                         "pid": os.getpid(),
                         "token": _PROC_TOKEN,
                         "lease_unix": round(
                             time.time() + injected_skew_s(), 3
                         ),
-                    },
-                    fh,
-                )
+                    }
+                ),
+            )
         except OSError:
             pass  # lease-less claims degrade to the pre-lease behavior
 
     def _drop_lease(self, job_id: str) -> None:
         try:
-            os.unlink(self._lease_path(job_id))
+            _dio.unlink(self._lease_path(job_id))
         except OSError:
             pass
 
@@ -626,14 +641,14 @@ class JobQueue:
             # reader exists); (4) publish into pending/.
             private = claimed_path + f".requeue-{os.getpid()}"
             try:
-                os.rename(claimed_path, private)
+                _dio.rename(claimed_path, private)
             except OSError:
                 continue  # a sibling janitor (or a finishing daemon) won
             if not self.lease_orphaned(job_id, lease_ttl=lease_ttl):
                 # stale decision: a live daemon re-claimed between our
                 # check and the rename — give its claim file back
                 try:
-                    os.rename(private, claimed_path)
+                    _dio.rename(private, claimed_path)
                 except OSError:
                     pass
                 continue
@@ -664,7 +679,7 @@ class JobQueue:
             except (OSError, ValueError):
                 pass  # attribution is best-effort; the requeue is not
             try:
-                os.rename(private, self._job_path(PENDING, job_id))
+                _dio.rename(private, self._job_path(PENDING, job_id))
                 self._drop_lease(job_id)
                 moved.append(job_id)
             except OSError:
@@ -715,7 +730,7 @@ class JobQueue:
             except ValueError:
                 continue
             try:
-                os.rename(
+                _dio.rename(
                     os.path.join(self.queue_dir, CLAIMED, name),
                     self._job_path(PENDING, job_id),
                 )
@@ -738,7 +753,7 @@ class JobQueue:
         claimed = self._job_path(CLAIMED, job_id)
         if os.path.isfile(claimed):
             try:
-                os.rename(claimed, self._job_path(DONE, job_id))
+                _dio.rename(claimed, self._job_path(DONE, job_id))
             except OSError:
                 pass
         self._drop_lease(job_id)

@@ -56,6 +56,7 @@ import os
 import time
 from typing import Optional
 
+from .. import durable_io as _dio
 from ..obs import fleettrace
 from ..obs.atomicio import atomic_write_json
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
@@ -148,6 +149,10 @@ class Router:
         # converge per-router, and a restart just re-sticks)
         self._affinity = {}
         os.makedirs(self.routes_dir, exist_ok=True)
+        # startup-janitor parity (crashcheck `router` scenario): a route
+        # writer killed mid-atomic-write leaves a nonce'd `.tmp` here;
+        # routes are multi-writer (every router instance), so grace-aged
+        _dio.sweep_tmp(self.routes_dir, min_age_s=_dio.TMP_SWEEP_GRACE_S)
         self.queues = [JobQueue(h) for h in self.hosts]
         if cfg is None or cfg.get("hosts") != self.hosts or (
             float(cfg.get("dead_after_s", -1.0)) != self.dead_after_s
@@ -361,7 +366,14 @@ class Router:
             {"host": host, "why": why, "at": round(time.time(), 3)}
         )
         try:
-            atomic_write_json(self._route_path(job_id), rec)
+            atomic_write_json(
+                self._route_path(job_id), rec,
+                # route records race ACROSS router instances to the same
+                # final path: a shared `.tmp` name would let one racer
+                # promote/unlink the sibling's half-written tmp (the
+                # PR 16 torn-promote precedent) — privatise it
+                tmp_nonce=f"{os.getpid():x}-{os.urandom(4).hex()}",
+            )
         except OSError:
             pass  # resolution falls back to the all-hosts scan
 
@@ -510,7 +522,7 @@ class Router:
                 # verdict write and claim retire, then got requeued):
                 # retire the spec so nobody ever re-runs it
                 try:
-                    os.rename(
+                    _dio.rename(
                         q._job_path(PENDING, job_id),
                         q._job_path(DONE, job_id),
                     )
@@ -521,7 +533,7 @@ class Router:
             src = q._job_path(PENDING, job_id)
             private = src + f".reroute-{os.getpid()}"
             try:
-                os.rename(src, private)
+                _dio.rename(src, private)
             except OSError:
                 continue  # claimed / another router won: not ours
             try:
@@ -540,19 +552,18 @@ class Router:
                 tq = self.queues[target]
                 tdir = tq._tenant_dir(spec.get("tenant", "default"))
                 os.makedirs(tdir, exist_ok=True)
-                with open(os.path.join(tdir, job_id), "w"):
-                    pass
+                _dio.write_text(os.path.join(tdir, job_id), "")
                 atomic_write_json(tq._job_path(PENDING, job_id), spec)
             except (OSError, ValueError):
                 # cannot complete the move: put the job back where one
                 # actor-at-a-time recovery can retry it
                 try:
-                    os.rename(private, src)
+                    _dio.rename(private, src)
                 except OSError:
                     pass
                 continue
             try:
-                os.unlink(private)
+                _dio.unlink(private)
             except OSError:
                 pass  # adoption retires it once this pid is gone
             self._write_route(job_id, target, why="reroute:host-dead")
@@ -613,9 +624,9 @@ class Router:
                     )
                 try:
                     if landed:
-                        os.unlink(path)
+                        _dio.unlink(path)
                     else:
-                        os.rename(path, q._job_path(PENDING, job_id))
+                        _dio.rename(path, q._job_path(PENDING, job_id))
                 except OSError:
                     pass
 

@@ -97,6 +97,7 @@ import numpy as np
 
 from ..resilience import integrity as _integ
 from ..resilience.faults import FaultPlan, corrupt_file
+from .. import durable_io as _dio
 from ..storage.atomic import atomic_write
 from ..storage.runs import RunCorrupt, SortedRun, write_run
 
@@ -598,10 +599,22 @@ class StateSpaceCache:
                 referenced.add(art["visited"]["name"])
             if art.get("boundary"):
                 referenced.add(art["boundary"]["name"])
+        except FileNotFoundError:
+            # no entry was EVER promoted here: every data file is either
+            # an in-flight publisher's (protected by the grace window
+            # below) or a crashed first-publisher's orphan that no
+            # future entry will ever reference (publishes mint fresh
+            # nonce'd names) — the crashcheck `cache` scenario found
+            # these accumulating forever when this case collected
+            # nothing
+            pass
         except (OSError, ValueError, KeyError, TypeError):
-            # no / unreadable entry: nothing is provably garbage (the
-            # first publisher may be mid-race) — collect nothing
+            # unreadable/torn entry: the atomic promote makes this
+            # unreachable by crash, so treat it as transient (EIO, a
+            # concurrent replace) — nothing is provably garbage
             return []
+        # a referenced run's bloom sidecar is part of the artifact
+        referenced |= {name + ".bloom" for name in tuple(referenced)}
         removed = []
         now = time.time()
         try:
@@ -609,15 +622,27 @@ class StateSpaceCache:
         except OSError:
             return []
         for name in names:
-            if name in referenced or not (
+            collectable = (
                 name.endswith(".run") or name.endswith(".npy")
-            ):
-                continue  # tmp files belong to atomic_write's own cleanup
+                # a loser's rebuilt-on-verify bloom sidecar dies with
+                # its run
+                or name.endswith(".bloom")
+                # startup-janitor parity (crashcheck `cache` scenario):
+                # a publisher killed mid-atomic-write leaves a nonce'd
+                # entry tmp that atomic_write's cleanup-on-raise never
+                # saw — once it outlives the same grace window that
+                # protects an in-flight promote, it is provably a
+                # mid-write death's orphan (no manifest references tmp
+                # names)
+                or name.endswith(".tmp") or ".tmp." in name
+            )
+            if name in referenced or not collectable:
+                continue
             path = os.path.join(d, name)
             try:
                 if now - os.path.getmtime(path) < grace_s:
                     continue
-                os.unlink(path)
+                _dio.unlink(path)
                 removed.append(name)
             except OSError:
                 continue

@@ -21,20 +21,13 @@ from __future__ import annotations
 
 import os
 
+from .. import durable_io as _dio
 
-def fsync_dir(path: str) -> None:
-    """Best-effort fsync of a directory entry (some filesystems refuse
-    O_RDONLY dir fsync; the data-file fsync already happened either way)."""
-    try:
-        fd = os.open(path or ".", os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
+# canonical implementations live in the durable_io leaf so the
+# crash-consistency harness sees one op vocabulary; these names stay
+# re-exported here because every storage structure imports them from
+# this module
+fsync_dir = _dio.fsync_dir
 
 
 def atomic_write(path: str, write_fn, before_replace=None,
@@ -59,34 +52,17 @@ def atomic_write(path: str, write_fn, before_replace=None,
             write_fn(fh)
             fh.flush()
             os.fsync(fh.fileno())
+        _dio.note_write(tmp, fsynced=True)
         if before_replace is not None:
             before_replace()
-        os.replace(tmp, path)
+        _dio.replace(tmp, path)
     except BaseException:
         try:
-            os.unlink(tmp)
+            _dio.unlink(tmp)
         except OSError:
             pass
         raise
     fsync_dir(os.path.dirname(path))
 
 
-def sweep_tmp(directory: str) -> list:
-    """Startup janitor: remove stale `.tmp` siblings (and `.tmp.npz`
-    checkpoint tmps) left by a mid-write death.  Safe by construction —
-    no manifest ever references a tmp name.  Returns the removed paths."""
-    removed = []
-    if not os.path.isdir(directory):
-        return removed
-    for name in os.listdir(directory):
-        if not (name.endswith(".tmp") or ".tmp." in name):
-            continue
-        p = os.path.join(directory, name)
-        if not os.path.isfile(p):
-            continue
-        try:
-            os.unlink(p)
-            removed.append(p)
-        except OSError:
-            pass
-    return removed
+sweep_tmp = _dio.sweep_tmp

@@ -26,6 +26,7 @@ import os
 import numpy as np
 
 from ..native import FpSet
+from .. import durable_io as _dio
 from .atomic import sweep_tmp
 from .runs import SortedRun, merge_runs, write_run
 
@@ -132,7 +133,7 @@ class DeferredDeleter:
 def _unlink_quiet(path: str) -> None:
     for p in (path, path + ".bloom"):
         try:
-            os.unlink(p)
+            _dio.unlink(p)
         except OSError:
             pass
 

@@ -45,6 +45,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from .. import durable_io as _dio
 from ..obs import fleettrace
 from ..obs.atomicio import atomic_write_json
 from .cost import CostModel, features_from, fit_from_corpus
@@ -150,6 +151,11 @@ class Manifest:
     @classmethod
     def open_or_create(cls, sweep_dir: str, lattice: LatticeSpec):
         path = os.path.join(sweep_dir, "sweep.json")
+        # startup-janitor parity (crashcheck `sweep` scenario): a
+        # promote killed mid-tmp-write leaves a nonce'd `.tmp` next to
+        # sweep.json; the dir is shared with a possibly-live sweeper, so
+        # the sweep is grace-aged like the queue's
+        _dio.sweep_tmp(sweep_dir, min_age_s=_dio.TMP_SWEEP_GRACE_S)
         if os.path.isfile(path):
             with open(path) as fh:
                 rec = json.load(fh)
@@ -177,7 +183,14 @@ class Manifest:
 
     def promote(self) -> None:
         self.rec["updated_unix"] = round(time.time(), 3)
-        atomic_write_json(self.path, self.rec)
+        # a crash-resumed sweeper can race a wedged-but-alive
+        # predecessor to this one final path: privatise the tmp (the
+        # PR 16 torn-promote precedent) so neither promotes the other's
+        # half-written bytes
+        atomic_write_json(
+            self.path, self.rec,
+            tmp_nonce=f"{os.getpid():x}-{os.urandom(4).hex()}",
+        )
 
     def row(self, point_id: str) -> Optional[dict]:
         return self.rec["points"].get(point_id)

@@ -54,6 +54,24 @@ cat > "$LATTICE" <<'EOF'
 }
 EOF
 
+# 0. crash-consistency torture harness: every recovery protocol against
+# every legal post-crash state (jax-free; docs/resilience.md § Crash
+# consistency).  Bank the kspec-crashcheck/1 artifact; any
+# non-convergent state fails the night.
+$KSPEC crashcheck --json > "$WORK/crashcheck.json" \
+    || { echo "FAIL: crashcheck found non-convergent crash states"; \
+         $KSPEC crashcheck || true; exit 1; }
+python - "$WORK/crashcheck.json" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+assert rec["schema"] == "kspec-crashcheck/1", rec["schema"]
+assert rec["ok"] and rec["non_convergent"] == 0, rec["non_convergent"]
+assert rec["states"] >= 200 and len(rec["protocols"]) >= 6, (
+    rec["states"], rec["protocols"])
+print(f"# crashcheck ok: {rec['states']} states / "
+      f"{len(rec['protocols'])} protocols in {rec['seconds']}s")
+EOF
+
 # 1. plan: jax-free dry run, must not create a sweep dir
 $KSPEC sweep plan "$LATTICE" --state-cache-dir "$SVC/state-cache"
 test ! -e "$WORK/sweep1" || { echo "FAIL: plan had side effects"; exit 1; }

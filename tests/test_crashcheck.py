@@ -1,0 +1,483 @@
+"""Crash-consistency torture harness tests (`pytest -m crashcheck`).
+
+Four layers, mirroring the subsystem:
+
+- the durable-io shim: transparent when not recording, faithful op
+  capture when recording;
+- the fs model: legal-crash-state enumeration pins the exact semantics
+  the harness exists for (un-dir-fsynced renames revert, unfsynced
+  writes tear, journal tails drop);
+- the full harness: every protocol's recovery converges on every
+  enumerated crash state (the ISSUE's ≥200-states / ≥6-protocols /
+  <60s bar), and reverting the atomicio dir-fsync fix is DETECTED;
+- the discipline boundary: the durable-io lint is pinned at zero on the
+  real tree and proven live on seeded mutants, every durable directory
+  has a startup janitor (planted-orphan parity), and every O_APPEND
+  journal's reader survives a torn tail.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from kafka_specification_tpu import durable_io as _dio
+from kafka_specification_tpu.analysis.durable_lint import lint_durable_io
+from kafka_specification_tpu.resilience.crashcheck import (
+    CRASHCHECK_SCHEMA,
+    SCENARIOS,
+    list_scenarios,
+    run_crashcheck,
+)
+
+pytestmark = pytest.mark.crashcheck
+
+
+def _age(path, s=3600.0):
+    old = time.time() - s
+    os.utime(path, (old, old))
+
+
+# --- the durable-io shim --------------------------------------------------
+
+
+def test_shim_transparent_when_not_recording(tmp_path):
+    assert not _dio.recording()
+    p = str(tmp_path / "f.txt")
+    _dio.write_text(p, "hello", fsync=True)
+    assert open(p).read() == "hello"
+    _dio.append_text(p, " world")
+    assert open(p).read() == "hello world"
+    q = str(tmp_path / "g.txt")
+    _dio.replace(p, q)
+    assert open(q).read() == "hello world" and not os.path.exists(p)
+    _dio.fsync_dir(str(tmp_path))
+    _dio.unlink(q)
+    assert not os.path.exists(q)
+
+
+def test_recorder_captures_ops_root_relative(tmp_path):
+    rec = _dio.OpRecorder(str(tmp_path))
+    prev = _dio.install(rec)
+    try:
+        _dio.write_text(str(tmp_path / "a"), "x", fsync=True)
+        _dio.append_text(str(tmp_path / "a"), "y")
+        _dio.replace(str(tmp_path / "a"), str(tmp_path / "b"))
+        _dio.fsync_dir(str(tmp_path))
+        rec.ack("done", n=1)
+        # an op outside the recorder's root is not this scenario's
+        _dio.write_text(str(tmp_path.parent / "outside.txt"), "z")
+    finally:
+        _dio.install(prev)
+        (tmp_path.parent / "outside.txt").unlink()
+    kinds = [op["op"] for op in rec.ops]
+    assert kinds == ["write", "append", "rename", "fsync_dir", "ack"]
+    assert rec.ops[0]["path"] == "a" and rec.ops[0]["fsynced"]
+    assert rec.ops[2] == {"op": "rename", "src": "a", "dst": "b"}
+    assert rec.ops[4]["label"] == "done"
+
+
+def test_sweep_tmp_grace_window(tmp_path):
+    aged = tmp_path / "old.json.tmp"
+    fresh = tmp_path / "new.json.ab12.tmp"
+    keeper = tmp_path / "real.json"
+    for p in (aged, fresh, keeper):
+        p.write_text("x")
+    _age(str(aged))
+    removed = _dio.sweep_tmp(str(tmp_path), min_age_s=60.0)
+    assert removed == [str(aged)]
+    assert not aged.exists() and fresh.exists() and keeper.exists()
+
+
+# --- the fs model: crash-state semantics ----------------------------------
+
+
+def test_unfsynced_rename_may_revert_fsynced_may_not():
+    """The exact pre-fix obs/atomicio failure mode: tmp -> final rename
+    with no directory fsync may revert (or half-persist); with the dir
+    fsync recorded it may not."""
+    from kafka_specification_tpu.resilience.crashcheck.fsmodel import (
+        _vulnerable,
+        replay,
+    )
+
+    ops = [
+        {"op": "write", "path": "f.tmp", "data": b"payload",
+         "fsynced": True},
+        {"op": "rename", "src": "f.tmp", "dst": "f"},
+        {"op": "fsync_dir", "path": "."},
+    ]
+    # crash after the rename but before the dir fsync: both degradation
+    # modes of the rename are legal
+    assert {(1, "skip"), (1, "linger")} <= set(_vulnerable(ops, 2))
+    reverted = replay({}, ops, 2, {1: ("skip",)})
+    assert "f" not in reverted and reverted["f.tmp"] == b"payload"
+    lingering = replay({}, ops, 2, {1: ("linger",)})
+    assert lingering["f"] == b"payload" and "f.tmp" in lingering
+    # once the dir fsync is in the prefix, the rename is invulnerable
+    assert not any(idx == 1 for idx, _mode in _vulnerable(ops, 3))
+
+
+def test_unfsynced_write_tears_and_append_tail_drops():
+    from kafka_specification_tpu.resilience.crashcheck.fsmodel import (
+        _vulnerable,
+        enumerate_crash_states,
+        replay,
+    )
+
+    ops = [
+        {"op": "write", "path": "w", "data": b"0123456789",
+         "fsynced": False},
+        {"op": "append", "path": "j", "data": b"rec1\n"},
+        {"op": "append", "path": "j", "data": b"rec2\n"},
+    ]
+    vuln = set(_vulnerable(ops, 3))
+    assert (0, "data") in vuln  # unfsynced write may tear
+    assert (2, "tail") in vuln  # the LAST append per path may drop
+    assert (1, "tail") not in vuln  # ...earlier records are durable
+    torn = replay({}, ops, 3, {0: ("data", b"01234")})
+    assert torn["w"] == b"01234"
+    dropped = replay({}, ops, 3, {2: ("skip",)})
+    assert dropped["j"] == b"rec1\n"
+    # the enumerator emits these as concrete states (dedup collapses a
+    # degraded prefix-3 state into an identical earlier clean state, so
+    # search the whole set)
+    trees = [s.tree for s in enumerate_crash_states({}, ops)]
+    assert any(t.get("w", b"") == b"" for t in trees)  # lost entirely
+    assert any(t.get("w") == b"01234" for t in trees)  # torn prefix
+    assert any(t.get("j") == b"rec1\n" and "w" in t for t in trees)
+
+
+# --- the full harness -----------------------------------------------------
+
+
+def test_every_protocol_converges_on_every_crash_state(tmp_path):
+    rec = run_crashcheck(workdir=str(tmp_path / "w"))
+    assert rec["schema"] == CRASHCHECK_SCHEMA
+    assert rec["ok"] and rec["non_convergent"] == 0, rec["findings"][:3]
+    assert rec["states"] >= 200
+    assert len(rec["protocols"]) >= 6
+    assert rec["seconds"] < 60.0
+    assert len(rec["scenarios"]) == len(SCENARIOS)
+    for s in rec["scenarios"]:
+        assert s["states"] > 0 and s["ops"] > 0
+
+
+def test_protocol_filter_and_unknown_protocol(tmp_path):
+    rec = run_crashcheck(protocols=["trace"],
+                         workdir=str(tmp_path / "w"))
+    assert rec["protocols"] == ["trace"] and rec["ok"]
+    with pytest.raises(ValueError, match="no crashcheck scenario"):
+        run_crashcheck(protocols=["nonesuch"])
+
+
+def test_reverted_dirfsync_fix_is_detected(tmp_path, monkeypatch):
+    """Revert the PR's atomicio fix in spirit — make every dir fsync a
+    silent no-op (so it neither syncs nor records) — and the harness
+    must find non-convergent states: that is the gap it exists to
+    catch."""
+    from kafka_specification_tpu.storage import atomic as atomic_mod
+
+    noop = lambda path: None  # noqa: E731
+    monkeypatch.setattr(_dio, "fsync_dir", noop)
+    monkeypatch.setattr(atomic_mod, "fsync_dir", noop)
+    rec = run_crashcheck(protocols=["queue"],
+                         workdir=str(tmp_path / "w"))
+    assert not rec["ok"] and rec["non_convergent"] > 0
+    f = rec["findings"][0]
+    # findings are machine-readable repros
+    assert f["scenario"] == "queue-lifecycle"
+    assert isinstance(f["prefix"], int) and f["op_log"]
+    assert f["state_digest"] and "tree" in f
+    json.dumps(rec)  # the whole record is JSON-safe
+
+
+def test_cli_crashcheck_json_contract(tmp_path, capsys, monkeypatch):
+    from kafka_specification_tpu.utils.cli import main as cli_main
+
+    assert cli_main(["crashcheck", "--protocol", "trace",
+                     "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["schema"] == CRASHCHECK_SCHEMA and rec["ok"]
+    assert cli_main(["crashcheck", "--protocol", "nonesuch"]) == 2
+
+
+def test_faults_list_carries_scenario_registry(capsys):
+    from kafka_specification_tpu.utils.cli import main as cli_main
+
+    assert cli_main(["faults", "--json"]) == 0
+    entries = json.loads(capsys.readouterr().out)
+    rows = [e for e in entries if e["kind"] == "crashcheck-scenario"]
+    assert {r["sites"][0] for r in rows} == {s.name for s in SCENARIOS}
+    assert cli_main(["faults"]) == 0
+    out = capsys.readouterr().out
+    assert "Crashcheck scenarios" in out and "queue-lifecycle" in out
+    assert {s["name"] for s in list_scenarios()} == \
+        {s.name for s in SCENARIOS}
+
+
+# --- the durable-write discipline lint ------------------------------------
+
+
+def test_lint_pins_zero_findings_on_the_real_tree():
+    assert lint_durable_io() == []
+
+
+def test_lint_detects_seeded_mutants(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import os\n"
+        "def f(a, b):\n"
+        "    os.replace(a, b)\n"
+        "def g(p):\n"
+        '    with open(p, "a") as fh:\n'
+        '        fh.write("x")\n'
+        "def h(a, b):\n"
+        "    # kspec: allow(durable-io)\n"
+        "    os.rename(a, b)\n"
+        "def i(a, b):\n"
+        "    # kspec: allow(durable-io) scratch swap, not durable\n"
+        "    os.rename(a, b)\n"
+        'DOC = """example: os.replace(a, b)"""\n'
+    )
+    problems = {p["line"]: p["problem"] for p in lint_durable_io(str(pkg))}
+    assert "raw os.rename/os.replace" in problems[3]
+    assert "append-mode writer" in problems[5]
+    assert "carries no reason" in problems[9]
+    assert set(problems) == {3, 5, 9}  # reasoned allow + docstring pass
+
+
+def test_analyze_cli_runs_durable_lint(capsys):
+    from kafka_specification_tpu.utils.cli import main as cli_main
+
+    assert cli_main(["analyze", "--no-models", "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["ok"]
+    assert any("durable-write discipline" in t for t in rec["targets"])
+
+
+# --- startup-janitor parity: every durable dir collects its orphans -------
+
+
+def test_queue_open_collects_aged_tmp_orphans(tmp_path):
+    from kafka_specification_tpu.service.queue import JobQueue
+
+    q = JobQueue(str(tmp_path / "svc"))
+    planted, fresh = [], []
+    for d in (os.path.join(q.queue_dir, "pending"),
+              os.path.join(q.queue_dir, "claimed"),
+              os.path.join(q.queue_dir, "done"),
+              q.results_dir):
+        p = os.path.join(d, "orphan.json.tmp")
+        open(p, "w").write("{")
+        _age(p)
+        planted.append(p)
+        f = os.path.join(d, "inflight.json.ab.tmp")
+        open(f, "w").write("{")
+        fresh.append(f)
+    JobQueue(str(tmp_path / "svc"))
+    assert not any(os.path.exists(p) for p in planted)
+    # a live sibling's in-flight tmp is inside the grace window: kept
+    assert all(os.path.exists(f) for f in fresh)
+
+
+def test_router_open_collects_aged_route_tmps(tmp_path):
+    from kafka_specification_tpu.service.queue import JobQueue
+    from kafka_specification_tpu.service.router import Router
+
+    h0 = str(tmp_path / "h0")
+    JobQueue(h0)
+    r = Router(str(tmp_path / "rt"), hosts=[h0])
+    p = os.path.join(r.routes_dir, "j1.json.dead.tmp")
+    open(p, "w").write("{")
+    _age(p)
+    Router(str(tmp_path / "rt"))
+    assert not os.path.exists(p)
+
+
+def test_cache_gc_collects_entryless_orphans(tmp_path):
+    """A publisher that dies before its first entry-promote must not
+    orphan its artifacts forever — the crashcheck cache scenario found
+    collect_garbage refusing to touch an entry-less dir."""
+    from kafka_specification_tpu.service.state_cache import (
+        CacheKey,
+        StateSpaceCache,
+    )
+
+    c = StateSpaceCache(str(tmp_path / "sc"))
+    key = CacheKey("IdSequence", False, (("MaxId", 3),), ("TypeOk",), (),
+                   False, max_depth=2)
+    d = c._entry_dir(key)
+    os.makedirs(d, exist_ok=True)
+    planted = []
+    for name in ("visited-dead.run", "visited-dead.run.bloom",
+                 "rows-dead.npy", "entry.json.ab12.tmp"):
+        p = os.path.join(d, name)
+        open(p, "wb").write(b"\xff" * 16)
+        _age(p)
+        planted.append(p)
+    removed = c.collect_garbage(key, grace_s=60.0)
+    assert sorted(os.path.basename(p) for p in removed) == sorted(
+        os.path.basename(p) for p in planted
+    )
+    assert not any(os.path.exists(p) for p in planted)
+
+
+def test_cache_gc_grace_protects_inflight_publisher(tmp_path):
+    from kafka_specification_tpu.service.state_cache import (
+        CacheKey,
+        StateSpaceCache,
+    )
+
+    c = StateSpaceCache(str(tmp_path / "sc"))
+    key = CacheKey("IdSequence", False, (("MaxId", 3),), ("TypeOk",), (),
+                   False, max_depth=2)
+    d = c._entry_dir(key)
+    os.makedirs(d, exist_ok=True)
+    live = os.path.join(d, "visited-live.run")
+    open(live, "wb").write(b"\x00" * 16)  # fresh: publisher mid-flight
+    assert c.collect_garbage(key, grace_s=60.0) == []
+    assert os.path.exists(live)
+
+
+def test_sweep_manifest_open_collects_aged_tmps(tmp_path):
+    from kafka_specification_tpu.sweep.lattice import (
+        Axis,
+        LatticeSheet,
+        LatticeSpec,
+    )
+    from kafka_specification_tpu.sweep.portfolio import Manifest
+
+    spec = LatticeSpec(name="jan", sheets=[LatticeSheet(
+        module="IdSequence", cfg_text="CONSTANTS MaxId = 3",
+        axes=[Axis("MaxId", (2, 3))],
+    )])
+    d = str(tmp_path / "sweep")
+    m = Manifest.open_or_create(d, spec)
+    m.promote()
+    stray = os.path.join(d, "manifest.json.dead.tmp")
+    open(stray, "w").write("{torn")
+    _age(stray)
+    m2 = Manifest.open_or_create(d, spec)
+    assert not os.path.exists(stray)
+    assert m2.rec["sweep_id"] == m.rec["sweep_id"]
+
+
+def test_trace_dir_is_append_only_no_tmp_writer(tmp_path):
+    # parity note: the traces dir needs no tmp janitor BECAUSE its only
+    # writers are O_APPEND emitters — pin that no emit ever creates a
+    # tmp file (if one ever does, it must also gain a janitor)
+    from kafka_specification_tpu.obs import fleettrace
+
+    trace = fleettrace.mint_trace("job-t", time.time())
+    t0 = fleettrace.now()
+    fleettrace.emit_span(str(tmp_path), trace, "job-submit", t0,
+                         fleettrace.now(), job_id="job-t",
+                         span_id=trace["span_id"])
+    names = []
+    for cur, _d, fns in os.walk(tmp_path):
+        names.extend(fns)
+    assert names and not any(
+        n.endswith(".tmp") or ".tmp." in n for n in names
+    )
+
+
+# --- torn-tail recovery: every O_APPEND journal reader --------------------
+
+
+def _torn_append(path, lines, torn=b'{"kind": "daemon", "un'):
+    with open(path, "ab") as fh:
+        for ln in lines:
+            fh.write(ln)
+        fh.write(torn)  # killed mid-append: no trailing newline
+
+
+def test_heartbeat_reader_survives_torn_tail(tmp_path):
+    from kafka_specification_tpu.obs.tracer import read_jsonl_tolerant
+    from kafka_specification_tpu.resilience.heartbeat import append_jsonl
+
+    p = str(tmp_path / "heartbeat.jsonl")
+    append_jsonl(p, {"kind": "daemon", "unix": 1.0})
+    append_jsonl(p, {"kind": "daemon", "unix": 2.0})
+    with open(p, "ab") as fh:
+        fh.write(b'{"kind": "daemon", "unix": 3')
+    recs = read_jsonl_tolerant(p)
+    assert [r["unix"] for r in recs] == [1.0, 2.0]
+
+
+def test_router_liveness_survives_torn_heartbeat_tail(tmp_path):
+    from kafka_specification_tpu.service.queue import JobQueue
+    from kafka_specification_tpu.service.router import Router
+
+    h0 = str(tmp_path / "h0")
+    JobQueue(h0)
+    hb = os.path.join(h0, "service", "heartbeat-daemon.jsonl")
+    os.makedirs(os.path.dirname(hb), exist_ok=True)
+    stamp = round(time.time(), 3)
+    _torn_append(hb, [
+        json.dumps({"kind": "daemon", "unix": stamp}).encode() + b"\n",
+    ])
+    r = Router(str(tmp_path / "rt"), hosts=[h0])
+    assert r._newest_heartbeat_unix(0) == stamp
+
+
+def test_router_event_log_survives_torn_tail(tmp_path):
+    from kafka_specification_tpu.obs.tracer import read_jsonl_tolerant
+    from kafka_specification_tpu.service.queue import JobQueue
+    from kafka_specification_tpu.service.router import Router
+
+    h0 = str(tmp_path / "h0")
+    JobQueue(h0)
+    r = Router(str(tmp_path / "rt"), hosts=[h0])
+    r._event("route", job_id="j1", host=0)
+    r._event("route", job_id="j2", host=0)
+    with open(r.events_path, "ab") as fh:
+        fh.write(b'{"kind": "router", "event": "rou')
+    recs = read_jsonl_tolerant(r.events_path)
+    assert [x["job_id"] for x in recs] == ["j1", "j2"]
+
+
+def test_sweep_manifest_resume_with_torn_tmp_stray(tmp_path):
+    from kafka_specification_tpu.sweep.lattice import (
+        Axis,
+        LatticeSheet,
+        LatticeSpec,
+    )
+    from kafka_specification_tpu.sweep.portfolio import (
+        Manifest,
+        load_manifest,
+    )
+
+    spec = LatticeSpec(name="torn", sheets=[LatticeSheet(
+        module="IdSequence", cfg_text="CONSTANTS MaxId = 3",
+        axes=[Axis("MaxId", (2, 3))],
+    )])
+    d = str(tmp_path / "sweep")
+    m = Manifest.open_or_create(d, spec)
+    m.promote()
+    # a crashed sibling's half-written promote tmp must never shadow
+    # the intact manifest nor break the resume
+    stray = os.path.join(d, "manifest.json.beef.tmp")
+    open(stray, "wb").write(b'{"sweep_id": "WRONG", "poi')
+    _age(stray)
+    rec = load_manifest(d)
+    assert rec["sweep_id"] == m.rec["sweep_id"]
+    m2 = Manifest.open_or_create(d, spec)
+    assert m2.rec["sweep_id"] == m.rec["sweep_id"]
+    assert not os.path.exists(stray)
+
+
+def test_readback_chain_tolerates_rotation_window(tmp_path):
+    """The post-save chain readback races the NEXT save's keep-K
+    rotation: generation 0 is briefly renamed to `.1` before its
+    replacement promotes, so the just-verified path can legally be
+    absent.  A vanished path means a newer generation superseded this
+    one (whose own readback verifies it) — never an error."""
+    from kafka_specification_tpu.resilience.integrity import (
+        readback_chain,
+    )
+
+    readback_chain(str(tmp_path / "gone.npz"), depth=3)  # must not raise
