@@ -48,11 +48,12 @@ __all__ = [
     "replace", "rename", "unlink", "fsync_dir",
     "write_text", "append_text",
     "note_write", "note_append", "ack",
-    "sweep_tmp",
+    "sweep_tmp", "set_fault_hook",
 ]
 
 _LOCK = threading.Lock()
 _RECORDER = None  # None in production: every wrapper is a pass-through
+_FAULT_HOOK = None  # None in production: doing wrappers never inject
 
 #: grace age for sweeping orphan tmps out of MULTI-writer directories
 #: (queue state dirs, router routes, sweep manifests): a live writer's
@@ -152,20 +153,47 @@ def ack(label: str, **fields) -> None:
         r.ack(label, **fields)
 
 
+# --- fault injection seam (simfleet's flaky-fs model) ---------------------
+
+
+def set_fault_hook(hook):
+    """Install (``None`` removes) a callable ``hook(op, path) -> None``
+    consulted at the START of every doing wrapper, before the effect.
+    Raising ``OSError`` from the hook makes the op fail cleanly (nothing
+    happened on disk) — the flaky-filesystem model the deterministic
+    fleet simulation (``resilience/simfleet``) drives, exercising every
+    ``retry_transient`` envelope in virtual time.  Returns the previous
+    hook.  Production default ``None``: one ``is None`` check per op."""
+    global _FAULT_HOOK
+    with _LOCK:
+        prev = _FAULT_HOOK
+        _FAULT_HOOK = hook
+    return prev
+
+
+def _fault(op: str, path: str) -> None:
+    h = _FAULT_HOOK
+    if h is not None:
+        h(op, path)
+
+
 # --- doing wrappers (perform the effect, then record it) ------------------
 
 
 def replace(src: str, dst: str) -> None:
+    _fault("rename", dst)
     os.replace(src, dst)
     _note("rename", src=src, dst=dst)
 
 
 def rename(src: str, dst: str) -> None:
+    _fault("rename", dst)
     os.rename(src, dst)
     _note("rename", src=src, dst=dst)
 
 
 def unlink(path: str) -> None:
+    _fault("unlink", path)
     os.unlink(path)
     _note("unlink", path=path)
 
@@ -194,6 +222,7 @@ def write_text(path: str, text: str, fsync: bool = False) -> None:
     sidecars whose torn state is tolerated by every reader (claim
     leases, tenant admission markers) — anything a reader must never
     see torn goes through an atomic helper instead."""
+    _fault("write", path)
     with open(path, "w") as fh:
         fh.write(text)
         fh.flush()
@@ -204,6 +233,7 @@ def write_text(path: str, text: str, fsync: bool = False) -> None:
 
 def append_text(path: str, text: str) -> None:
     """One buffered O_APPEND text emit, recorded."""
+    _fault("append", path)
     with open(path, "a") as fh:
         fh.write(text)
     note_append(path, text)

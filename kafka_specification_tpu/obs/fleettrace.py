@@ -69,11 +69,11 @@ from __future__ import annotations
 import json
 import os
 import re
-import time
 from contextlib import contextmanager
 from typing import Optional
 
 from .. import durable_io as _dio
+from ..utils import clock as _clk
 from ..resilience.heartbeat import heartbeat_record
 from .tracer import read_jsonl_tolerant
 
@@ -149,9 +149,9 @@ def now() -> float:
     shifts heartbeat/lease stamps (and normalization must undo it)."""
     try:
         from ..resilience.faults import injected_skew_s
-        return time.time() + injected_skew_s()
+        return _clk.now() + injected_skew_s()
     except Exception:
-        return time.time()
+        return _clk.now()
 
 
 # --- emission --------------------------------------------------------------
@@ -702,7 +702,7 @@ def _daemon_rows(svc: str) -> list:
         )
     except OSError:
         return rows
-    wall = time.time()
+    wall = _clk.now()
     for name in names:
         recs = read_jsonl_tolerant(os.path.join(svc, name))
         last = recs[-1] if recs else {}

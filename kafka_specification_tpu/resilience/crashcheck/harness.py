@@ -36,8 +36,12 @@ CRASHCHECK_SCHEMA = "kspec-crashcheck/1"
 def _crashed_process_view():
     """Recovery-side reality adjustment: this process recorded the
     scenario, so ITS pid is the 'crashed' one — adoption sweeps keyed on
-    pid-aliveness must treat it as dead, and the skew allowance that
-    protects live-but-drifted claimers must not protect a corpse."""
+    pid-aliveness must treat it as dead.  The zero-skew allowance that
+    a corpse must not enjoy is no longer forced here via a process-global
+    ``os.environ`` mutation (unsafe under concurrent harnesses): the
+    recovery steps pass ``skew_s=0.0`` explicitly to the queue/router
+    skew readers instead, and the env var stays the documented default
+    for production sweeps."""
     from ...service import queue as qmod
     from ...service import router as rmod
 
@@ -47,8 +51,6 @@ def _crashed_process_view():
     def fake(pid: int) -> bool:
         return False if pid == me else real(pid)
 
-    old_skew = os.environ.get("KSPEC_CLOCK_SKEW")
-    os.environ["KSPEC_CLOCK_SKEW"] = "0"
     qmod._pid_alive = fake
     rmod._pid_alive = fake
     try:
@@ -56,10 +58,6 @@ def _crashed_process_view():
     finally:
         qmod._pid_alive = real
         rmod._pid_alive = real
-        if old_skew is None:
-            os.environ.pop("KSPEC_CLOCK_SKEW", None)
-        else:
-            os.environ["KSPEC_CLOCK_SKEW"] = old_skew
 
 
 def _tree_listing(tree: dict) -> dict:

@@ -106,7 +106,11 @@ def _queue_run(root, rec):
 def _queue_recover(root, acked, ctx):
     viol = []
     jid = ctx["job_id"]
-    q = _queue_mod().JobQueue(os.path.join(root, "svc"))
+    # skew_s=0.0 explicitly: the crashed claimer is a corpse, so the
+    # live-but-drifted skew allowance must not protect its lease (this
+    # used to be forced through os.environ["KSPEC_CLOCK_SKEW"] — now
+    # threaded as a parameter so concurrent harnesses can't race on it)
+    q = _queue_mod().JobQueue(os.path.join(root, "svc"), skew_s=0.0)
     q.requeue_orphans(lease_ttl=0.0)
     states = _job_states(q, jid)
     try:
@@ -194,7 +198,9 @@ def _router_recover(root, acked, ctx):
     # a live host B keeps heart-beating at real recovery time; restamp it
     # so the pre-crash stamp's age never misclassifies the survivor
     _stamp_heartbeat(hosts[1], time.time())
-    r = _router_mod().Router(os.path.join(root, "router"), hosts=hosts)
+    # skew_s=0.0: same corpse-gets-no-allowance rule as _queue_recover
+    r = _router_mod().Router(os.path.join(root, "router"), hosts=hosts,
+                             skew_s=0.0)
     r.sweep()
     copies = []
     for q in r.queues:

@@ -21,13 +21,16 @@ import json
 import time
 
 from .. import durable_io as _dio
+from ..utils import clock as _clk
 
 
 def heartbeat_record(kind: str, t: float = None, **fields) -> dict:
     """Envelope a record; `t` overrides the stamped time (e.g. a consumer
-    that needs event-START semantics stamps the start, not now)."""
+    that needs event-START semantics stamps the start, not now).  The
+    default stamp comes from the injected clock (utils/clock.py), so a
+    simulated daemon's liveness trail carries virtual time."""
     if t is None:
-        t = time.time()
+        t = _clk.now()
     return {
         "kind": kind,
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t)),

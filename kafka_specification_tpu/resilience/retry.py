@@ -30,8 +30,9 @@ from __future__ import annotations
 import os
 import random
 import sys
-import time
 from dataclasses import dataclass, field
+
+from ..utils import clock as _clk
 
 TRANSIENT_PATTERNS = (
     "UNAVAILABLE",
@@ -172,7 +173,7 @@ class ChunkRetryHandler:
                 backoff_s=round(pause, 2),
                 error=f"{type(e).__name__}: {e}"[:200],
             )
-            time.sleep(pause)
+            _clk.sleep(pause)
             return "retry"
         if kind == "device_resource" and not escalated:
             # (an ESCALATED attempt's RESOURCE_EXHAUSTED falls through to

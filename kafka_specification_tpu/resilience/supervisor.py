@@ -36,11 +36,11 @@ import random
 import signal
 import subprocess
 import sys
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from .heartbeat import append_jsonl, heartbeat_record
+from ..utils import clock as _clk
 from .integrity import EXIT_INTEGRITY
 from .resources import EXIT_RESOURCE_EXHAUSTED, reclaim_disk
 
@@ -151,7 +151,7 @@ def _run_attempt(cfg: SupervisorConfig, attempt: int) -> int:
                 except (OSError, ProcessLookupError):
                     pass
 
-        last_progress = time.monotonic()
+        last_progress = _clk.monotonic()
         hb_size = _hb_size(cfg.heartbeat)
         while True:
             rc = child.poll()
@@ -161,13 +161,13 @@ def _run_attempt(cfg: SupervisorConfig, attempt: int) -> int:
                 # no heartbeat stream configured: the stall detector is
                 # off (a constant size would read as an eternal stall and
                 # kill every healthy child) — only child exits matter
-                time.sleep(cfg.poll)
+                _clk.sleep(cfg.poll)
                 continue
             size = _hb_size(cfg.heartbeat)
             if size != hb_size:
                 hb_size = size
-                last_progress = time.monotonic()
-            if time.monotonic() - last_progress > cfg.stall_timeout:
+                last_progress = _clk.monotonic()
+            if _clk.monotonic() - last_progress > cfg.stall_timeout:
                 cfg.event(
                     event="stall-kill",
                     attempt=attempt,
@@ -181,7 +181,7 @@ def _run_attempt(cfg: SupervisorConfig, attempt: int) -> int:
                     signal_tree(signal.SIGKILL)
                     child.wait()
                 return STALL_RC
-            time.sleep(cfg.poll)
+            _clk.sleep(cfg.poll)
     finally:
         if log_fh is not None:
             log_fh.close()
@@ -238,13 +238,13 @@ def supervise(cfg: SupervisorConfig) -> int:
     while True:
         attempt += 1
         cfg.event(event="start", attempt=attempt, cmd=cfg.cmd)
-        t0 = time.time()
+        t0 = _clk.now()
         rc = _run_attempt(cfg, attempt)
         cfg.event(
             event="exit",
             attempt=attempt,
             rc=rc,
-            seconds=round(time.time() - t0, 1),
+            seconds=round(_clk.now() - t0, 1),
         )
         if rc == 0:
             cfg.event(event="complete", attempt=attempt)
@@ -275,7 +275,7 @@ def supervise(cfg: SupervisorConfig) -> int:
         cfg.event(
             event="restart", attempt=attempt, backoff_s=round(delay, 2)
         )
-        time.sleep(delay)
+        _clk.sleep(delay)
     cfg.event(event="give-up", attempts=attempt, rc=rc)
     print(
         f"[supervisor] giving up after {attempt} attempts "
@@ -419,10 +419,10 @@ def _teardown_fleet(cfg: FleetConfig, children: list) -> None:
     live = [c for c in children if c is not None and c.poll() is None]
     for c in live:
         _signal_pg(c.pid, signal.SIGTERM)
-    deadline = time.monotonic() + cfg.term_grace
+    deadline = _clk.monotonic() + cfg.term_grace
     for c in live:
-        while c.poll() is None and time.monotonic() < deadline:
-            time.sleep(0.05)
+        while c.poll() is None and _clk.monotonic() < deadline:
+            _clk.sleep(0.05)
         if c.poll() is None:
             _signal_pg(c.pid, signal.SIGKILL)
             c.wait()
@@ -468,10 +468,10 @@ def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> str:
             for i in range(cfg.num_processes)
         ]
         hb_sizes = [_hb_size(p) for p in hb_paths]
-        last_progress = [time.monotonic()] * cfg.num_processes
+        last_progress = [_clk.monotonic()] * cfg.num_processes
         done = [None] * cfg.num_processes  # rc once exited
         while True:
-            now = time.monotonic()
+            now = _clk.monotonic()
             stalled = None
             for i, child in enumerate(children):
                 if done[i] is not None:
@@ -500,7 +500,7 @@ def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> str:
             if failed is not None and done[failed] != EXIT_RESOURCE_EXHAUSTED:
                 # one extra poll cycle of grace for the reverse ordering —
                 # the peer's crash landing just before the typed exit
-                time.sleep(cfg.poll)
+                _clk.sleep(cfg.poll)
                 for i, child in enumerate(children):
                     if done[i] is None:
                         done[i] = child.poll()
@@ -558,7 +558,7 @@ def _run_fleet_attempt(cfg: FleetConfig, attempt: int) -> str:
                 return "dead"
             if all(rc == 0 for rc in done):
                 return "ok"
-            time.sleep(cfg.poll)
+            _clk.sleep(cfg.poll)
     finally:
         _teardown_fleet(cfg, children)
         for fh in log_fhs:
@@ -581,14 +581,14 @@ def supervise_fleet(cfg: FleetConfig) -> int:
             processes=cfg.num_processes,
             cmd=cfg.cmd,
         )
-        t0 = time.time()
+        t0 = _clk.now()
         status = _run_fleet_attempt(cfg, attempt)
         cfg.event(
             event="fleet-teardown",
             attempt=attempt,
             ok=status == "ok",
             status=status,
-            seconds=round(time.time() - t0, 1),
+            seconds=round(_clk.now() - t0, 1),
         )
         if status == "ok":
             cfg.event(event="fleet-complete", attempt=attempt)
@@ -609,7 +609,7 @@ def supervise_fleet(cfg: FleetConfig) -> int:
         restarts_used += 1
         delay = cfg.backoff(restarts_used)
         cfg.event(event="restart", attempt=attempt, backoff_s=round(delay, 2))
-        time.sleep(delay)
+        _clk.sleep(delay)
     cfg.event(event="fleet-give-up", attempts=attempt)
     print(
         f"[supervisor] fleet giving up after {attempt} "

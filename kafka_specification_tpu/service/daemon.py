@@ -39,6 +39,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .. import durable_io as _dio
+from ..utils import clock as _clk
 from ..engine.bfs import check
 from ..obs import RunContext, fleettrace
 from ..obs.metrics import MetricsRegistry
@@ -201,7 +202,7 @@ class Daemon:
         self.jobs_done = 0
         self.groups_run = 0
         self._stop = False
-        self._last_work = time.monotonic()
+        self._last_work = _clk.monotonic()
         self._last_tick = 0.0
         # busy-heartbeat plumbing: the job ids of the group the main
         # thread is currently executing (None = idle), and the event that
@@ -252,15 +253,15 @@ class Daemon:
                 n = self.drain_once()
                 self._tick(worked=bool(n))
                 if n:
-                    self._last_work = time.monotonic()
+                    self._last_work = _clk.monotonic()
                 else:
                     if self.cfg.idle_exit_s is not None and (
-                        time.monotonic() - self._last_work
+                        _clk.monotonic() - self._last_work
                         > self.cfg.idle_exit_s
                     ):
                         self._event("daemon-idle-exit")
                         break
-                    time.sleep(self.cfg.poll_s)
+                    _clk.sleep(self.cfg.poll_s)
                 if (
                     self.cfg.max_jobs is not None
                     and self.jobs_done >= self.cfg.max_jobs
@@ -282,7 +283,7 @@ class Daemon:
         grouped.  Returns the number of verdicts written."""
         claimed = self.queue.claim_pending()
         if claimed and self.cfg.linger_s:
-            time.sleep(self.cfg.linger_s)  # let an in-flight burst land
+            _clk.sleep(self.cfg.linger_s)  # let an in-flight burst land
             claimed += self.queue.claim_pending()
         # stall@daemon<i> wedges HERE — after the claim sweep, before any
         # lease renewal starts — so the injected failure is exactly the
@@ -954,7 +955,7 @@ class Daemon:
     # --- helpers ----------------------------------------------------------
     def _stamp(self, spec: dict, rec: dict, status: str,
                wall_s: Optional[float] = None) -> dict:
-        now = time.time()
+        now = _clk.now()
         rec["job_id"] = spec["job_id"]
         rec["tenant"] = spec.get("tenant", "default")
         rec["status"] = status
@@ -1136,10 +1137,10 @@ class Daemon:
         self._mark_daemon_fault("stall")
         self._event("daemon-wedge-injected", pid=os.getpid())
         while True:  # pragma: no cover — killed externally
-            time.sleep(3600.0)
+            _clk.sleep(3600.0)
 
     def _tick(self, worked: bool = False) -> None:
-        now = time.monotonic()
+        now = _clk.monotonic()
         if not worked and now - self._last_tick < _IDLE_TICK_S:
             return
         self._last_tick = now
@@ -1174,7 +1175,7 @@ class Daemon:
                         # router's freshness check reads these `unix`
                         # fields, and its KSPEC_CLOCK_SKEW allowance is
                         # what this fault rehearses (0-shift otherwise)
-                        t=time.time() + injected_skew_s(),
+                        t=_clk.now() + injected_skew_s(),
                         pid=os.getpid(),
                         jobs_done=self.jobs_done,
                         **fields,

@@ -46,11 +46,11 @@ import random
 import signal
 import subprocess
 import sys
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..utils import clock as _clk
 from ..resilience.supervisor import (
     SupervisorConfig,
     _hb_size,
@@ -204,7 +204,7 @@ class FleetManager:
         slot.state = "up"
         slot.respawn_at = None
         slot.hb_size = _hb_size(self._hb_path(slot.instance))
-        slot.last_progress = time.monotonic()
+        slot.last_progress = _clk.monotonic()
         self._event(
             "daemon-start",
             instance=slot.instance,
@@ -225,9 +225,9 @@ class FleetManager:
 
     def _kill(self, slot: _Slot) -> None:
         self._signal_tree(slot, signal.SIGTERM)
-        deadline = time.monotonic() + self.cfg.term_grace
-        while slot.proc.poll() is None and time.monotonic() < deadline:
-            time.sleep(0.05)
+        deadline = _clk.monotonic() + self.cfg.term_grace
+        while slot.proc.poll() is None and _clk.monotonic() < deadline:
+            _clk.sleep(0.05)
         if slot.proc.poll() is None:
             self._signal_tree(slot, signal.SIGKILL)
             slot.proc.wait()
@@ -245,7 +245,7 @@ class FleetManager:
         slot.restarts_used += 1
         delay = self.cfg.backoff(slot.restarts_used)
         slot.state = "down"
-        slot.respawn_at = time.monotonic() + delay
+        slot.respawn_at = _clk.monotonic() + delay
         self._event(
             "daemon-restart", instance=slot.instance, why=why, rc=rc,
             backoff_s=round(delay, 2), restarts=slot.restarts_used,
@@ -253,7 +253,7 @@ class FleetManager:
 
     # --- per-iteration checks ---------------------------------------------
     def _reap_and_watch(self) -> None:
-        now = time.monotonic()
+        now = _clk.monotonic()
         for slot in list(self.slots):  # a drained slot removes itself
             if slot.state == "down":
                 if slot.respawn_at is not None and now >= slot.respawn_at:
@@ -329,7 +329,7 @@ class FleetManager:
         self._schedule_restart(slot, kind, rc)
 
     def _autoscale(self) -> None:
-        now = time.monotonic()
+        now = _clk.monotonic()
         if now - self._last_scale < self.cfg.scale_interval_s:
             return
         self._last_scale = now
@@ -409,7 +409,7 @@ class FleetManager:
                         file=sys.stderr,
                     )
                     return 1
-                time.sleep(self.cfg.poll_s)
+                _clk.sleep(self.cfg.poll_s)
         finally:
             for slot in self.slots:
                 if slot.proc is not None and slot.proc.poll() is None:

@@ -65,6 +65,10 @@ from typing import Optional
 from .. import durable_io as _dio
 from ..obs import fleettrace
 from ..obs.atomicio import atomic_write_json
+# every timing decision routes through the injectable clock boundary so
+# the simfleet harness can own lease/heartbeat/backoff time wholesale
+# (utils/clock.py; default = real time, zero behavior change)
+from ..utils import clock as _clk
 
 JOB_SCHEMA = "kspec-job/1"
 
@@ -112,7 +116,14 @@ _RETRY_RNG = random.Random()
 DEFAULT_CLOCK_SKEW_S = 5.0
 
 
-def clock_skew_s() -> float:
+def clock_skew_s(explicit: Optional[float] = None) -> float:
+    """The effective skew allowance.  ``explicit`` (a harness or an
+    operator threading the value as a parameter) wins over the
+    ``KSPEC_CLOCK_SKEW`` env default; both are clamped non-negative —
+    a negative allowance would NARROW freshness windows and steal live
+    claims."""
+    if explicit is not None:
+        return max(0.0, float(explicit))
     try:
         return max(
             0.0, float(os.environ.get("KSPEC_CLOCK_SKEW",
@@ -148,7 +159,9 @@ def retry_transient(fn, attempts: Optional[int] = None,
         except OSError as e:
             if not is_transient_oserror(e) or i >= attempts - 1:
                 raise
-            time.sleep(rng.uniform(0.0, min(RETRY_CAP_S,
+            # the injected clock, not the wall: under simfleet a flaky-fs
+            # schedule's whole backoff ladder costs virtual time only
+            _clk.sleep(rng.uniform(0.0, min(RETRY_CAP_S,
                                             base * (2.0 ** i))))
 
 PENDING = "pending"
@@ -187,7 +200,7 @@ def new_job_id() -> str:
     """Sortable, collision-resistant without coordination (same recipe as
     obs run ids, distinct prefix so job and run ids never read alike)."""
     return "job-{}-{}-{}".format(
-        time.strftime("%Y%m%dT%H%M%S", time.gmtime()),
+        time.strftime("%Y%m%dT%H%M%S", time.gmtime(_clk.now())),
         os.getpid(),
         os.urandom(3).hex(),
     )
@@ -197,10 +210,19 @@ class JobQueue:
     """One service directory's queue; safe for many concurrent submitters
     and one daemon (claims are renames: first mover wins, losers skip)."""
 
-    def __init__(self, service_dir: str, create: bool = True):
+    def __init__(self, service_dir: str, create: bool = True,
+                 skew_s: Optional[float] = None):
         """create=False opens read-only (``cli status``/``result``): a
         mistyped --service-dir must raise, not silently fabricate an
-        empty service tree that masks the typo as 'no such job'."""
+        empty service tree that masks the typo as 'no such job'.
+
+        ``skew_s`` pins this queue's clock-skew allowance explicitly
+        (crashcheck's crashed-process view passes 0.0; simfleet threads
+        its scenario value) — ``None`` keeps the ``KSPEC_CLOCK_SKEW``
+        env default.  An explicit parameter instead of an env mutation:
+        the env var is process-global and two concurrent harnesses would
+        trample each other's save/restore."""
+        self.skew_s = skew_s
         self.dir = os.path.normpath(service_dir)
         self.queue_dir = os.path.join(self.dir, "queue")
         self.results_dir = os.path.join(self.dir, "results")
@@ -249,6 +271,12 @@ class JobQueue:
     def run_dir(self, job_id: str) -> str:
         return os.path.join(self.runs_dir, job_id)
 
+    def _skew(self, override: Optional[float] = None) -> float:
+        """Effective skew allowance for this queue's freshness math: a
+        per-call override wins, then the instance pin, then the env."""
+        return clock_skew_s(override if override is not None
+                            else self.skew_s)
+
     def _tenant_dir(self, tenant: str) -> str:
         """Per-tenant marker directory (admission-control index).  Keyed
         by a digest: tenant names are tenant input and must not be able
@@ -292,7 +320,7 @@ class JobQueue:
             "kernel_source": kernel_source,
             "max_depth": max_depth,
             "max_states": max_states,
-            "submitted_unix": round(time.time(), 3),
+            "submitted_unix": round(_clk.now(), 3),
             "fault": fault,
         }
         if solo:
@@ -397,14 +425,14 @@ class JobQueue:
 
     def wait_result(self, job_id: str, timeout: float = 120.0,
                     poll: float = 0.05) -> Optional[dict]:
-        deadline = time.monotonic() + timeout
+        deadline = _clk.monotonic() + timeout
         while True:
             rec = self.result(job_id)
             if rec is not None:
                 return rec
-            if time.monotonic() >= deadline:
+            if _clk.monotonic() >= deadline:
                 return None
-            time.sleep(poll)
+            _clk.sleep(poll)
 
     def overview(self) -> dict:
         """Queue depths + recent terminal jobs (``cli status`` no-arg)."""
@@ -478,8 +506,11 @@ class JobQueue:
                 # rename PRESERVES the submit-time mtime: refresh it so
                 # the janitor's leaseless-claim grace window (which keys
                 # on the claim file's age) actually covers a claim of a
-                # job that sat queued longer than the window
-                os.utime(dst)
+                # job that sat queued longer than the window.  Stamped
+                # from the injected clock so a virtual-time janitor
+                # compares like against like.
+                t_mt = _clk.now()
+                os.utime(dst, (t_mt, t_mt))
             except OSError:
                 pass
             self._write_lease(job_id)
@@ -490,7 +521,7 @@ class JobQueue:
                     raise ValueError(
                         f"unsupported job schema {spec.get('schema')!r}"
                     )
-                spec["claimed_unix"] = round(time.time(), 3)
+                spec["claimed_unix"] = round(_clk.now(), 3)
                 fleettrace.emit_span(
                     self.dir, spec.get("trace"), "queue-claim",
                     t_claim, fleettrace.now(), job_id=job_id,
@@ -540,7 +571,7 @@ class JobQueue:
                         "pid": os.getpid(),
                         "token": _PROC_TOKEN,
                         "lease_unix": round(
-                            time.time() + injected_skew_s(), 3
+                            _clk.now() + injected_skew_s(), 3
                         ),
                     }
                 ),
@@ -569,7 +600,8 @@ class JobQueue:
             self._write_lease(job_id)
 
     def lease_orphaned(self, job_id: str,
-                       lease_ttl: Optional[float] = None) -> bool:
+                       lease_ttl: Optional[float] = None,
+                       skew_s: Optional[float] = None) -> bool:
         """True iff a claimed job's lease marks it as abandoned: no lease
         sidecar (pre-lease claim or write failure), a dead claimer pid on
         this host, or an expired timestamp (shared-filesystem queues,
@@ -586,12 +618,12 @@ class JobQueue:
             # live claim mid-stamp — only a leaseless claim that has SAT
             # there is an orphan (pre-lease daemons also land here)
             try:
-                age = time.time() - os.path.getmtime(
+                age = _clk.now() - os.path.getmtime(
                     self._job_path(CLAIMED, job_id)
                 )
             except OSError:
                 return True  # claim vanished under us: nothing to hold
-            return age > 10.0 + clock_skew_s()
+            return age > 10.0 + self._skew(skew_s)
         if lease_ttl is None:
             lease_ttl = float(
                 os.environ.get("KSPEC_CLAIM_LEASE_TTL", DEFAULT_LEASE_TTL)
@@ -599,8 +631,8 @@ class JobQueue:
         # the lease timestamp may come from ANOTHER host's clock: widen
         # the expiry window by the skew allowance so a live claimer whose
         # clock runs a few seconds behind ours is never stolen from
-        age = time.time() - float(lease.get("lease_unix", 0.0))
-        if age >= lease_ttl + clock_skew_s():
+        age = _clk.now() - float(lease.get("lease_unix", 0.0))
+        if age >= lease_ttl + self._skew(skew_s):
             # expiry dominates even a live pid: the busy-heartbeat loop
             # renews every few seconds, so an expired lease means the
             # claimer is wedged beyond rescue (or a foreign-host daemon
@@ -615,16 +647,23 @@ class JobQueue:
             return lease.get("token") != _PROC_TOKEN
         return not _pid_alive(pid)
 
-    def requeue_orphans(self, lease_ttl: Optional[float] = None) -> list:
+    def requeue_orphans(self, lease_ttl: Optional[float] = None,
+                        skew_s: Optional[float] = None) -> list:
         """Startup janitor: claims whose LEASE is orphaned (dead pid /
         expired / missing — see :meth:`lease_orphaned`) go back to
         pending/ (idempotent jobs; nothing commits before the verdict).
         A live sibling daemon's leased claims are left untouched — the
-        prerequisite for two daemons sharing one queue directory."""
+        prerequisite for two daemons sharing one queue directory.
+        ``skew_s`` threads an explicit allowance through every expiry
+        decision of this sweep (see :meth:`lease_orphaned`)."""
         moved = []
         self._adopt_stale_requeues()
+        # only forward skew_s when explicitly given: tests (and older
+        # subclasses) stub lease_orphaned with the two-arg signature, and
+        # the default sweep must stay call-compatible with them
+        skw = {} if skew_s is None else {"skew_s": skew_s}
         for job_id in self._list(CLAIMED):
-            if not self.lease_orphaned(job_id, lease_ttl=lease_ttl):
+            if not self.lease_orphaned(job_id, lease_ttl=lease_ttl, **skw):
                 continue
             lease = self.read_lease(job_id)
             claimed_path = self._job_path(CLAIMED, job_id)
@@ -644,7 +683,7 @@ class JobQueue:
                 _dio.rename(claimed_path, private)
             except OSError:
                 continue  # a sibling janitor (or a finishing daemon) won
-            if not self.lease_orphaned(job_id, lease_ttl=lease_ttl):
+            if not self.lease_orphaned(job_id, lease_ttl=lease_ttl, **skw):
                 # stale decision: a live daemon re-claimed between our
                 # check and the rename — give its claim file back
                 try:
@@ -658,7 +697,7 @@ class JobQueue:
                 "by_pid": os.getpid(),
                 "reason": (
                     "no-lease" if lease is None else "lease-expired"
-                    if time.time() - float(lease.get("lease_unix", 0))
+                    if _clk.now() - float(lease.get("lease_unix", 0))
                     >= float(
                         lease_ttl
                         if lease_ttl is not None
@@ -666,10 +705,10 @@ class JobQueue:
                             "KSPEC_CLAIM_LEASE_TTL",
                             DEFAULT_LEASE_TTL,
                         )
-                    ) + clock_skew_s()
+                    ) + self._skew(skew_s)
                     else "dead-pid"
                 ),
-                "at": round(time.time(), 3),
+                "at": round(_clk.now(), 3),
             }
             try:
                 with open(private) as fh:

@@ -53,13 +53,13 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from typing import Optional
 
 from .. import durable_io as _dio
 from ..obs import fleettrace
 from ..obs.atomicio import atomic_write_json
 from ..resilience.heartbeat import append_jsonl, heartbeat_record
+from ..utils import clock as _clk
 from ..resilience.resources import budget_for_tenant, load_tenant_budgets
 from .queue import (
     CLAIMED,
@@ -119,7 +119,13 @@ class Router:
     create/refresh the router dir, or without to open an existing one."""
 
     def __init__(self, router_dir: str, hosts: Optional[list] = None,
-                 dead_after_s: Optional[float] = None):
+                 dead_after_s: Optional[float] = None,
+                 skew_s: Optional[float] = None):
+        # explicit skew pin (None = the KSPEC_CLOCK_SKEW env default):
+        # threads through every heartbeat-freshness decision AND down
+        # into the fronted queues' lease-expiry math — the harness-safe
+        # alternative to mutating the process-global env var
+        self.skew_s = skew_s
         self.dir = os.path.normpath(router_dir)
         self.routes_dir = os.path.join(self.dir, "routes")
         self.config_path = os.path.join(self.dir, "router.json")
@@ -153,7 +159,7 @@ class Router:
         # writer killed mid-atomic-write leaves a nonce'd `.tmp` here;
         # routes are multi-writer (every router instance), so grace-aged
         _dio.sweep_tmp(self.routes_dir, min_age_s=_dio.TMP_SWEEP_GRACE_S)
-        self.queues = [JobQueue(h) for h in self.hosts]
+        self.queues = [JobQueue(h, skew_s=skew_s) for h in self.hosts]
         if cfg is None or cfg.get("hosts") != self.hosts or (
             float(cfg.get("dead_after_s", -1.0)) != self.dead_after_s
         ):
@@ -165,7 +171,7 @@ class Router:
                     "dead_after_s": self.dead_after_s,
                     "created_unix": (
                         cfg.get("created_unix") if cfg
-                        else round(time.time(), 3)
+                        else round(_clk.now(), 3)
                     ),
                 },
             )
@@ -245,13 +251,14 @@ class Router:
         """One host's routable-state snapshot (see `classify_host`)."""
         q = self.queues[host]
         hb = self._newest_heartbeat_unix(host)
-        now = time.time()
+        now = _clk.now()
         seen = hb is not None
         # the heartbeat stamp came from ANOTHER host's clock: the
         # staleness window widens by the skew allowance, so a live host
         # running a few seconds behind is never declared dead
         alive = bool(
-            seen and (now - hb) <= self.dead_after_s + clock_skew_s()
+            seen
+            and (now - hb) <= self.dead_after_s + clock_skew_s(self.skew_s)
         )
         return {
             "host": host,
@@ -363,7 +370,7 @@ class Router:
         rec["host"] = host
         rec["dir"] = self.hosts[host]
         rec["history"].append(
-            {"host": host, "why": why, "at": round(time.time(), 3)}
+            {"host": host, "why": why, "at": round(_clk.now(), 3)}
         )
         try:
             atomic_write_json(
@@ -430,14 +437,14 @@ class Router:
 
     def wait_result(self, job_id: str, timeout: float = 120.0,
                     poll: float = 0.05) -> Optional[dict]:
-        deadline = time.monotonic() + timeout
+        deadline = _clk.monotonic() + timeout
         while True:
             rec = self.result(job_id)
             if rec is not None:
                 return rec
-            if time.monotonic() >= deadline:
+            if _clk.monotonic() >= deadline:
                 return None
-            time.sleep(poll)
+            _clk.sleep(poll)
 
     def overview(self) -> dict:
         try:
@@ -447,7 +454,7 @@ class Router:
         return {
             "dir": self.dir,
             "dead_after_s": self.dead_after_s,
-            "clock_skew_s": clock_skew_s(),
+            "clock_skew_s": clock_skew_s(self.skew_s),
             "routes": routes,
             "hosts": self.healths(),
         }
@@ -469,7 +476,7 @@ class Router:
                 continue
             q = self.queues[h["host"]]
             try:
-                moved = q.requeue_orphans()
+                moved = q.requeue_orphans(skew_s=self.skew_s)
             except OSError:
                 moved = []
             if moved:
@@ -545,7 +552,7 @@ class Router:
                         "to_host": target,
                         "by_pid": os.getpid(),
                         "reason": "host-dead",
-                        "at": round(time.time(), 3),
+                        "at": round(_clk.now(), 3),
                     }
                 )
                 atomic_write_json(private, spec)
@@ -641,7 +648,7 @@ class Router:
             n += 1
             if max_sweeps is not None and n >= max_sweeps:
                 return
-            time.sleep(poll_s)
+            _clk.sleep(poll_s)
 
     def request_stop(self) -> None:
         self._stop = True

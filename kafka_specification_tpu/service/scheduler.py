@@ -128,9 +128,10 @@ class TenantPolicy:
 
     def _refresh(self) -> None:
         import sys
-        import time
 
-        now = time.monotonic()
+        from ..utils import clock as _clk
+
+        now = _clk.monotonic()
         if now - self._loaded_at < _BUDGET_TTL_S:
             return
         self._loaded_at = now

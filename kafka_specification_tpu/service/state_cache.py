@@ -89,7 +89,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -98,6 +97,7 @@ import numpy as np
 from ..resilience import integrity as _integ
 from ..resilience.faults import FaultPlan, corrupt_file
 from .. import durable_io as _dio
+from ..utils import clock as _clk
 from ..storage.atomic import atomic_write
 from ..storage.runs import RunCorrupt, SortedRun, write_run
 
@@ -458,7 +458,7 @@ class StateSpaceCache:
         d = self._entry_dir(key)
         entry = {
             "schema": CACHE_SCHEMA,
-            "created_unix": round(time.time(), 3),
+            "created_unix": round(_clk.now(), 3),
             "key": key.base_dict(),
             "max_depth": key.max_depth,
             "max_states": key.max_states,
@@ -616,7 +616,7 @@ class StateSpaceCache:
         # a referenced run's bloom sidecar is part of the artifact
         referenced |= {name + ".bloom" for name in tuple(referenced)}
         removed = []
-        now = time.time()
+        now = _clk.now()
         try:
             names = os.listdir(d)
         except OSError:

@@ -479,6 +479,49 @@ def main(argv=None):
     pcc.add_argument("--json", action="store_true",
                      help="machine-readable kspec-crashcheck/1 record")
 
+    psf = sub.add_parser(
+        "simfleet",
+        help="deterministic fleet simulation (docs/resilience.md "
+        "§ Deterministic simulation): run the REAL router/queue/daemon/"
+        "cache control plane under a virtual clock and a seeded "
+        "scheduler, search interleavings across seeds (kill, partition, "
+        "clock skew, flaky fs), judge every run with invariant oracles, "
+        "and shrink any violation to a minimal kspec-simfleet/1 repro — "
+        "never imports jax.  `run` exits 1 on any violation; `replay` "
+        "re-runs a repro and exits 0 if it still reproduces, 2 if stale",
+    )
+    sfsub = psf.add_subparsers(dest="sf_cmd", required=True)
+    psr = sfsub.add_parser("run", help="sweep seeds, shrink violations")
+    psr.add_argument("--seeds", type=int, default=50,
+                     help="how many seeds to run (default 50)")
+    psr.add_argument("--start-seed", type=int, default=0,
+                     help="first seed (default 0)")
+    psr.add_argument("--hosts", type=int, default=2)
+    psr.add_argument("--jobs", type=int, default=4)
+    psr.add_argument("--steps", type=int, default=60,
+                     help="schedule length per seed (default 60)")
+    psr.add_argument(
+        "--coverage", action="store_true",
+        help="coverage-guided: seeds that reach new adjacent event-type "
+        "pairs queue derived seeds behind them",
+    )
+    psr.add_argument(
+        "--out", default="simfleet-repros", metavar="DIR",
+        help="directory violations' shrunk repros are banked in "
+        "(default ./simfleet-repros)",
+    )
+    psr.add_argument("--json", action="store_true")
+    psp = sfsub.add_parser("replay",
+                           help="replay a kspec-simfleet/1 repro")
+    psp.add_argument("repro", help="kspec-simfleet/1 file")
+    psp.add_argument(
+        "--trace", action="store_true",
+        help="assemble the violating job's fleet trace from the "
+        "simulated run and render the same waterfall `cli trace` "
+        "gives real runs",
+    )
+    psp.add_argument("--json", action="store_true")
+
     pp = sub.add_parser(
         "pipelines",
         help="enumerate the registered level-pipeline implementations "
@@ -1073,6 +1116,11 @@ def main(argv=None):
                     print(f"    {v}")
         return 0 if rec["ok"] else 1
 
+    if args.cmd == "simfleet":
+        # deterministic fleet simulation: jax-free by construction (the
+        # whole simulated plane is the jax-free control plane)
+        return _run_simfleet(args)
+
     if args.cmd == "pipelines":
         # pure registry dump (pipeline_registry.PIPELINE_REGISTRY, the
         # fault-registry pattern): jax-free, the same source the
@@ -1639,6 +1687,120 @@ def main(argv=None):
 
 
 
+def _run_simfleet(args) -> int:
+    """`cli simfleet run|replay`: the deterministic fleet simulator.
+
+    Exit codes — run: 0 = every seed clean, 1 = violations (repros
+    banked under --out), 2 = bad arguments.  replay: 0 = the repro
+    still reproduces its recorded violation, 2 = stale."""
+    from ..resilience import simfleet as sf
+
+    if args.sf_cmd == "run":
+        cfg = sf.SimConfig(hosts=args.hosts, jobs=args.jobs,
+                           steps=args.steps)
+        if args.seeds < 1 or args.hosts < 1 or args.jobs < 0:
+            print("error: --seeds/--hosts must be >= 1", file=sys.stderr)
+            return 2
+        seeds = range(args.start_seed, args.start_seed + args.seeds)
+        summary = sf.sweep_seeds(
+            seeds, config=cfg, coverage=args.coverage,
+            max_extra=max(2, args.seeds // 5) if args.coverage else 0,
+        )
+        banked = []
+        for hit in summary["violating"]:
+            seed, record = hit["seed"], hit["record"]
+            v = record["violations"][0]
+            try:
+                small, srec = sf.shrink(record["schedule"], cfg, seed,
+                                        v["oracle"])
+            except ValueError:
+                # drain-phase-only violation on an empty-ish schedule:
+                # the full schedule IS the minimal repro
+                small, srec = record["schedule"], record
+            os.makedirs(args.out, exist_ok=True)
+            path = os.path.join(
+                args.out, f"repro-seed{seed}-{v['oracle']}.json")
+            # bank the violation as the SHRUNK run reports it: job ids
+            # shift when submit events drop out of the schedule, and a
+            # repro must name a job that exists in its own replay
+            sv = next((w for w in srec["violations"]
+                       if w["oracle"] == v["oracle"]), v)
+            sf.save_repro(path, seed, cfg, sv, small, srec,
+                          shrunk_from=len(record["schedule"]))
+            banked.append({"seed": seed, "oracle": v["oracle"],
+                           "events": len(small), "path": path})
+        rec = {
+            "schema": "kspec-simfleet-sweep/1",
+            "config": summary["config"],
+            "runs": summary["runs"],
+            "clean": summary["clean"],
+            "pair_coverage": summary["pair_coverage"],
+            "violations": banked,
+            "ok": not banked,
+        }
+        if args.json:
+            print(json.dumps(rec))
+        else:
+            print(f"kspec simfleet: {rec['runs']} seed(s) — "
+                  f"{rec['clean']} clean, {len(banked)} violating "
+                  f"({rec['pair_coverage']} event-pair(s) covered)")
+            for b in banked:
+                print(f"  VIOLATION seed {b['seed']}: {b['oracle']} — "
+                      f"shrunk to {b['events']} event(s), repro at "
+                      f"{b['path']}")
+        return 0 if rec["ok"] else 1
+
+    # replay
+    try:
+        repro = sf.load_repro(args.repro)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    out = sf.replay_repro(repro, keep_root=args.trace)
+    record = out["record"]
+    rec = {
+        "schema": "kspec-simfleet-replay/1",
+        "repro": {k: repro[k] for k in
+                  ("seed", "violation", "events_digest", "shrunk_from")},
+        "reproduced": out["reproduced"],
+        "digest_match": out["digest_match"],
+        "violations": record["violations"],
+    }
+    try:
+        if args.json:
+            print(json.dumps(rec))
+        else:
+            v = repro["violation"]
+            state = ("REPRODUCED" if out["reproduced"] else
+                     "STALE (violation no longer fires)")
+            print(f"kspec simfleet replay: {state} — {v['oracle']} "
+                  f"on {v['job']} over {len(repro['schedule'])} "
+                  f"event(s); digest "
+                  f"{'match' if out['digest_match'] else 'DRIFT'}")
+            for got in record["violations"]:
+                print(f"  {got['oracle']} @step {got['step']} "
+                      f"job={got['job']}: {got['detail']}")
+            if args.trace and out["kernel"] is not None:
+                from ..obs import fleettrace as ft
+
+                job = (next((g["job"] for g in record["violations"]
+                             if g.get("job")), None)
+                       or v.get("job")
+                       or next(iter(record["verdicts"]), None))
+                if job:
+                    recs = ft.load_trace(out["kernel"].trace_roots(),
+                                         job)
+                    if recs:
+                        print()
+                        print(ft.render_trace(ft.assemble(recs, job)))
+                    else:
+                        print(f"  (no trace records for {job})")
+    finally:
+        if out["kernel"] is not None:
+            out["kernel"].cleanup()
+    return 0 if out["reproduced"] else 2
+
+
 def _run_analyze(args) -> int:
     """`cli analyze`: the spec & engine static-analysis driver.
 
@@ -1738,6 +1900,21 @@ def _run_analyze(args) -> int:
         for prob in lint_durable_io():
             findings.append(Finding(
                 kind="durable-io", severity="HIGH",
+                target=f"{prob['path']}:{prob['line']}",
+                message=prob["problem"],
+                data=dict(prob),
+            ))
+        # raw-clock discipline lint (analysis/clock_lint): every timing
+        # decision in a clock-migrated module must route through
+        # utils/clock.py so the simfleet virtual clock owns it — a raw
+        # time.time()/sleep()/monotonic() site silently reads the real
+        # wall clock under simulation and breaks seed determinism
+        targets.append("raw-clock discipline (utils/clock boundary)")
+        from ..analysis.clock_lint import lint_raw_clock
+
+        for prob in lint_raw_clock():
+            findings.append(Finding(
+                kind="raw-clock", severity="HIGH",
                 target=f"{prob['path']}:{prob['line']}",
                 message=prob["problem"],
                 data=dict(prob),

@@ -1,0 +1,114 @@
+"""The one injectable clock/sleep boundary for the jax-free control plane.
+
+Every timing decision the service plane makes — lease stamps and expiry
+math, heartbeat freshness, retry backoff, poll sleeps, supervisor stall
+detection — used to call ``time.time()`` / ``time.monotonic()`` /
+``time.sleep()`` raw, which made that plane untestable except in real
+time: a lease-expiry race takes ``lease_ttl`` wall seconds to stage, and
+an interleaving that needs a 40-second clock jump cannot be staged at
+all.  This module is the seam that fixes it, the clock twin of
+``durable_io``'s recorder: control-plane modules call
+:func:`now`/:func:`monotonic`/:func:`sleep` here, and a harness
+(``resilience/simfleet``) installs a virtual clock that owns time
+wholesale — same production code, simulated schedule.
+
+With the default :class:`SystemClock` installed every call is a direct
+pass-through to ``time`` — one attribute hop of overhead, zero behavior
+change.  The pass-through resolves ``time.time``/``time.sleep`` at call
+time, so tests that monkeypatch attributes on the ``time`` module keep
+working unchanged through the shim.
+
+The ``raw-clock`` lint (``analysis/clock_lint.py``, wired into ``cli
+analyze``) pins the boundary: a raw ``time.time()``/``time.sleep()``/
+``time.monotonic()`` in a clock-migrated module is a HIGH finding unless
+the site carries a reasoned ``# kspec: allow(raw-clock)`` tag.
+
+Clock contract:
+
+``now()``        wall-clock seconds (the thing cross-host metadata
+                 stamps carry: lease_unix, heartbeat unix, route `at`)
+``monotonic()``  monotonic seconds for local deadlines and durations
+                 (never compared across processes or hosts)
+``sleep(s)``     blocks for ``s`` seconds — a virtual clock advances
+                 its own time instead, so a retry backoff or a poll
+                 loop costs simulated time, not wall time
+
+Leaf contract: stdlib-only, zero intra-package imports (imported by
+``durable_io``-adjacent leaves like ``resilience/heartbeat.py``).
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+__all__ = [
+    "Clock", "SystemClock", "SYSTEM",
+    "install", "get", "now", "monotonic", "sleep",
+]
+
+
+class Clock:
+    """The interface a virtual clock implements (duck-typed; this base
+    doubles as documentation).  All three methods are required."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """Real time.  Late-bound lookups on the ``time`` module so test
+    monkeypatching of ``time.sleep``/``time.time`` still intercepts."""
+
+    def now(self) -> float:
+        return _time.time()
+
+    def monotonic(self) -> float:
+        return _time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+
+#: the production default — also importable directly for code that must
+#: read REAL time regardless of any installed virtual clock (e.g. the
+#: simfleet runner's own wall-time budget accounting)
+SYSTEM = SystemClock()
+
+_CLOCK = SYSTEM
+
+
+def install(clock):
+    """Install a clock (``None`` restores :data:`SYSTEM`).  Returns the
+    previous clock so callers can restore it — the ``durable_io.install``
+    idiom.  Process-global by design: the control plane under simulation
+    is single-threaded, and the production default is never installed
+    over."""
+    global _CLOCK
+    prev = _CLOCK
+    _CLOCK = SYSTEM if clock is None else clock
+    return prev
+
+
+def get() -> Clock:
+    return _CLOCK
+
+
+def now() -> float:
+    """Wall-clock seconds via the installed clock."""
+    return _CLOCK.now()
+
+
+def monotonic() -> float:
+    """Monotonic seconds via the installed clock."""
+    return _CLOCK.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """Sleep via the installed clock (virtual clocks advance instead)."""
+    _CLOCK.sleep(seconds)

@@ -72,6 +72,35 @@ print(f"# crashcheck ok: {rec['states']} states / "
       f"{len(rec['protocols'])} protocols in {rec['seconds']}s")
 EOF
 
+# 0b. deterministic fleet simulation soak (jax-free; docs/resilience.md
+# § Deterministic simulation).  Two blocks: a FIXED seed corpus — the
+# regression floor, every seed has been clean before and must stay
+# clean — plus a date-derived block so each night explores schedules no
+# prior night ran.  A violating seed shrinks to a kspec-simfleet/1
+# repro banked under $WORK/simfleet-repros (attach it to the bug
+# report; `cli simfleet replay <file> --trace` shows the interleaving)
+# and fails the night.
+$KSPEC simfleet run --seeds 500 --json \
+    --out "$WORK/simfleet-repros" > "$WORK/simfleet-fixed.json" \
+    || { echo "FAIL: simfleet fixed-seed soak found violations" \
+              " (repros in $WORK/simfleet-repros)"; \
+         cat "$WORK/simfleet-fixed.json"; exit 1; }
+$KSPEC simfleet run --seeds 250 --coverage \
+    --start-seed "$(( $(date +%Y%m%d) * 1000 ))" --json \
+    --out "$WORK/simfleet-repros" > "$WORK/simfleet-nightly.json" \
+    || { echo "FAIL: simfleet date-seeded soak found violations" \
+              " (repros in $WORK/simfleet-repros)"; \
+         cat "$WORK/simfleet-nightly.json"; exit 1; }
+python - "$WORK/simfleet-fixed.json" "$WORK/simfleet-nightly.json" <<'EOF'
+import json, sys
+for path in sys.argv[1:]:
+    rec = json.load(open(path))
+    assert rec["schema"] == "kspec-simfleet-sweep/1", rec["schema"]
+    assert rec["ok"] and rec["clean"] == rec["runs"], rec["violations"]
+    print(f"# simfleet ok: {rec['runs']} seeds clean "
+          f"({rec['pair_coverage']} event pairs) [{path.split('/')[-1]}]")
+EOF
+
 # 1. plan: jax-free dry run, must not create a sweep dir
 $KSPEC sweep plan "$LATTICE" --state-cache-dir "$SVC/state-cache"
 test ! -e "$WORK/sweep1" || { echo "FAIL: plan had side effects"; exit 1; }
